@@ -1,0 +1,11 @@
+"""Test harness config: force the CPU backend with 8 virtual devices so the
+mesh/sharding tests run without real TPU hardware (the driver separately
+dry-runs the multi-chip path)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()

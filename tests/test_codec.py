@@ -1,0 +1,120 @@
+"""Wire-primitive and fixed-header tests (golden bytes hand-computed from the
+MQTT 3.1.1 / 5.0 specs)."""
+
+import pytest
+
+from maxmq_tpu.protocol.codec import (
+    FixedHeader,
+    MalformedPacketError,
+    PacketType as PT,
+    read_binary,
+    read_string,
+    read_uint16,
+    read_uint32,
+    read_varint,
+    valid_utf8_string,
+    varint_len,
+    write_binary,
+    write_string,
+    write_uint16,
+    write_uint32,
+    write_varint,
+)
+
+
+def test_uint16_roundtrip():
+    out = bytearray()
+    write_uint16(out, 0xABCD)
+    assert bytes(out) == b"\xab\xcd"
+    assert read_uint16(bytes(out), 0) == (0xABCD, 2)
+
+
+def test_uint32_roundtrip():
+    out = bytearray()
+    write_uint32(out, 0x01020304)
+    assert bytes(out) == b"\x01\x02\x03\x04"
+    assert read_uint32(bytes(out), 0) == (0x01020304, 4)
+
+
+def test_uint_truncated():
+    with pytest.raises(MalformedPacketError):
+        read_uint16(b"\x01", 0)
+    with pytest.raises(MalformedPacketError):
+        read_uint32(b"\x01\x02\x03", 0)
+
+
+def test_string_roundtrip():
+    out = bytearray()
+    write_string(out, "a/b")
+    assert bytes(out) == b"\x00\x03a/b"
+    assert read_string(bytes(out), 0) == ("a/b", 5)
+
+
+def test_string_rejects_null_and_bad_utf8():
+    assert not valid_utf8_string(b"ab\x00cd")
+    assert not valid_utf8_string(b"\xff\xfe")
+    with pytest.raises(MalformedPacketError):
+        read_string(b"\x00\x02\xff\xfe", 0)
+
+
+def test_binary_truncated():
+    with pytest.raises(MalformedPacketError):
+        read_binary(b"\x00\x05abc", 0)
+
+
+# Spec 1.5.5 examples: 0->0x00, 127->0x7F, 128->0x80 0x01, 16383->0xFF 0x7F,
+# 16384 -> 0x80 0x80 0x01, max 268435455 -> 0xFF 0xFF 0xFF 0x7F.
+@pytest.mark.parametrize("value,wire", [
+    (0, b"\x00"),
+    (127, b"\x7f"),
+    (128, b"\x80\x01"),
+    (16383, b"\xff\x7f"),
+    (16384, b"\x80\x80\x01"),
+    (2_097_151, b"\xff\xff\x7f"),
+    (2_097_152, b"\x80\x80\x80\x01"),
+    (268_435_455, b"\xff\xff\xff\x7f"),
+])
+def test_varint_golden(value, wire):
+    out = bytearray()
+    write_varint(out, value)
+    assert bytes(out) == wire
+    assert read_varint(wire, 0) == (value, len(wire))
+    assert varint_len(value) == len(wire)
+
+
+def test_varint_overlong_and_range():
+    with pytest.raises(MalformedPacketError):
+        read_varint(b"\xff\xff\xff\xff\x7f", 0)
+    with pytest.raises(MalformedPacketError):
+        write_varint(bytearray(), 268_435_456)
+    with pytest.raises(MalformedPacketError):
+        read_varint(b"\x80\x80", 0)  # truncated continuation
+
+
+def test_fixed_header_publish_flags():
+    fh = FixedHeader(type=PT.PUBLISH, dup=True, qos=2, retain=True, remaining=5)
+    out = bytearray()
+    fh.encode(out)
+    # 0x3 << 4 | dup(8) | qos2(100) | retain(1) = 0x3D
+    assert bytes(out) == b"\x3d\x05"
+    back = FixedHeader.decode(out[0], 5)
+    assert (back.dup, back.qos, back.retain) == (True, 2, True)
+
+
+def test_fixed_header_qos3_malformed():
+    with pytest.raises(MalformedPacketError):
+        FixedHeader.decode(0x36, 0)  # PUBLISH qos=3
+
+
+def test_fixed_header_reserved_flags_rejected():
+    # SUBSCRIBE requires flags 0b0010 [MQTT-3.8.1-1]
+    with pytest.raises(MalformedPacketError):
+        FixedHeader.decode((PT.SUBSCRIBE << 4) | 0x0, 0)
+    ok = FixedHeader.decode((PT.SUBSCRIBE << 4) | 0x2, 0)
+    assert ok.type == PT.SUBSCRIBE
+    # PUBREL requires 0b0010 too
+    with pytest.raises(MalformedPacketError):
+        FixedHeader.decode((PT.PUBREL << 4) | 0x0, 0)
+    # reserved type 0
+    with pytest.raises(MalformedPacketError):
+        FixedHeader.decode(0x00, 0)

@@ -1,0 +1,342 @@
+"""Packet codec conformance: golden wire vectors (hand-computed from the MQTT
+specs, in the spirit of the reference's tpackets corpus), roundtrips for every
+packet type at v3/v4/v5, and malformed-input rejection."""
+
+import pytest
+
+from maxmq_tpu.protocol import (
+    FixedHeader,
+    MalformedPacketError,
+    Packet,
+    PacketType as PT,
+    Properties,
+    ProtocolError,
+    Subscription,
+    Will,
+    codes,
+    parse_stream,
+)
+
+
+def roundtrip(p: Packet) -> Packet:
+    wire = p.encode()
+    buf = bytearray(wire)
+    frames = list(parse_stream(buf))
+    assert len(frames) == 1 and not buf
+    fh, body = frames[0]
+    return Packet.decode(fh, body, protocol_version=p.protocol_version)
+
+
+# ---------------------------------------------------------------------------
+# Golden vectors
+# ---------------------------------------------------------------------------
+
+def test_connect_v4_golden():
+    p = Packet(fixed=FixedHeader(type=PT.CONNECT), protocol_version=4,
+               clean_start=True, keepalive=60, client_id="abc")
+    assert p.encode() == bytes.fromhex("100f00044d5154540402003c0003616263")
+
+
+def test_connect_v311_decode_golden():
+    wire = bytes.fromhex("100f00044d5154540402003c0003616263")
+    buf = bytearray(wire)
+    fh, body = next(parse_stream(buf))
+    p = Packet.decode(fh, body)
+    assert p.protocol_name == "MQTT"
+    assert p.protocol_version == 4
+    assert p.clean_start is True
+    assert p.keepalive == 60
+    assert p.client_id == "abc"
+    assert p.will is None
+
+
+def test_publish_qos1_v4_golden():
+    p = Packet(fixed=FixedHeader(type=PT.PUBLISH, qos=1), topic="a/b",
+               packet_id=10, payload=b"hi")
+    assert p.encode() == bytes.fromhex("320900042f62002f686a").replace(
+        bytes.fromhex("042f62002f686a"), bytes.fromhex("03612f62000a6869"))
+    # explicit: 32 09 0003 'a/b' 000a 'hi'
+    assert p.encode() == b"\x32\x09\x00\x03a/b\x00\x0ahi"
+
+
+def test_subscribe_v4_golden():
+    p = Packet(fixed=FixedHeader(type=PT.SUBSCRIBE), packet_id=1,
+               filters=[Subscription(filter="s/#", qos=1)])
+    assert p.encode() == b"\x82\x08\x00\x01\x00\x03s/#\x01"
+
+
+def test_pingreq_golden():
+    assert Packet(fixed=FixedHeader(type=PT.PINGREQ)).encode() == b"\xc0\x00"
+    assert Packet(fixed=FixedHeader(type=PT.PINGRESP)).encode() == b"\xd0\x00"
+
+
+def test_connack_v4_golden():
+    p = Packet(fixed=FixedHeader(type=PT.CONNACK), session_present=True,
+               reason_code=0)
+    assert p.encode() == b"\x20\x02\x01\x00"
+
+
+def test_publish_v5_with_properties_golden():
+    p = Packet(fixed=FixedHeader(type=PT.PUBLISH), protocol_version=5,
+               topic="t", payload=b"x",
+               properties=Properties(payload_format=1))
+    assert p.encode() == b"\x30\x07\x00\x01t\x02\x01\x01x"
+
+
+# ---------------------------------------------------------------------------
+# Roundtrips
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("version", [3, 4, 5])
+def test_connect_full_roundtrip(version):
+    p = Packet(fixed=FixedHeader(type=PT.CONNECT), protocol_version=version,
+               clean_start=False, keepalive=30, client_id="cl1",
+               username=b"user", password=b"pw",
+               username_flag=True, password_flag=True,
+               will=Will(topic="w/t", payload=b"gone", qos=1, retain=True))
+    if version == 5:
+        p.properties = Properties(session_expiry=120, receive_maximum=5)
+        p.will.properties = Properties(will_delay=9, message_expiry=44)
+    q = roundtrip(p)
+    assert q.client_id == "cl1"
+    assert q.keepalive == 30
+    assert q.username == b"user" and q.password == b"pw"
+    assert q.will is not None
+    assert (q.will.topic, q.will.payload, q.will.qos, q.will.retain) == \
+        ("w/t", b"gone", 1, True)
+    if version == 5:
+        assert q.properties.session_expiry == 120
+        assert q.properties.receive_maximum == 5
+        assert q.will.properties.will_delay == 9
+
+
+def test_connect_v3_protocol_name():
+    p = Packet(fixed=FixedHeader(type=PT.CONNECT), protocol_version=3,
+               client_id="x", clean_start=True)
+    wire = p.encode()
+    assert b"MQIsdp" in wire
+    assert roundtrip(p).protocol_version == 3
+
+
+@pytest.mark.parametrize("version", [4, 5])
+@pytest.mark.parametrize("qos", [0, 1, 2])
+def test_publish_roundtrip(version, qos):
+    p = Packet(fixed=FixedHeader(type=PT.PUBLISH, qos=qos, retain=True,
+                                 dup=qos > 0),
+               protocol_version=version, topic="x/y/z",
+               packet_id=77 if qos else 0, payload=b"\x00\x01payload")
+    if version == 5:
+        p.properties = Properties(message_expiry=10, topic_alias=3,
+                                  user_properties=[("k", "v")],
+                                  subscription_ids=[5])
+    q = roundtrip(p)
+    assert q.topic == "x/y/z"
+    assert q.payload == b"\x00\x01payload"
+    assert q.fixed.retain and (q.fixed.dup == (qos > 0))
+    if qos:
+        assert q.packet_id == 77
+    if version == 5:
+        assert q.properties.message_expiry == 10
+        assert q.properties.topic_alias == 3
+        assert q.properties.user_properties == [("k", "v")]
+        assert q.properties.subscription_ids == [5]
+
+
+@pytest.mark.parametrize("ptype", [PT.PUBACK, PT.PUBREC, PT.PUBREL, PT.PUBCOMP])
+@pytest.mark.parametrize("version", [4, 5])
+def test_ack_roundtrip(ptype, version, reason=0x10):
+    p = Packet(fixed=FixedHeader(type=ptype), protocol_version=version,
+               packet_id=99, reason_code=reason if version == 5 else 0)
+    q = roundtrip(p)
+    assert q.packet_id == 99
+    if version == 5:
+        assert q.reason_code == reason
+
+
+def test_ack_v5_short_form():
+    # v5 acks with success reason omit reason code + properties entirely.
+    p = Packet(fixed=FixedHeader(type=PT.PUBACK), protocol_version=5, packet_id=7)
+    assert p.encode() == b"\x40\x02\x00\x07"
+    q = roundtrip(p)
+    assert q.packet_id == 7 and q.reason_code == 0
+
+
+@pytest.mark.parametrize("version", [4, 5])
+def test_subscribe_roundtrip(version):
+    subs = [Subscription(filter="a/+/c", qos=2, no_local=version == 5,
+                         retain_as_published=version == 5, retain_handling=1
+                         if version == 5 else 0),
+            Subscription(filter="#", qos=0)]
+    p = Packet(fixed=FixedHeader(type=PT.SUBSCRIBE), protocol_version=version,
+               packet_id=42, filters=subs)
+    if version == 5:
+        p.properties = Properties(subscription_ids=[9])
+    q = roundtrip(p)
+    assert [s.filter for s in q.filters] == ["a/+/c", "#"]
+    assert q.filters[0].qos == 2
+    if version == 5:
+        assert q.filters[0].no_local is True
+        assert q.filters[0].retain_as_published is True
+        assert q.filters[0].retain_handling == 1
+        assert q.filters[0].identifier == 9
+        assert q.filters[1].identifier == 9
+
+
+@pytest.mark.parametrize("version", [4, 5])
+def test_suback_unsub_roundtrip(version):
+    p = Packet(fixed=FixedHeader(type=PT.SUBACK), protocol_version=version,
+               packet_id=42, reason_codes=[0, 1, 0x80])
+    q = roundtrip(p)
+    assert q.reason_codes == [0, 1, 0x80]
+
+    u = Packet(fixed=FixedHeader(type=PT.UNSUBSCRIBE), protocol_version=version,
+               packet_id=43, filters=[Subscription(filter="a/b")])
+    qu = roundtrip(u)
+    assert [s.filter for s in qu.filters] == ["a/b"]
+
+    ua = Packet(fixed=FixedHeader(type=PT.UNSUBACK), protocol_version=version,
+                packet_id=43, reason_codes=[0] if version == 5 else [])
+    qua = roundtrip(ua)
+    assert qua.packet_id == 43
+
+
+def test_disconnect_roundtrip_v5():
+    p = Packet(fixed=FixedHeader(type=PT.DISCONNECT), protocol_version=5,
+               reason_code=codes.ErrServerShuttingDown.value,
+               properties=Properties(reason_string="bye"))
+    q = roundtrip(p)
+    assert q.reason_code == 0x8B
+    assert q.properties.reason_string == "bye"
+    # v4 DISCONNECT is empty-bodied
+    v4 = Packet(fixed=FixedHeader(type=PT.DISCONNECT), protocol_version=4)
+    assert v4.encode() == b"\xe0\x00"
+
+
+def test_auth_roundtrip():
+    p = Packet(fixed=FixedHeader(type=PT.AUTH), protocol_version=5,
+               reason_code=codes.ContinueAuthentication.value,
+               properties=Properties(auth_method="SCRAM", auth_data=b"\x01"))
+    q = roundtrip(p)
+    assert q.reason_code == 0x18
+    assert q.properties.auth_method == "SCRAM"
+
+
+# ---------------------------------------------------------------------------
+# Malformed / protocol-error inputs
+# ---------------------------------------------------------------------------
+
+def dec(hexstr, version=4):
+    buf = bytearray(bytes.fromhex(hexstr))
+    fh, body = next(parse_stream(buf))
+    return Packet.decode(fh, body, protocol_version=version)
+
+
+def test_connect_reserved_flag_rejected():
+    # flags byte 0x03 sets reserved bit 0
+    with pytest.raises(ProtocolError):
+        dec("100f00044d5154540403003c0003616263")
+
+
+def test_connect_bad_protocol_name():
+    with pytest.raises(ProtocolError) as ei:
+        dec("100f0004514d54540402003c0003616263")  # "QMTT"
+    assert ei.value.code == codes.ErrUnsupportedProtocolVersion
+
+
+def test_connect_will_qos_without_flag():
+    # will qos bits set but will flag clear (flags 0x18)
+    with pytest.raises(ProtocolError):
+        dec("100f00044d5154540418003c0003616263")
+
+
+def test_publish_qos0_with_packet_id_is_just_payload():
+    # qos0 publish: no packet-id field; bytes after topic are payload
+    p = dec("300700036162630102")  # topic "abc", payload 0x0102
+    assert p.topic == "abc" and p.payload == b"\x01\x02"
+
+
+def test_publish_qos_nonzero_packet_id_zero():
+    with pytest.raises(ProtocolError):
+        dec("32070003616263000041")  # qos1, packet id 0
+
+
+def test_publish_wildcard_topic_invalid():
+    p = Packet(fixed=FixedHeader(type=PT.PUBLISH), topic="a/+/b")
+    with pytest.raises(ProtocolError) as ei:
+        p.validate_publish()
+    assert ei.value.code == codes.ErrTopicNameInvalid
+    p2 = Packet(fixed=FixedHeader(type=PT.PUBLISH), topic="")
+    with pytest.raises(ProtocolError):
+        p2.validate_publish()
+
+
+def test_subscribe_no_filters_rejected():
+    with pytest.raises(ProtocolError):
+        dec("82020001")
+
+
+def test_subscribe_missing_options_byte():
+    with pytest.raises(MalformedPacketError):
+        dec("820700010003612f62")  # filter present, options byte absent
+
+
+def test_subscribe_reserved_option_bits_v5():
+    with pytest.raises(MalformedPacketError):
+        dec("820900010000036162634f", version=5)  # options 0x4F has bit6 set
+
+
+def test_unsubscribe_no_filters_rejected():
+    with pytest.raises(ProtocolError):
+        dec("a2020001")
+
+
+def test_properties_invalid_for_packet_type():
+    # TOPIC_ALIAS (0x23) inside CONNECT properties is invalid
+    with pytest.raises(MalformedPacketError):
+        dec("101200044d515454050200000323000100026964", version=5)
+
+
+def test_properties_duplicate_rejected():
+    # PUBLISH v5 with payload_format twice
+    with pytest.raises(MalformedPacketError):
+        dec("3009000174040101010178", version=5)
+
+
+def test_parse_stream_partial_and_multiple():
+    a = Packet(fixed=FixedHeader(type=PT.PINGREQ)).encode()
+    b = Packet(fixed=FixedHeader(type=PT.PUBLISH), topic="t", payload=b"p").encode()
+    buf = bytearray(a + b[:3])
+    frames = list(parse_stream(buf))
+    assert len(frames) == 1 and frames[0][0].type == PT.PINGREQ
+    buf.extend(b[3:])
+    frames = list(parse_stream(buf))
+    assert len(frames) == 1 and frames[0][0].type == PT.PUBLISH
+
+
+def test_parse_stream_max_packet_size():
+    big = Packet(fixed=FixedHeader(type=PT.PUBLISH), topic="t",
+                 payload=b"x" * 100).encode()
+    with pytest.raises(ProtocolError) as ei:
+        list(parse_stream(bytearray(big), max_packet_size=50))
+    assert ei.value.code == codes.ErrPacketTooLarge
+
+
+def test_packet_copy_is_deep():
+    p = Packet(fixed=FixedHeader(type=PT.PUBLISH, qos=1), topic="t",
+               payload=b"p", packet_id=5,
+               properties=Properties(user_properties=[("a", "b")]),
+               filters=[Subscription(filter="f", qos=1)])
+    q = p.copy()
+    q.properties.user_properties.append(("c", "d"))
+    q.filters[0].qos = 2
+    q.fixed.qos = 0
+    assert p.properties.user_properties == [("a", "b")]
+    assert p.filters[0].qos == 1
+    assert p.fixed.qos == 1
+
+
+def test_connack_v3_downgrade():
+    assert codes.connack_for_version(codes.ErrNotAuthorized, 4) == 0x05
+    assert codes.connack_for_version(codes.ErrBadUsernameOrPassword, 3) == 0x04
+    assert codes.connack_for_version(codes.ErrNotAuthorized, 5) == 0x87
+    assert codes.connack_for_version(codes.Success, 4) == 0x00
