@@ -1,0 +1,68 @@
+"""Topic-name / topic-filter utilities shared by the CPU matcher and the NFA
+compiler: level splitting, validation, `$share` parsing.
+
+Parity surface: vendor/github.com/mochi-co/mqtt/v2/topics.go:558-624 in the
+reference (isolateParticle / IsValidFilter). Re-derived from MQTT spec 4.7.
+"""
+
+from __future__ import annotations
+
+SHARE_PREFIX = "$share"
+
+
+def split_levels(topic: str) -> list[str]:
+    """Split a topic/filter on '/' keeping empty levels ('a//b' -> 3 levels)."""
+    return topic.split("/")
+
+
+def parse_share(filter_: str) -> tuple[str, str]:
+    """Return (group, inner_filter); group == '' for non-shared filters."""
+    if not filter_.startswith(SHARE_PREFIX + "/"):
+        return "", filter_
+    rest = filter_[len(SHARE_PREFIX) + 1:]
+    group, sep, inner = rest.partition("/")
+    if not sep:
+        return group, ""
+    return group, inner
+
+
+def valid_filter(filter_: str, shared_allowed: bool = True,
+                 wildcards_allowed: bool = True) -> bool:
+    """MQTT 4.7.1 filter validity, incl. `$share/{group}/{filter}` rules."""
+    if filter_ == "":
+        return False  # [MQTT-4.7.3-1]
+    group, inner = parse_share(filter_)
+    if filter_.startswith(SHARE_PREFIX + "/"):
+        if not shared_allowed:
+            return False
+        # group must be non-empty and wildcard-free [MQTT-4.8.2-1/2]
+        if group == "" or "+" in group or "#" in group:
+            return False
+        if inner == "":
+            return False
+        filter_ = inner
+    levels = split_levels(filter_)
+    for i, level in enumerate(levels):
+        if "#" in level:
+            if not wildcards_allowed:
+                return False
+            # '#' must be alone in its level and the last level [MQTT-4.7.1-2]
+            if level != "#" or i != len(levels) - 1:
+                return False
+        elif "+" in level:
+            if not wildcards_allowed:
+                return False
+            if level != "+":  # '+' must occupy an entire level [MQTT-4.7.1-3]
+                return False
+    return True
+
+
+def valid_topic_name(topic: str) -> bool:
+    """Publish topic names: non-empty, no wildcards [MQTT-3.3.2-2]."""
+    return topic != "" and "+" not in topic and "#" not in topic
+
+
+def is_dollar(topic: str) -> bool:
+    """Topics beginning with '$' are excluded from root-level wildcard
+    matching [MQTT-4.7.2-1]."""
+    return topic.startswith("$")

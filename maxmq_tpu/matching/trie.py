@@ -1,0 +1,351 @@
+"""CPU reference topic matcher: a subscription trie with full MQTT wildcard
+semantics. This is both the low-latency fallback matcher and the semantic
+oracle the TPU NFA is parity-tested against.
+
+Parity surface: vendor/github.com/mochi-co/mqtt/v2/topics.go in the reference
+(TopicsIndex / particle / Subscribers / scanMessages / topic aliases).
+Re-designed: recursion is over an explicit node stack, retained messages live
+in the same trie, shared-group selection uses a round-robin cursor.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from ..protocol.packets import Packet, Subscription
+from .topics import is_dollar, parse_share, split_levels
+
+
+def merge_subscription(base: Subscription | None, new: Subscription,
+                       filter_: str) -> Subscription:
+    """Merge overlapping matching filters for one client: max QoS wins, v5
+    subscription identifiers union (keyed by filter), flags from the newer.
+
+    Parity: packets.go:250-270 (Subscription.Merge) in the reference.
+    """
+    merged = Subscription(
+        filter=new.filter, qos=new.qos, no_local=new.no_local,
+        retain_as_published=new.retain_as_published,
+        retain_handling=new.retain_handling, identifier=new.identifier,
+        identifiers=dict(new.identifiers))
+    if new.identifier:
+        merged.identifiers[filter_] = new.identifier
+    if base is not None:
+        merged.identifiers.update(base.identifiers)
+        if base.qos > merged.qos:
+            merged.qos = base.qos
+        if base.no_local:
+            merged.no_local = True
+    return merged
+
+
+@dataclass
+class SubscriberSet:
+    """Result of a topic match: per-client merged non-shared subscriptions and
+    shared-group candidate maps (group -> client -> subscription)."""
+
+    subscriptions: dict[str, Subscription] = field(default_factory=dict)
+    # (group, filter) -> client -> subscription: each pair delivers to exactly
+    # one of its members [MQTT-4.8.2-4].
+    shared: dict[tuple[str, str], dict[str, Subscription]] = field(
+        default_factory=dict)
+
+    def add(self, client_id: str, sub: Subscription, filter_: str) -> None:
+        self.subscriptions[client_id] = merge_subscription(
+            self.subscriptions.get(client_id), sub, filter_)
+
+    def add_shared(self, group: str, filter_: str, client_id: str,
+                   sub: Subscription) -> None:
+        self.shared.setdefault((group, filter_), {})[client_id] = sub
+
+    def __len__(self) -> int:
+        return len(self.subscriptions) + sum(len(g) for g in self.shared.values())
+
+
+class _Node:
+    __slots__ = ("children", "subscriptions", "shared", "retained")
+
+    def __init__(self) -> None:
+        self.children: dict[str, _Node] = {}
+        self.subscriptions: dict[str, Subscription] = {}
+        self.shared: dict[str, dict[str, Subscription]] = {}
+        self.retained: Packet | None = None
+
+    def empty(self) -> bool:
+        return (not self.children and not self.subscriptions
+                and not self.shared and self.retained is None)
+
+
+class TopicIndex:
+    """Thread-safe subscription + retained-message trie."""
+
+    def __init__(self) -> None:
+        self._root = _Node()
+        self._lock = threading.RLock()
+        self._share_cursor: dict[tuple[str, str], int] = {}
+        self.subscription_count = 0
+        self.retained_count = 0
+        # bumped on every mutation; lets the NFA engine detect staleness
+        self.version = 0
+
+    # ------------------------------------------------------------------
+    # Mutation
+    # ------------------------------------------------------------------
+
+    def subscribe(self, client_id: str, sub: Subscription) -> bool:
+        """Install a subscription; returns True when it is brand new (False
+        when it replaced an existing subscription of the same client+filter)."""
+        group, inner = parse_share(sub.filter)
+        levels = split_levels(inner if group else sub.filter)
+        with self._lock:
+            node = self._root
+            for level in levels:
+                node = node.children.setdefault(level, _Node())
+            if group:
+                holders = node.shared.setdefault(group, {})
+                is_new = client_id not in holders
+                holders[client_id] = sub
+            else:
+                is_new = client_id not in node.subscriptions
+                node.subscriptions[client_id] = sub
+            if is_new:
+                self.subscription_count += 1
+            self.version += 1
+            return is_new
+
+    def unsubscribe(self, client_id: str, filter_: str) -> bool:
+        group, inner = parse_share(filter_)
+        levels = split_levels(inner if group else filter_)
+        with self._lock:
+            path: list[tuple[_Node, str]] = []
+            node = self._root
+            for level in levels:
+                child = node.children.get(level)
+                if child is None:
+                    return False
+                path.append((node, level))
+                node = child
+            if group:
+                holders = node.shared.get(group)
+                if not holders or client_id not in holders:
+                    return False
+                del holders[client_id]
+                if not holders:
+                    del node.shared[group]
+            else:
+                if client_id not in node.subscriptions:
+                    return False
+                del node.subscriptions[client_id]
+            self.subscription_count -= 1
+            self._trim(path, node)
+            self.version += 1
+            return True
+
+    def _trim(self, path: list[tuple[_Node, str]], node: _Node) -> None:
+        for parent, level in reversed(path):
+            if node.empty():
+                del parent.children[level]
+                node = parent
+            else:
+                return
+
+    # ------------------------------------------------------------------
+    # Matching
+    # ------------------------------------------------------------------
+
+    def subscribers(self, topic: str) -> SubscriberSet:
+        """All subscriptions matching a published topic name.
+
+        Per level the walk tries the literal child, '+', and '#'; a '#' child
+        also matches the parent level itself (spec 4.7.1.2), and topics whose
+        first level begins with '$' never match root-level wildcards
+        [MQTT-4.7.2-1].
+        """
+        levels = split_levels(topic)
+        out = SubscriberSet()
+        dollar = is_dollar(topic)
+        with self._lock:
+            # stack of (node, depth): node's path matches levels[:depth]
+            stack: list[tuple[_Node, int]] = [(self._root, 0)]
+            while stack:
+                node, depth = stack.pop()
+                wildcard_ok = not (dollar and depth == 0)
+                if wildcard_ok:
+                    hash_child = node.children.get("#")
+                    if hash_child is not None:
+                        self._collect(out, hash_child, "#-terminated")
+                if depth == len(levels):
+                    self._collect(out, node, "exact")
+                    continue
+                lit = node.children.get(levels[depth])
+                if lit is not None:
+                    stack.append((lit, depth + 1))
+                if wildcard_ok:
+                    plus = node.children.get("+")
+                    if plus is not None:
+                        stack.append((plus, depth + 1))
+        return out
+
+    def _collect(self, out: SubscriberSet, node: _Node, _why: str) -> None:
+        for client_id, sub in node.subscriptions.items():
+            out.add(client_id, sub, sub.filter)
+        for group, holders in node.shared.items():
+            for client_id, sub in holders.items():
+                out.add_shared(group, sub.filter, client_id, sub)
+
+    def select_shared(self, group: str, filter_: str,
+                      candidates: dict[str, Subscription],
+                      alive=None) -> tuple[str, Subscription] | None:
+        """Pick one receiver for a `$share` (group, filter) pair: round-robin
+        over the sorted candidate set, skipping clients rejected by the
+        ``alive`` predicate.
+
+        The reference picks effectively-arbitrarily (map iteration order,
+        topics.go:255-270); round-robin gives fairer load spreading.
+        """
+        if not candidates:
+            return None
+        ordered = sorted(candidates)
+        key = (group, filter_)
+        with self._lock:
+            cur = self._share_cursor.get(key, -1)
+            for i in range(1, len(ordered) + 1):
+                idx = (cur + i) % len(ordered)
+                cid = ordered[idx]
+                if alive is None or alive(cid):
+                    self._share_cursor[key] = idx
+                    return cid, candidates[cid]
+        return None
+
+    # ------------------------------------------------------------------
+    # Retained messages
+    # ------------------------------------------------------------------
+
+    def retain(self, packet: Packet) -> int:
+        """Store/replace/clear the retained message for packet.topic.
+        Returns +1 stored-new, 0 replaced, -1 cleared (empty payload)."""
+        levels = split_levels(packet.topic)
+        with self._lock:
+            if not packet.payload:
+                # clearing walk; avoid creating nodes
+                path: list[tuple[_Node, str]] = []
+                node = self._root
+                for level in levels:
+                    child = node.children.get(level)
+                    if child is None:
+                        return 0
+                    path.append((node, level))
+                    node = child
+                if node.retained is None:
+                    return 0
+                node.retained = None
+                self.retained_count -= 1
+                self._trim(path, node)
+                self.version += 1
+                return -1
+            node = self._root
+            for level in levels:
+                node = node.children.setdefault(level, _Node())
+            existed = node.retained is not None
+            node.retained = packet
+            if not existed:
+                self.retained_count += 1
+            self.version += 1
+            return 0 if existed else 1
+
+    def retained_for(self, filter_: str) -> list[Packet]:
+        """Retained messages matching a subscription filter (wildcard-aware;
+        '#'/'+' at the first level skip '$' topics [MQTT-4.7.2-1])."""
+        levels = split_levels(filter_)
+        out: list[Packet] = []
+        with self._lock:
+            self._scan_retained(self._root, levels, 0, out)
+        out.sort(key=lambda p: p.created)
+        return out
+
+    def _scan_retained(self, node: _Node, levels: list[str], depth: int,
+                       out: list[Packet]) -> None:
+        if depth == len(levels):
+            if node.retained is not None:
+                out.append(node.retained)
+            return
+        level = levels[depth]
+        if level == "#":
+            # matches the parent level itself and every descendant
+            stack = [(node, depth == 0)]
+            while stack:
+                n, top = stack.pop()
+                if n.retained is not None:
+                    out.append(n.retained)
+                for name, child in n.children.items():
+                    if top and name.startswith("$"):
+                        continue
+                    stack.append((child, False))
+            return
+        if level == "+":
+            for name, child in node.children.items():
+                if depth == 0 and name.startswith("$"):
+                    continue
+                self._scan_retained(child, levels, depth + 1, out)
+            return
+        child = node.children.get(level)
+        if child is not None:
+            self._scan_retained(child, levels, depth + 1, out)
+
+    # ------------------------------------------------------------------
+    # Introspection (NFA compiler input, $SYS counters)
+    # ------------------------------------------------------------------
+
+    def all_subscriptions(self):
+        """Yield (filter, client_id, subscription, group) for every entry.
+        ``group`` is '' for non-shared. Used by the NFA compiler."""
+        with self._lock:
+            stack: list[tuple[_Node, list[str]]] = [(self._root, [])]
+            while stack:
+                node, path = stack.pop()
+                filt = "/".join(path)
+                for client_id, sub in node.subscriptions.items():
+                    yield filt, client_id, sub, ""
+                for group, holders in node.shared.items():
+                    for client_id, sub in holders.items():
+                        yield filt, client_id, sub, group
+                for name, child in node.children.items():
+                    stack.append((child, path + [name]))
+
+
+class TopicAliases:
+    """Per-client inbound/outbound v5 topic alias maps.
+
+    Parity: topics.go:21-105 in the reference.
+    """
+
+    def __init__(self, maximum: int) -> None:
+        self.maximum = maximum
+        self.inbound: dict[int, str] = {}
+        self.outbound: dict[str, int] = {}
+        self._next_out = 0
+
+    def resolve_inbound(self, topic: str, alias: int | None) -> str | None:
+        """Apply/learn an inbound alias; None means the alias is invalid."""
+        if alias is None:
+            return topic
+        if alias == 0 or alias > self.maximum:
+            return None
+        if topic:
+            self.inbound[alias] = topic
+            return topic
+        return self.inbound.get(alias)
+
+    def assign_outbound(self, topic: str) -> tuple[int, bool]:
+        """Return (alias, first_use). alias 0 = no alias available."""
+        if self.maximum <= 0:
+            return 0, False
+        existing = self.outbound.get(topic)
+        if existing is not None:
+            return existing, False
+        if self._next_out >= self.maximum:
+            return 0, False
+        self._next_out += 1
+        self.outbound[topic] = self._next_out
+        return self._next_out, True

@@ -60,6 +60,8 @@ class Subscription:
 
     @classmethod
     def from_options_byte(cls, filter_: str, b: int, v5: bool) -> "Subscription":
+        if (b & 0x3) == 3:
+            raise MalformedPacketError("subscription qos 3 is malformed")  # [MQTT-3.8.3-4]
         if v5:
             if b & 0xC0:
                 raise MalformedPacketError("subscription options reserved bits set")
@@ -311,6 +313,10 @@ class Packet:
                     if len(body) > 1:
                         p.properties, _ = Properties.decode(body, 1, PT.DISCONNECT)
             elif t == PT.AUTH:
+                if not p.v5:
+                    # type 15 is reserved before MQTT 5 [MQTT-2.2.1]
+                    raise ProtocolError(codes.ErrProtocolViolation,
+                                        "AUTH packet on pre-v5 connection")
                 if body:
                     p.reason_code = body[0]
                     if len(body) > 1:
@@ -345,6 +351,10 @@ class Packet:
                                 "will qos/retain without will flag")
         if will_qos > 2:
             raise ProtocolError(codes.ErrProtocolViolation, "will qos 3")
+        if self.password_flag and not self.username_flag and not self.v5:
+            # [MQTT-3.1.2-22]; v5 lifts this restriction.
+            raise ProtocolError(codes.ErrProtocolViolation,
+                                "password flag without username flag")
         self.keepalive, off = read_uint16(body, off)
         if self.v5:
             self.properties, off = Properties.decode(body, off, PT.CONNECT)
@@ -417,6 +427,9 @@ class Packet:
 
     def validate_publish(self) -> None:
         if not self.topic:
+            # a v5 publish may carry only a topic alias [MQTT-3.3.2-6]
+            if self.v5 and self.properties.topic_alias:
+                return
             raise ProtocolError(codes.ErrTopicNameInvalid, "empty topic")
         if "+" in self.topic or "#" in self.topic:
             raise ProtocolError(codes.ErrTopicNameInvalid,

@@ -1,0 +1,286 @@
+"""Semantic tests for the CPU reference matcher: wildcard matching per MQTT
+spec 4.7, `$share` handling, retained scans, merge rules, aliases."""
+
+import pytest
+
+from maxmq_tpu.matching import (
+    TopicAliases,
+    TopicIndex,
+    parse_share,
+    valid_filter,
+    valid_topic_name,
+)
+from maxmq_tpu.protocol import FixedHeader, Packet, PacketType as PT, Subscription
+
+
+def sub(index, client, filt, qos=0, ident=0):
+    return index.subscribe(client, Subscription(filter=filt, qos=qos,
+                                                identifier=ident))
+
+
+def match_clients(index, topic):
+    return sorted(index.subscribers(topic).subscriptions)
+
+
+# ---------------------------------------------------------------------------
+# Filter validation
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("filt,ok", [
+    ("a/b/c", True), ("#", True), ("+", True), ("a/+/c", True), ("a/#", True),
+    ("/", True), ("a//b", True), ("+/+/+", True), ("/finance", True),
+    ("", False), ("a/#/b", False), ("a#", False), ("#/a", False),
+    ("a/b+", False), ("+a", False), ("a+/b", False),
+    ("$share/g/t/#", True), ("$share/g/+", True),
+    ("$share//t", False), ("$share/g", False), ("$share/g/", False),
+    ("$share/g+/t", False), ("$share/g#/t", False),
+])
+def test_valid_filter(filt, ok):
+    assert valid_filter(filt) == ok
+
+
+def test_valid_filter_feature_gates():
+    assert not valid_filter("$share/g/t", shared_allowed=False)
+    assert not valid_filter("a/+", wildcards_allowed=False)
+    assert valid_filter("a/b", shared_allowed=False, wildcards_allowed=False)
+
+
+def test_valid_topic_name():
+    assert valid_topic_name("a/b")
+    assert not valid_topic_name("")
+    assert not valid_topic_name("a/+")
+    assert not valid_topic_name("a/#")
+
+
+def test_parse_share():
+    assert parse_share("$share/g/a/b") == ("g", "a/b")
+    assert parse_share("a/b") == ("", "a/b")
+    assert parse_share("$share/g") == ("g", "")
+
+
+# ---------------------------------------------------------------------------
+# Wildcard matching semantics
+# ---------------------------------------------------------------------------
+
+def test_exact_match():
+    idx = TopicIndex()
+    sub(idx, "c1", "a/b/c")
+    sub(idx, "c2", "a/b")
+    assert match_clients(idx, "a/b/c") == ["c1"]
+    assert match_clients(idx, "a/b") == ["c2"]
+    assert match_clients(idx, "a") == []
+    assert match_clients(idx, "a/b/c/d") == []
+
+
+def test_plus_wildcard():
+    idx = TopicIndex()
+    sub(idx, "c1", "sport/+/player1")
+    sub(idx, "c2", "sport/+")
+    sub(idx, "c3", "+")
+    assert match_clients(idx, "sport/tennis/player1") == ["c1"]
+    assert match_clients(idx, "sport/tennis") == ["c2"]
+    assert match_clients(idx, "sport") == ["c3"]
+    # '+' matches empty levels too: 'sport/' is ['sport','']
+    assert match_clients(idx, "sport/") == ["c2"]
+
+
+def test_hash_wildcard_matches_parent():
+    # spec 4.7.1.2: "sport/tennis/player1/#" matches the parent itself
+    idx = TopicIndex()
+    sub(idx, "c1", "sport/tennis/player1/#")
+    assert match_clients(idx, "sport/tennis/player1") == ["c1"]
+    assert match_clients(idx, "sport/tennis/player1/ranking") == ["c1"]
+    assert match_clients(idx, "sport/tennis/player1/score/wimbledon") == ["c1"]
+    assert match_clients(idx, "sport/tennis/player2") == []
+
+
+def test_root_hash_matches_all_but_dollar():
+    idx = TopicIndex()
+    sub(idx, "c1", "#")
+    assert match_clients(idx, "a") == ["c1"]
+    assert match_clients(idx, "a/b/c") == ["c1"]
+    assert match_clients(idx, "/") == ["c1"]
+    # [MQTT-4.7.2-1]: no match on $-topics
+    assert match_clients(idx, "$SYS/broker/load") == []
+
+
+def test_root_plus_excludes_dollar():
+    idx = TopicIndex()
+    sub(idx, "c1", "+/monitor/Clients")
+    sub(idx, "c2", "$SYS/monitor/+")
+    assert match_clients(idx, "$SYS/monitor/Clients") == ["c2"]
+    sub(idx, "c3", "$SYS/#")
+    assert match_clients(idx, "$SYS/monitor/Clients") == ["c2", "c3"]
+
+
+def test_empty_level_handling():
+    idx = TopicIndex()
+    sub(idx, "c1", "/finance")
+    assert match_clients(idx, "/finance") == ["c1"]
+    sub(idx, "c2", "+/+")
+    sub(idx, "c3", "/+")
+    assert sorted(match_clients(idx, "/finance")) == ["c1", "c2", "c3"]
+
+
+def test_overlapping_filters_merge_max_qos_and_ids():
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="a/+", qos=0, identifier=7))
+    idx.subscribe("c1", Subscription(filter="a/b", qos=2, identifier=9))
+    result = idx.subscribers("a/b")
+    assert len(result.subscriptions) == 1
+    merged = result.subscriptions["c1"]
+    assert merged.qos == 2
+    assert merged.identifiers in ({"a/+": 7, "a/b": 9},)
+
+
+def test_unsubscribe_and_trim():
+    idx = TopicIndex()
+    sub(idx, "c1", "a/b/c")
+    assert idx.subscription_count == 1
+    assert idx.unsubscribe("c1", "a/b/c") is True
+    assert idx.unsubscribe("c1", "a/b/c") is False
+    assert match_clients(idx, "a/b/c") == []
+    assert idx.subscription_count == 0
+    # trie fully trimmed
+    assert not idx._root.children
+
+
+def test_resubscribe_not_new():
+    idx = TopicIndex()
+    assert sub(idx, "c1", "a/b") is True
+    assert sub(idx, "c1", "a/b", qos=1) is False
+    assert idx.subscription_count == 1
+    assert idx.subscribers("a/b").subscriptions["c1"].qos == 1
+
+
+# ---------------------------------------------------------------------------
+# Shared subscriptions
+# ---------------------------------------------------------------------------
+
+def test_shared_subscription_grouping():
+    idx = TopicIndex()
+    sub(idx, "c1", "$share/g1/t/+")
+    sub(idx, "c2", "$share/g1/t/+")
+    sub(idx, "c3", "$share/g2/t/a")
+    sub(idx, "c4", "t/a")
+    res = idx.subscribers("t/a")
+    assert sorted(res.subscriptions) == ["c4"]
+    assert ("g1", "$share/g1/t/+") in res.shared
+    assert sorted(res.shared[("g1", "$share/g1/t/+")]) == ["c1", "c2"]
+    assert sorted(res.shared[("g2", "$share/g2/t/a")]) == ["c3"]
+
+
+def test_shared_round_robin_selection():
+    idx = TopicIndex()
+    sub(idx, "c1", "$share/g/t")
+    sub(idx, "c2", "$share/g/t")
+    sub(idx, "c3", "$share/g/t")
+    res = idx.subscribers("t")
+    cands = res.shared[("g", "$share/g/t")]
+    picks = [idx.select_shared("g", "$share/g/t", cands)[0] for _ in range(6)]
+    assert picks == ["c1", "c2", "c3", "c1", "c2", "c3"]
+
+
+def test_shared_selection_skips_dead():
+    idx = TopicIndex()
+    sub(idx, "c1", "$share/g/t")
+    sub(idx, "c2", "$share/g/t")
+    cands = idx.subscribers("t").shared[("g", "$share/g/t")]
+    pick = idx.select_shared("g", "$share/g/t", cands,
+                             alive=lambda c: c == "c2")
+    assert pick[0] == "c2"
+    assert idx.select_shared("g", "$share/g/t", cands,
+                             alive=lambda c: False) is None
+
+
+def test_shared_unsubscribe():
+    idx = TopicIndex()
+    sub(idx, "c1", "$share/g/t")
+    assert idx.unsubscribe("c1", "$share/g/t") is True
+    assert idx.subscribers("t").shared == {}
+    assert not idx._root.children
+
+
+# ---------------------------------------------------------------------------
+# Retained messages
+# ---------------------------------------------------------------------------
+
+def ret(topic, payload=b"x", created=0.0):
+    return Packet(fixed=FixedHeader(type=PT.PUBLISH, retain=True), topic=topic,
+                  payload=payload, created=created)
+
+
+def test_retain_add_replace_clear():
+    idx = TopicIndex()
+    assert idx.retain(ret("a/b")) == 1
+    assert idx.retained_count == 1
+    assert idx.retain(ret("a/b", b"y")) == 0
+    assert idx.retained_count == 1
+    assert idx.retain(ret("a/b", b"")) == -1
+    assert idx.retained_count == 0
+    assert idx.retain(ret("nope", b"")) == 0  # clearing nothing
+    assert not idx._root.children
+
+
+def test_retained_scan_wildcards():
+    idx = TopicIndex()
+    idx.retain(ret("a/b", created=1))
+    idx.retain(ret("a/c", created=2))
+    idx.retain(ret("a/b/c", created=3))
+    idx.retain(ret("x", created=4))
+    assert [p.topic for p in idx.retained_for("a/b")] == ["a/b"]
+    assert sorted(p.topic for p in idx.retained_for("a/+")) == ["a/b", "a/c"]
+    assert [p.topic for p in idx.retained_for("a/#")] == ["a/b", "a/c", "a/b/c"]
+    assert len(idx.retained_for("#")) == 4
+    assert idx.retained_for("zzz") == []
+
+
+def test_retained_hash_matches_parent_level():
+    idx = TopicIndex()
+    idx.retain(ret("a"))
+    idx.retain(ret("a/b"))
+    got = sorted(p.topic for p in idx.retained_for("a/#"))
+    assert got == ["a", "a/b"]
+
+
+def test_retained_scan_excludes_dollar_for_wildcards():
+    idx = TopicIndex()
+    idx.retain(ret("$SYS/uptime"))
+    idx.retain(ret("normal"))
+    assert [p.topic for p in idx.retained_for("#")] == ["normal"]
+    assert [p.topic for p in idx.retained_for("+")] == ["normal"]
+    assert [p.topic for p in idx.retained_for("$SYS/uptime")] == ["$SYS/uptime"]
+    assert [p.topic for p in idx.retained_for("$SYS/#")] == ["$SYS/uptime"]
+
+
+def test_all_subscriptions_enumeration():
+    idx = TopicIndex()
+    sub(idx, "c1", "a/b", qos=1)
+    sub(idx, "c2", "$share/g/x")
+    entries = sorted(idx.all_subscriptions())
+    assert ("a/b", "c1") == entries[0][:2]
+    shared = [e for e in entries if e[3] == "g"]
+    assert len(shared) == 1 and shared[0][1] == "c2"
+
+
+# ---------------------------------------------------------------------------
+# Topic aliases
+# ---------------------------------------------------------------------------
+
+def test_inbound_alias_learning():
+    al = TopicAliases(maximum=5)
+    assert al.resolve_inbound("t/1", 3) == "t/1"     # learn
+    assert al.resolve_inbound("", 3) == "t/1"        # use
+    assert al.resolve_inbound("", 4) is None         # unknown alias
+    assert al.resolve_inbound("x", 0) is None        # alias 0 invalid
+    assert al.resolve_inbound("x", 9) is None        # over maximum
+    assert al.resolve_inbound("plain", None) == "plain"
+
+
+def test_outbound_alias_assignment():
+    al = TopicAliases(maximum=2)
+    assert al.assign_outbound("t/1") == (1, True)
+    assert al.assign_outbound("t/1") == (1, False)
+    assert al.assign_outbound("t/2") == (2, True)
+    assert al.assign_outbound("t/3") == (0, False)  # exhausted
+    assert TopicAliases(0).assign_outbound("t") == (0, False)
