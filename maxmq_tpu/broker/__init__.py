@@ -1,0 +1,14 @@
+"""Host-side broker runtime: server engine, sessions, listeners, QoS flows."""
+
+from .client import Client, ClientRegistry, PacketIDExhausted
+from .inflight import Inflight
+from .listeners import (Listener, Listeners, MockListener, TCPListener,
+                        UnixListener, WSListener)
+from .server import Broker, BrokerOptions, Capabilities
+from .sys_info import SysInfo
+
+__all__ = [
+    "Client", "ClientRegistry", "PacketIDExhausted", "Inflight",
+    "Listener", "Listeners", "MockListener", "TCPListener", "UnixListener",
+    "WSListener", "Broker", "BrokerOptions", "Capabilities", "SysInfo",
+]

@@ -1,0 +1,282 @@
+"""Per-connection client session state and transport loops.
+
+Parity surface: vendor/github.com/mochi-co/mqtt/v2/clients.go (Client,
+ClientState, read/write loops, packet-id allocation, inflight resend).
+Re-designed around asyncio: one reader task + one writer task per client,
+outbound delivery through a bounded asyncio queue.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+
+from ..matching.trie import TopicAliases
+from ..protocol import codes
+from ..protocol.codec import PacketType as PT
+from ..protocol.packets import Packet, ProtocolError, Subscription, Will, parse_stream
+from .inflight import Inflight
+
+
+@dataclass
+class ClientProperties:
+    protocol_version: int = 4
+    username: bytes = b""
+    clean_start: bool = False
+    will: Will | None = None
+    will_delay: int = 0
+    session_expiry: int = 0
+    session_expiry_set: bool = False
+    receive_maximum: int = 0        # client's stated receive maximum
+    topic_alias_maximum: int = 0    # client's stated inbound alias maximum
+    maximum_packet_size: int = 0
+    request_problem_info: int = 1
+
+
+class PacketIDExhausted(Exception):
+    pass
+
+
+class Client:
+    """One MQTT session (possibly outliving several network connections)."""
+
+    def __init__(self, server, reader: asyncio.StreamReader | None,
+                 writer: asyncio.StreamWriter | None, listener_id: str = "",
+                 inline: bool = False) -> None:
+        self.server = server
+        self.reader = reader
+        self.writer = writer
+        self.listener = listener_id
+        self.inline = inline
+        self.id = ""
+        self.remote = ""
+        if writer is not None:
+            peer = writer.get_extra_info("peername")
+            if peer:
+                self.remote = f"{peer[0]}:{peer[1]}" if len(peer) >= 2 else str(peer)
+
+        self.properties = ClientProperties()
+        self.subscriptions: dict[str, Subscription] = {}
+        self.inflight = Inflight()
+        # QoS2 publishes we have PUBRECed but not yet PUBRELed (dedup set)
+        self.pubrec_inbound: set[int] = set()
+        self.aliases: TopicAliases | None = None
+        self.keepalive = 0
+        self.last_received = time.monotonic()
+        self.connected_at = 0.0
+        self.disconnected_at = 0.0
+        self.taken_over = False
+        self.assigned_id = False
+        self.stop_cause: ProtocolError | None = None
+        self._stopped = asyncio.Event()
+        self._packet_id_cursor = 0
+
+        maxq = server.capabilities.maximum_client_writes_pending
+        self.outbound: asyncio.Queue[Packet | None] = asyncio.Queue(maxsize=maxq)
+        self._writer_task: asyncio.Task | None = None
+        self._reader_task: asyncio.Task | None = None
+
+    # ------------------------------------------------------------------
+
+    @property
+    def closed(self) -> bool:
+        return self._stopped.is_set()
+
+    def parse_connect(self, packet: Packet) -> None:
+        """Absorb CONNECT fields into session properties."""
+        p = self.properties
+        p.protocol_version = packet.protocol_version
+        p.clean_start = packet.clean_start
+        p.username = packet.username
+        self.id = packet.client_id
+        self.keepalive = packet.keepalive
+        pr = packet.properties
+        if packet.protocol_version >= 5:
+            p.session_expiry = pr.session_expiry or 0
+            p.session_expiry_set = pr.session_expiry is not None
+            p.receive_maximum = pr.receive_maximum or 0
+            p.topic_alias_maximum = pr.topic_alias_max or 0
+            p.maximum_packet_size = pr.maximum_packet_size or 0
+            if pr.request_problem_info is not None:
+                p.request_problem_info = pr.request_problem_info
+        caps = self.server.capabilities
+        self.inflight = Inflight(
+            receive_maximum=caps.receive_maximum,
+            send_maximum=p.receive_maximum or caps.receive_maximum)
+        self.aliases = TopicAliases(caps.topic_alias_maximum)
+        if packet.will is not None:
+            w = packet.will
+            p.will = w
+            p.will_delay = w.properties.will_delay or 0
+
+    def next_packet_id(self) -> int:
+        """Allocate an unused outbound packet id; raises when all 65535 are
+        inflight."""
+        for _ in range(65535):
+            self._packet_id_cursor = (self._packet_id_cursor % 65535) + 1
+            if self.inflight.get(self._packet_id_cursor) is None:
+                return self._packet_id_cursor
+        raise PacketIDExhausted()
+
+    # ------------------------------------------------------------------
+    # Transport
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        if self.writer is not None:
+            self._writer_task = asyncio.get_running_loop().create_task(
+                self._write_loop(), name=f"mq-write-{self.id or id(self)}")
+
+    async def read_loop(self, on_packet) -> None:
+        """Frame the inbound byte stream and dispatch packets until EOF,
+        error, or stop. ``on_packet`` is the server's receive entry point."""
+        assert self.reader is not None
+        buf = bytearray()
+        maxsize = self.server.capabilities.maximum_packet_size
+        while not self.closed:
+            try:
+                chunk = await self.reader.read(65536)
+            except (ConnectionError, asyncio.CancelledError, OSError):
+                return
+            if not chunk:
+                return
+            self.server.info.bytes_received += len(chunk)
+            self.last_received = time.monotonic()
+            buf.extend(chunk)
+            for fh, body in parse_stream(buf, maxsize):
+                self.server.info.packets_received += 1
+                packet = Packet.decode(fh, body,
+                                       self.properties.protocol_version)
+                await on_packet(self, packet)
+                if self.closed:
+                    return
+
+    async def _write_loop(self) -> None:
+        assert self.writer is not None
+        try:
+            while True:
+                packet = await self.outbound.get()
+                if packet is None:
+                    break
+                self._write_packet(packet)
+            await self._drain()
+        except (ConnectionError, asyncio.CancelledError, OSError):
+            pass
+
+    def _write_packet(self, packet: Packet) -> None:
+        packet = self.server.hooks.modify("on_packet_encode", packet, self)
+        wire = packet.encode()
+        maxsize = self.properties.maximum_packet_size
+        if maxsize and len(wire) > maxsize:
+            self.server.info.messages_dropped += 1
+            return
+        assert self.writer is not None
+        self.writer.write(wire)
+        self.server.info.bytes_sent += len(wire)
+        self.server.info.packets_sent += 1
+        if packet.type == PT.PUBLISH:
+            self.server.info.messages_sent += 1
+        self.server.hooks.notify("on_packet_sent", self, packet, len(wire))
+
+    async def _drain(self) -> None:
+        if self.writer is not None:
+            try:
+                await self.writer.drain()
+            except (ConnectionError, OSError):
+                pass
+
+    def send(self, packet: Packet) -> bool:
+        """Enqueue a packet for the writer task; False when the queue is full
+        (caller decides whether that drops a message)."""
+        if self.closed or self.writer is None:
+            return False
+        try:
+            self.outbound.put_nowait(packet)
+            return True
+        except asyncio.QueueFull:
+            return False
+
+    def send_now(self, packet: Packet) -> None:
+        """Write synchronously, bypassing the queue (CONNACK, shutdown)."""
+        if self.writer is not None:
+            self._write_packet(packet)
+
+    async def stop(self, cause: ProtocolError | None = None) -> None:
+        """Terminate the network connection (the session may persist)."""
+        if self._stopped.is_set():
+            return
+        self.stop_cause = self.stop_cause or cause
+        self._stopped.set()
+        self.disconnected_at = time.time()
+        if self._writer_task is not None:
+            try:
+                self.outbound.put_nowait(None)
+            except asyncio.QueueFull:
+                self._writer_task.cancel()
+            try:
+                await asyncio.wait_for(self._writer_task, timeout=1.0)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                self._writer_task.cancel()
+        if self.writer is not None:
+            try:
+                self.writer.close()
+            except Exception:
+                pass
+        if self._reader_task is not None and self._reader_task is not asyncio.current_task():
+            self._reader_task.cancel()
+
+    # ------------------------------------------------------------------
+
+    def resend_inflight(self, force_dup: bool = True) -> int:
+        """Queue all unacked messages again (session resume [MQTT-4.4.0-1]).
+        Returns the number of packets queued."""
+        n = 0
+        for p in self.inflight.all():
+            q = p.copy()
+            if q.type == PT.PUBLISH and force_dup:
+                q.fixed.dup = True
+            if self.send(q):
+                self.server.hooks.notify("on_qos_publish", self, q,
+                                         time.time(), 1)
+                n += 1
+        return n
+
+    def expired(self, now: float, maximum_expiry: int) -> bool:
+        """True when a disconnected session has outlived its expiry window."""
+        if self.disconnected_at == 0:
+            return False
+        if self.properties.protocol_version >= 5:
+            expiry = self.properties.session_expiry
+            if self.properties.session_expiry_set:
+                expiry = min(expiry, maximum_expiry) if maximum_expiry else expiry
+            else:
+                expiry = 0 if self.properties.clean_start else maximum_expiry
+        else:
+            expiry = 0 if self.properties.clean_start else maximum_expiry
+        return now > self.disconnected_at + expiry
+
+
+class ClientRegistry:
+    """Session registry keyed by client id."""
+
+    def __init__(self) -> None:
+        self._clients: dict[str, Client] = {}
+
+    def get(self, client_id: str) -> Client | None:
+        return self._clients.get(client_id)
+
+    def add(self, client: Client) -> None:
+        self._clients[client.id] = client
+
+    def delete(self, client_id: str) -> None:
+        self._clients.pop(client_id, None)
+
+    def __len__(self) -> int:
+        return len(self._clients)
+
+    def all(self) -> list[Client]:
+        return list(self._clients.values())
+
+    def connected(self) -> list[Client]:
+        return [c for c in self._clients.values() if not c.closed]

@@ -1,0 +1,932 @@
+"""The broker engine: connection establishment, per-packet dispatch, QoS 1/2
+state machines, publish fan-out, retained/will/session lifecycles, $SYS.
+
+Parity surface: vendor/github.com/mochi-co/mqtt/v2/server.go in the reference
+(Server, Capabilities, EstablishConnection, processPublish,
+publishToSubscribers, publishToClient, event loop). Re-designed around
+asyncio: the per-connection read loop serializes that client's packets; the
+topic matcher is pluggable so the TPU NFA engine can replace the CPU trie.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+
+from ..hooks.base import Hook, Hooks, RejectPacket
+from ..matching.topics import valid_filter, valid_topic_name
+from ..matching.trie import SubscriberSet, TopicIndex
+from ..protocol import codes
+from ..protocol.codec import FixedHeader, MalformedPacketError, PacketType as PT
+from ..protocol.packets import Packet, ProtocolError, Subscription, Will
+from .client import Client, ClientRegistry, PacketIDExhausted
+from .listeners import Listener, Listeners
+from .sys_info import SysInfo
+
+__version__ = "0.1.0"
+
+
+@dataclass
+class Capabilities:
+    """Feature flags/limits advertised to v5 clients and enforced for all.
+
+    Parity: v2/server.go:35-70 (Capabilities + defaults).
+    """
+
+    maximum_session_expiry_interval: int = 0xFFFFFFFF
+    maximum_message_expiry_interval: int = 60 * 60 * 24
+    receive_maximum: int = 1024
+    maximum_qos: int = 2
+    retain_available: bool = True
+    maximum_packet_size: int = 0  # 0 = unlimited
+    topic_alias_maximum: int = 65535
+    wildcard_sub_available: bool = True
+    sub_id_available: bool = True
+    shared_sub_available: bool = True
+    minimum_protocol_version: int = 3
+    maximum_clients: int = 0  # 0 = unlimited
+    maximum_client_writes_pending: int = 1024 * 8
+    maximum_inflight: int = 1024 * 8
+    sys_topic_interval: float = 30.0  # seconds; 0 disables
+    keepalive_grace: float = 1.5      # deadline = keepalive * grace
+
+
+@dataclass
+class BrokerOptions:
+    capabilities: Capabilities = field(default_factory=Capabilities)
+    logger: object | None = None
+    inline_client: bool = True
+
+
+class Broker:
+    """A single-process MQTT broker instance."""
+
+    def __init__(self, options: BrokerOptions | None = None) -> None:
+        self.options = options or BrokerOptions()
+        self.capabilities = self.options.capabilities
+        self.log = self.options.logger
+        self.clients = ClientRegistry()
+        self.topics = TopicIndex()
+        self.listeners = Listeners()
+        self.hooks = Hooks()
+        self.info = SysInfo(version=__version__, started=int(time.time()))
+        self.matcher = None  # optional TPU/NFA matcher engine (set via attach)
+        self._housekeeper: asyncio.Task | None = None
+        self._sys_task: asyncio.Task | None = None
+        self._will_delays: dict[str, tuple[float, Packet]] = {}
+        self._running = False
+        self.loop: asyncio.AbstractEventLoop | None = None
+
+    # ------------------------------------------------------------------
+    # Lifecycle
+    # ------------------------------------------------------------------
+
+    def add_hook(self, hook: Hook, config=None) -> Hook:
+        return self.hooks.add(hook, config)
+
+    def add_listener(self, listener: Listener) -> Listener:
+        return self.listeners.add(listener)
+
+    def attach_matcher(self, matcher) -> None:
+        """Install a pluggable matcher engine (e.g. the TPU NFA). It must
+        expose ``subscribers(topic) -> SubscriberSet``."""
+        self.matcher = matcher
+
+    async def serve(self) -> None:
+        self.loop = asyncio.get_running_loop()
+        self._running = True
+        await self._restore_from_storage()
+        await self.listeners.serve_all(self._establish)
+        self._housekeeper = self.loop.create_task(self._housekeeping_loop())
+        if self.capabilities.sys_topic_interval > 0:
+            self._sys_task = self.loop.create_task(self._sys_topic_loop())
+        self.hooks.notify("on_started")
+
+    async def close(self) -> None:
+        if not self._running:
+            return
+        self._running = False
+        for task in (self._housekeeper, self._sys_task):
+            if task is not None:
+                task.cancel()
+        self.listeners.stop_accepting_all()
+        for client in self.clients.connected():
+            self.disconnect_client(client, codes.ErrServerShuttingDown)
+            await client.stop(ProtocolError(codes.ErrServerShuttingDown))
+        await self.listeners.close_all()
+        self.hooks.notify("on_stopped")
+        self.hooks.stop_all()
+
+    # ------------------------------------------------------------------
+    # Connection establishment
+    # ------------------------------------------------------------------
+
+    async def _establish(self, listener_id: str, reader, writer) -> None:
+        client = Client(self, reader, writer, listener_id)
+        try:
+            await self._attach_client(client)
+        except (ProtocolError, MalformedPacketError, ConnectionError, OSError):
+            pass
+        finally:
+            await client.stop()
+
+    async def _attach_client(self, client: Client) -> None:
+        packet = await self._read_connect(client)
+        client.parse_connect(packet)
+        self._validate_connect(client, packet)
+
+        self.hooks.notify("on_connect", client, packet)
+        if not self.hooks.any_allow("on_connect_authenticate", client, packet):
+            self._send_connack(client, codes.ErrBadUsernameOrPassword, False)
+            raise ProtocolError(codes.ErrBadUsernameOrPassword)
+
+        if packet.will is not None:
+            client.properties.will = self.hooks.modify(
+                "on_will", packet.will, client)
+
+        self.hooks.notify("on_session_establish", client, packet)
+        session_present = self._inherit_session(client)
+        self._will_delays.pop(client.id, None)  # reconnect cancels delayed will
+        self.clients.add(client)
+        client.connected_at = time.time()
+        self.info.clients_connected += 1
+        self.info.clients_maximum = max(self.info.clients_maximum,
+                                        self.info.clients_connected)
+        self.info.clients_total += 1
+        client.start()
+        self._send_connack(client, codes.Success, session_present)
+        if session_present:
+            client.resend_inflight()
+        self.hooks.notify("on_session_established", client, packet)
+
+        err: ProtocolError | None = None
+        try:
+            await client.read_loop(self._receive_packet)
+        except ProtocolError as e:
+            err = e
+        except MalformedPacketError:
+            err = ProtocolError(codes.ErrMalformedPacket)
+        finally:
+            await self._detach_client(client, err)
+
+    async def _read_connect(self, client: Client) -> Packet:
+        """The first inbound packet must be CONNECT [MQTT-3.1.0-1]."""
+        from ..protocol.packets import parse_stream
+
+        assert client.reader is not None
+        buf = bytearray()
+        deadline = time.monotonic() + 5.0
+        while True:
+            for fh, body in parse_stream(
+                    buf, self.capabilities.maximum_packet_size):
+                if fh.type != PT.CONNECT:
+                    raise ProtocolError(codes.ErrProtocolViolation,
+                                        "first packet was not CONNECT")
+                return Packet.decode(fh, body)
+            timeout = deadline - time.monotonic()
+            if timeout <= 0:
+                raise ProtocolError(codes.ErrKeepAliveTimeout)
+            try:
+                chunk = await asyncio.wait_for(client.reader.read(65536),
+                                               timeout)
+            except asyncio.TimeoutError:
+                raise ProtocolError(codes.ErrKeepAliveTimeout) from None
+            if not chunk:
+                raise ConnectionError("eof before CONNECT")
+            self.info.bytes_received += len(chunk)
+            self.info.packets_received += 1
+            buf.extend(chunk)
+
+    def _validate_connect(self, client: Client, packet: Packet) -> None:
+        caps = self.capabilities
+        if packet.protocol_version < caps.minimum_protocol_version:
+            self._send_connack(client, codes.ErrUnsupportedProtocolVersion, False)
+            raise ProtocolError(codes.ErrUnsupportedProtocolVersion)
+        if caps.maximum_clients and len(self.clients) >= caps.maximum_clients:
+            self._send_connack(client, codes.ErrServerBusy, False)
+            raise ProtocolError(codes.ErrServerBusy)
+        if not packet.client_id:
+            if not packet.clean_start and packet.protocol_version < 5:
+                # [MQTT-3.1.3-8]: zero-byte id requires clean session pre-v5
+                self._send_connack(client, codes.ErrClientIdentifierNotValid,
+                                   False)
+                raise ProtocolError(codes.ErrClientIdentifierNotValid)
+            client.id = f"auto-{int(time.time() * 1000):x}-{id(client):x}"
+            client.assigned_id = True
+        else:
+            client.assigned_id = False
+
+    def _inherit_session(self, client: Client) -> bool:
+        """Session takeover/resume. Returns session-present for CONNACK.
+
+        Parity: v2/server.go:451-495 (inheritClientSession).
+        """
+        existing = self.clients.get(client.id)
+        if existing is None or existing is client:
+            return False
+        existing.taken_over = True
+        if not existing.closed:
+            self.disconnect_client(existing, codes.ErrSessionTakenOver)
+            task = self.loop.create_task(
+                existing.stop(ProtocolError(codes.ErrSessionTakenOver)))
+            task.add_done_callback(lambda t: t.exception())
+        if client.properties.clean_start:
+            self._purge_session(existing)
+            return False
+        client.subscriptions = dict(existing.subscriptions)
+        client.inflight = existing.inflight.clone()
+        client.inflight.maximum_send = (client.properties.receive_maximum
+                                        or self.capabilities.receive_maximum)
+        client.inflight.send_quota = client.inflight.maximum_send
+        client.inflight.maximum_receive = self.capabilities.receive_maximum
+        client.inflight.receive_quota = client.inflight.maximum_receive
+        client.pubrec_inbound = set(existing.pubrec_inbound)
+        return bool(client.subscriptions) or len(client.inflight) > 0
+
+    def _purge_session(self, client: Client) -> None:
+        for filt in list(client.subscriptions):
+            if self.topics.unsubscribe(client.id, filt):
+                self.info.subscriptions -= 1
+        client.subscriptions.clear()
+        self.clients.delete(client.id)
+
+    def _send_connack(self, client: Client, code: codes.Code,
+                      session_present: bool) -> None:
+        packet = Packet(fixed=FixedHeader(type=PT.CONNACK),
+                        protocol_version=client.properties.protocol_version,
+                        session_present=session_present,
+                        reason_code=codes.connack_for_version(
+                            code, client.properties.protocol_version))
+        if client.properties.protocol_version >= 5 and not code.is_error:
+            caps = self.capabilities
+            pr = packet.properties
+            pr.session_expiry = min(
+                client.properties.session_expiry,
+                caps.maximum_session_expiry_interval) \
+                if client.properties.session_expiry_set else None
+            pr.receive_maximum = caps.receive_maximum or None
+            if caps.maximum_qos < 2:
+                pr.maximum_qos = caps.maximum_qos
+            pr.retain_available = None if caps.retain_available else 0
+            if caps.maximum_packet_size:
+                pr.maximum_packet_size = caps.maximum_packet_size
+            pr.topic_alias_max = caps.topic_alias_maximum or None
+            pr.wildcard_sub_available = None if caps.wildcard_sub_available else 0
+            pr.sub_id_available = None if caps.sub_id_available else 0
+            pr.shared_sub_available = None if caps.shared_sub_available else 0
+            if getattr(client, "assigned_id", False):
+                pr.assigned_client_id = client.id
+        client.send_now(packet)
+
+    async def _detach_client(self, client: Client, err: ProtocolError | None) -> None:
+        """Connection teardown: will handling, registry bookkeeping, expiry."""
+        if err is not None and err.code.is_error and client.writer is not None:
+            self.disconnect_client(client, err.code)
+        await client.stop(err)
+        self.info.clients_connected -= 1
+        self.info.clients_disconnected += 1
+
+        if client.taken_over:
+            current = self.clients.get(client.id)
+            if current is not client:
+                # session continues elsewhere; suppress will per delay rules
+                self.hooks.notify("on_disconnect", client, err, False)
+                return
+        # A clean client DISCONNECT cleared the will in _process_disconnect;
+        # anything still present fires (abnormal close, or v5 reason 0x04).
+        if client.properties.will is not None:
+            self._queue_will(client)
+        if client.properties.protocol_version >= 5:
+            expire = (client.properties.session_expiry == 0
+                      if client.properties.session_expiry_set
+                      else client.properties.clean_start)
+        else:
+            expire = client.properties.clean_start
+        self.hooks.notify("on_disconnect", client, err, expire)
+        if expire:
+            self._purge_session(client)
+
+    # ------------------------------------------------------------------
+    # Packet dispatch
+    # ------------------------------------------------------------------
+
+    async def _receive_packet(self, client: Client, packet: Packet) -> None:
+        packet = self.hooks.modify("on_packet_read", packet, client)
+        err = None
+        try:
+            await self._process_packet(client, packet)
+        except ProtocolError as e:
+            err = e
+            raise
+        finally:
+            self.hooks.notify("on_packet_processed", client, packet, err)
+
+    async def _process_packet(self, client: Client, packet: Packet) -> None:
+        t = packet.type
+        if t == PT.PUBLISH:
+            await self.process_publish(client, packet)
+        elif t == PT.PUBACK:
+            self._process_puback(client, packet)
+        elif t == PT.PUBREC:
+            self._process_pubrec(client, packet)
+        elif t == PT.PUBREL:
+            self._process_pubrel(client, packet)
+        elif t == PT.PUBCOMP:
+            self._process_pubcomp(client, packet)
+        elif t == PT.SUBSCRIBE:
+            self._process_subscribe(client, packet)
+        elif t == PT.UNSUBSCRIBE:
+            self._process_unsubscribe(client, packet)
+        elif t == PT.PINGREQ:
+            client.send(Packet(fixed=FixedHeader(type=PT.PINGRESP),
+                               protocol_version=client.properties.protocol_version))
+        elif t == PT.DISCONNECT:
+            self._process_disconnect(client, packet)
+        elif t == PT.AUTH:
+            self.hooks.modify("on_auth_packet", packet, client)
+        elif t == PT.CONNECT:
+            raise ProtocolError(codes.ErrProtocolViolation,
+                                "second CONNECT on live connection")
+        else:
+            raise ProtocolError(codes.ErrProtocolViolation,
+                                f"unexpected packet type {t}")
+
+    def _process_disconnect(self, client: Client, packet: Packet) -> None:
+        if (packet.protocol_version >= 5
+                and packet.properties.session_expiry is not None):
+            if (not client.properties.session_expiry_set
+                    and packet.properties.session_expiry > 0):
+                # [MQTT-3.1.2-23]: can't resurrect expiry after connecting with 0
+                raise ProtocolError(codes.ErrProtocolViolation,
+                                    "session expiry raised at disconnect")
+            client.properties.session_expiry = packet.properties.session_expiry
+            client.properties.session_expiry_set = True
+        if packet.reason_code == codes.DisconnectWithWill.value:
+            pass  # keep the will: abnormal-close path will fire it
+        else:
+            client.properties.will = None  # normal disconnect discards will
+        raise ProtocolError(codes.Success)  # terminates read loop cleanly
+
+    # ------------------------------------------------------------------
+    # PUBLISH inbound
+    # ------------------------------------------------------------------
+
+    async def process_publish(self, client: Client, packet: Packet) -> None:
+        """Parity: v2/server.go:674-754 (processPublish)."""
+        packet.validate_publish()
+        packet.protocol_version = client.properties.protocol_version
+        packet.origin = client.id
+        packet.created = time.time()
+
+        # inbound topic alias resolution (v5)
+        if client.properties.protocol_version >= 5 and client.aliases is not None:
+            alias = packet.properties.topic_alias
+            if alias is not None:
+                resolved = client.aliases.resolve_inbound(packet.topic, alias)
+                if resolved is None:
+                    raise ProtocolError(codes.ErrTopicAliasInvalid)
+                packet.topic = resolved
+                packet.properties.topic_alias = None
+        if packet.topic.startswith("$") and not client.inline:
+            return  # clients may not publish into reserved $ topics
+        if not self.hooks.any_allow("on_acl_check", client, packet.topic, True):
+            # [MQTT-3.3.5-2]: ack but do not deliver
+            self._ack_publish(client, packet, success=False)
+            return
+        if packet.fixed.qos > self.capabilities.maximum_qos:
+            raise ProtocolError(codes.ErrQosNotSupported)
+        if packet.fixed.retain and not self.capabilities.retain_available:
+            raise ProtocolError(codes.ErrRetainNotSupported)
+
+        # QoS2 dedup: a repeated packet id re-acks without re-delivery
+        if packet.fixed.qos == 2 and packet.packet_id in client.pubrec_inbound:
+            client.send(Packet(fixed=FixedHeader(type=PT.PUBREC),
+                               protocol_version=packet.protocol_version,
+                               packet_id=packet.packet_id))
+            return
+        if packet.fixed.qos > 0 and not client.inflight.take_receive_quota():
+            raise ProtocolError(codes.ErrReceiveMaximumExceeded)
+
+        try:
+            packet = self.hooks.modify("on_publish", packet, client)
+        except RejectPacket as r:
+            self._ack_publish(client, packet, success=r.ack_success)
+            return
+
+        self.info.messages_received += 1
+        if packet.fixed.retain:
+            self.retain_message(client, packet)
+        self._ack_publish(client, packet, success=True)
+        await self.publish_to_subscribers(packet)
+        self.hooks.notify("on_published", client, packet)
+
+    def _ack_publish(self, client: Client, packet: Packet, success: bool) -> None:
+        qos = packet.fixed.qos
+        if qos == 0 or client.inline:
+            if qos > 0:
+                client.inflight.return_receive_quota()
+            return
+        reason = 0 if success else codes.ErrNotAuthorized.value
+        if qos == 1:
+            client.inflight.return_receive_quota()
+            client.send(Packet(fixed=FixedHeader(type=PT.PUBACK),
+                               protocol_version=packet.protocol_version,
+                               packet_id=packet.packet_id,
+                               reason_code=reason))
+        elif qos == 2:
+            if success:
+                client.pubrec_inbound.add(packet.packet_id)
+            else:
+                client.inflight.return_receive_quota()
+            client.send(Packet(fixed=FixedHeader(type=PT.PUBREC),
+                               protocol_version=packet.protocol_version,
+                               packet_id=packet.packet_id,
+                               reason_code=reason))
+
+    def retain_message(self, client: Client, packet: Packet) -> None:
+        stored = self.topics.retain(packet.copy())
+        self.info.retained += stored
+        self.hooks.notify("on_retain_message", client, packet, stored)
+
+    # ------------------------------------------------------------------
+    # PUBLISH fan-out — the hot loop the TPU matcher accelerates
+    # ------------------------------------------------------------------
+
+    async def publish_to_subscribers(self, packet: Packet) -> None:
+        """Parity: v2/server.go:766-868. Matching goes through the pluggable
+        matcher (TPU NFA) when attached, else the CPU trie; hooks may then
+        override via on_select_subscribers, mirroring the reference."""
+        if self.matcher is not None:
+            subscribers = await self._match_async(packet.topic)
+        else:
+            subscribers = self.topics.subscribers(packet.topic)
+        subscribers = self.hooks.modify("on_select_subscribers", subscribers,
+                                        packet)
+
+        # $share: pick one member per (group, filter), merging per client
+        selected: dict[str, Subscription] = {}
+        for (group, filt), candidates in subscribers.shared.items():
+            pick = self.topics.select_shared(
+                group, filt, candidates,
+                alive=lambda cid: (c := self.clients.get(cid)) is not None
+                and not c.closed)
+            if pick is not None:
+                cid, sub = pick
+                prev = selected.get(cid)
+                if prev is None or sub.qos > prev.qos:
+                    selected[cid] = sub
+        for cid, sub in selected.items():
+            if cid not in subscribers.subscriptions:
+                self._publish_to_client(cid, sub, packet, shared=True)
+        for cid, sub in subscribers.subscriptions.items():
+            self._publish_to_client(cid, sub, packet, shared=False)
+
+    async def _match_async(self, topic: str) -> SubscriberSet:
+        result = self.matcher.subscribers(topic)
+        if asyncio.iscoroutine(result):
+            result = await result
+        return result
+
+    def _publish_to_client(self, client_id: str, sub: Subscription,
+                           packet: Packet, shared: bool) -> None:
+        """Parity: v2/server.go:795-868 (publishToClient)."""
+        client = self.clients.get(client_id)
+        if client is None:
+            return
+        if sub.no_local and packet.origin == client_id:
+            return  # v5 NoLocal [MQTT-3.8.3-3]
+        out = packet.copy()
+        out.protocol_version = client.properties.protocol_version
+        out.fixed.qos = min(packet.fixed.qos, sub.qos,
+                            self.capabilities.maximum_qos)
+        out.fixed.dup = False
+        if not sub.retain_as_published:
+            out.fixed.retain = False
+        if client.properties.protocol_version >= 5:
+            ids = sorted(set(sub.identifiers.values())
+                         or ({sub.identifier} if sub.identifier else set()))
+            out.properties.subscription_ids = ids
+            out.properties.topic_alias = None
+            if client.aliases is not None and client.properties.topic_alias_maximum:
+                alias, first = client.aliases.assign_outbound(out.topic)
+                if alias and not first:
+                    out.properties.topic_alias = alias
+                    out.topic = ""
+                elif alias:
+                    out.properties.topic_alias = alias
+        else:
+            out.properties = type(out.properties)()
+
+        if client.closed and out.fixed.qos == 0:
+            return  # QoS0 is not queued for offline clients
+        if out.fixed.qos > 0:
+            if len(client.inflight) >= self.capabilities.maximum_inflight:
+                self.info.inflight_dropped += 1
+                self.hooks.notify("on_qos_dropped", client, out)
+                return
+            try:
+                out.packet_id = client.next_packet_id()
+            except PacketIDExhausted:
+                self.hooks.notify("on_packet_id_exhausted", client, out)
+                return
+            out.created = time.time()
+            client.inflight.set(out.copy())
+            self.info.inflight += 1
+            if not client.inflight.take_send_quota():
+                # hold for later: quota-released resend picks it up
+                return
+            self.hooks.notify("on_qos_publish", client, out, out.created, 0)
+        if client.closed:
+            return  # queued in inflight for session resume
+        if not client.send(out):
+            self.info.messages_dropped += 1
+            self.hooks.notify("on_publish_dropped", client, out)
+            if out.fixed.qos > 0:
+                client.inflight.delete(out.packet_id)
+                client.inflight.return_send_quota()
+                self.info.inflight -= 1
+
+    # ------------------------------------------------------------------
+    # QoS acknowledgement state machines (v2/server.go:909-987)
+    # ------------------------------------------------------------------
+
+    def _process_puback(self, client: Client, packet: Packet) -> None:
+        if client.inflight.delete(packet.packet_id):
+            self.info.inflight -= 1
+            client.inflight.return_send_quota()
+            self.hooks.notify("on_qos_complete", client, packet)
+
+    def _process_pubrec(self, client: Client, packet: Packet) -> None:
+        if packet.reason_code >= 0x80:
+            if client.inflight.delete(packet.packet_id):
+                self.info.inflight -= 1
+                client.inflight.return_send_quota()
+            return
+        if client.inflight.get(packet.packet_id) is None:
+            # unknown id -> PUBREL with not-found (v5)
+            client.send(Packet(
+                fixed=FixedHeader(type=PT.PUBREL),
+                protocol_version=client.properties.protocol_version,
+                packet_id=packet.packet_id,
+                reason_code=codes.ErrPacketIdentifierNotFound.value
+                if client.properties.protocol_version >= 5 else 0))
+            return
+        rel = Packet(fixed=FixedHeader(type=PT.PUBREL),
+                     protocol_version=client.properties.protocol_version,
+                     packet_id=packet.packet_id)
+        rel.created = time.time()
+        client.inflight.set(rel.copy())
+        client.send(rel)
+
+    def _process_pubrel(self, client: Client, packet: Packet) -> None:
+        known = packet.packet_id in client.pubrec_inbound
+        client.pubrec_inbound.discard(packet.packet_id)
+        if known:
+            client.inflight.return_receive_quota()
+        client.send(Packet(
+            fixed=FixedHeader(type=PT.PUBCOMP),
+            protocol_version=client.properties.protocol_version,
+            packet_id=packet.packet_id,
+            reason_code=0 if known or client.properties.protocol_version < 5
+            else codes.ErrPacketIdentifierNotFound.value))
+        if known:
+            self.hooks.notify("on_qos_complete", client, packet)
+
+    def _process_pubcomp(self, client: Client, packet: Packet) -> None:
+        if client.inflight.delete(packet.packet_id):
+            self.info.inflight -= 1
+            client.inflight.return_send_quota()
+            self.hooks.notify("on_qos_complete", client, packet)
+
+    # ------------------------------------------------------------------
+    # SUBSCRIBE / UNSUBSCRIBE (v2/server.go:990-1129)
+    # ------------------------------------------------------------------
+
+    def _process_subscribe(self, client: Client, packet: Packet) -> None:
+        packet = self.hooks.modify("on_subscribe", packet, client)
+        caps = self.capabilities
+        reason_codes: list[int] = []
+        counts: list[int] = []
+        accepted: list[Subscription] = []
+        for sub in packet.filters:
+            filt = sub.filter
+            if not valid_filter(filt,
+                                shared_allowed=caps.shared_sub_available,
+                                wildcards_allowed=caps.wildcard_sub_available):
+                if not valid_filter(filt):
+                    reason_codes.append(codes.ErrTopicFilterInvalid.value)
+                elif filt.startswith("$share/"):
+                    reason_codes.append(
+                        codes.ErrSharedSubscriptionsNotSupported.value)
+                else:
+                    reason_codes.append(
+                        codes.ErrWildcardSubscriptionsNotSupported.value)
+                counts.append(0)
+                continue
+            if filt.startswith("$share/") and sub.no_local:
+                # [MQTT-3.8.3-4]: NoLocal on shared subscription is an error
+                raise ProtocolError(codes.ErrProtocolViolation,
+                                    "no-local shared subscription")
+            if not self.hooks.any_allow("on_acl_check", client, filt, False):
+                reason_codes.append(codes.ErrNotAuthorized.value)
+                counts.append(0)
+                continue
+            granted = min(sub.qos, caps.maximum_qos)
+            sub.qos = granted
+            if not caps.sub_id_available:
+                sub.identifier = 0
+            is_new = self.topics.subscribe(client.id, sub)
+            if is_new:
+                self.info.subscriptions += 1
+            client.subscriptions[filt] = sub
+            accepted.append(sub)
+            reason_codes.append(granted)
+            counts.append(1 if is_new else 0)
+        client.send(Packet(fixed=FixedHeader(type=PT.SUBACK),
+                           protocol_version=client.properties.protocol_version,
+                           packet_id=packet.packet_id,
+                           reason_codes=reason_codes))
+        self.hooks.notify("on_subscribed", client, packet, reason_codes, counts)
+        for sub, is_new_count in zip(accepted, counts):
+            self._publish_retained_to(client, sub, existing=is_new_count == 0)
+
+    def _publish_retained_to(self, client: Client, sub: Subscription,
+                             existing: bool) -> None:
+        """Retained delivery per v5 retain-handling. Shared subscriptions get
+        none [MQTT-3.3.1-13]."""
+        if sub.filter.startswith("$share/"):
+            return
+        if sub.retain_handling == 2:
+            return
+        if sub.retain_handling == 1 and existing:
+            return
+        now = time.time()
+        maxexp = self.capabilities.maximum_message_expiry_interval
+        for msg in self.topics.retained_for(sub.filter):
+            if self._message_expired(msg, now, maxexp):
+                continue
+            out = msg.copy()
+            out.protocol_version = client.properties.protocol_version
+            out.fixed.retain = True
+            out.fixed.qos = min(out.fixed.qos, sub.qos)
+            out.fixed.dup = False
+            if out.fixed.qos > 0:
+                try:
+                    out.packet_id = client.next_packet_id()
+                except PacketIDExhausted:
+                    continue
+                out.created = now
+                client.inflight.set(out.copy())
+                self.info.inflight += 1
+            if out.protocol_version < 5:
+                out.properties = type(out.properties)()
+            if client.send(out):
+                self.hooks.notify("on_retain_published", client, out)
+
+    def _process_unsubscribe(self, client: Client, packet: Packet) -> None:
+        packet = self.hooks.modify("on_unsubscribe", packet, client)
+        reason_codes = []
+        for sub in packet.filters:
+            existed = self.topics.unsubscribe(client.id, sub.filter)
+            if existed:
+                self.info.subscriptions -= 1
+            client.subscriptions.pop(sub.filter, None)
+            reason_codes.append(codes.Success.value if existed
+                                else codes.NoSubscriptionExisted.value)
+        client.send(Packet(fixed=FixedHeader(type=PT.UNSUBACK),
+                           protocol_version=client.properties.protocol_version,
+                           packet_id=packet.packet_id,
+                           reason_codes=reason_codes))
+        self.hooks.notify("on_unsubscribed", client, packet)
+
+    # ------------------------------------------------------------------
+    # Wills
+    # ------------------------------------------------------------------
+
+    def _queue_will(self, client: Client) -> None:
+        will = client.properties.will
+        if will is None:
+            return
+        packet = Packet(fixed=FixedHeader(type=PT.PUBLISH, qos=will.qos,
+                                          retain=will.retain),
+                        topic=will.topic, payload=will.payload,
+                        origin=client.id, created=time.time(),
+                        properties=will.properties.copy())
+        packet.properties.will_delay = None
+        delay = client.properties.will_delay
+        if delay > 0:
+            self._will_delays[client.id] = (time.time() + delay, packet)
+        else:
+            self._fire_will(client, packet)
+        client.properties.will = None
+
+    def _fire_will(self, client: Client | None, packet: Packet) -> None:
+        if packet.fixed.retain:
+            self.topics.retain(packet.copy())
+        task = self.loop.create_task(self.publish_to_subscribers(packet))
+        task.add_done_callback(lambda t: t.exception())
+        self.hooks.notify("on_will_sent", client, packet)
+
+    # ------------------------------------------------------------------
+    # Inline publish / packet injection
+    # ------------------------------------------------------------------
+
+    async def publish(self, topic: str, payload: bytes, qos: int = 0,
+                      retain: bool = False, **props) -> None:
+        """Server-side publish without a network client (InjectPacket
+        equivalent, v2/server.go:637-671)."""
+        if not valid_topic_name(topic) and not topic.startswith("$"):
+            raise ProtocolError(codes.ErrTopicNameInvalid)
+        packet = Packet(fixed=FixedHeader(type=PT.PUBLISH, qos=qos,
+                                          retain=retain),
+                        topic=topic, payload=payload, origin="inline",
+                        created=time.time())
+        for k, v in props.items():
+            setattr(packet.properties, k, v)
+        if retain:
+            self.topics.retain(packet.copy())
+        await self.publish_to_subscribers(packet)
+
+    async def inject(self, client: Client, packet: Packet) -> None:
+        """Process a packet as if ``client`` had sent it over the network."""
+        await self._receive_packet(client, packet)
+
+    def new_inline_client(self, client_id: str = "inline") -> Client:
+        client = Client(self, None, None, "inline", inline=True)
+        client.id = client_id
+        return client
+
+    # ------------------------------------------------------------------
+    # Housekeeping + $SYS (v2/server.go:284-305, 1185-1237, 1436-1493)
+    # ------------------------------------------------------------------
+
+    def disconnect_client(self, client: Client, code: codes.Code) -> None:
+        """Send DISCONNECT (v5) before dropping the connection."""
+        if client.properties.protocol_version >= 5 and not client.closed:
+            client.send_now(Packet(fixed=FixedHeader(type=PT.DISCONNECT),
+                                   protocol_version=5,
+                                   reason_code=code.value))
+
+    @staticmethod
+    def _message_expired(packet: Packet, now: float, maximum: int) -> bool:
+        expiry = packet.properties.message_expiry
+        if expiry is None:
+            expiry = maximum if maximum else 0
+        if expiry <= 0:
+            return False
+        return now > packet.created + expiry
+
+    async def _housekeeping_loop(self) -> None:
+        try:
+            while True:
+                await asyncio.sleep(1.0)
+                now = time.time()
+                mono = time.monotonic()
+                self._check_keepalives(mono)
+                self._check_client_expiry(now)
+                self._check_will_delays(now)
+                self._check_expired_retained(now)
+                self._check_expired_inflight(now)
+        except asyncio.CancelledError:
+            pass
+
+    def _check_keepalives(self, mono: float) -> None:
+        grace = self.capabilities.keepalive_grace
+        for client in self.clients.connected():
+            if client.keepalive <= 0:
+                continue
+            if mono - client.last_received > client.keepalive * grace:
+                self.disconnect_client(client, codes.ErrKeepAliveTimeout)
+                task = self.loop.create_task(
+                    client.stop(ProtocolError(codes.ErrKeepAliveTimeout)))
+                task.add_done_callback(lambda t: t.exception())
+
+    def _check_client_expiry(self, now: float) -> None:
+        maximum = self.capabilities.maximum_session_expiry_interval
+        for client in self.clients.all():
+            if client.closed and client.expired(now, maximum):
+                self.hooks.notify("on_client_expired", client)
+                self._purge_session(client)
+
+    def _check_will_delays(self, now: float) -> None:
+        for cid in list(self._will_delays):
+            due, packet = self._will_delays[cid]
+            if now >= due:
+                del self._will_delays[cid]
+                self._fire_will(self.clients.get(cid), packet)
+
+    def _check_expired_retained(self, now: float) -> None:
+        maximum = self.capabilities.maximum_message_expiry_interval
+        if not maximum:
+            return
+        # the '#' scan already skips $-prefixed (broker-owned) topics
+        expired = [p.topic for p in self.topics.retained_for("#")
+                   if self._message_expired(p, now, maximum)]
+        for topic in expired:
+            clear = Packet(fixed=FixedHeader(type=PT.PUBLISH, retain=True),
+                           topic=topic, payload=b"")
+            self.topics.retain(clear)
+            self.info.retained -= 1
+            self.hooks.notify("on_retained_expired", topic)
+
+    def _check_expired_inflight(self, now: float) -> None:
+        maximum = self.capabilities.maximum_message_expiry_interval
+        if not maximum:
+            return
+        for client in self.clients.all():
+            for packet in client.inflight.all():
+                if packet.created > 0 and now > packet.created + maximum:
+                    if client.inflight.delete(packet.packet_id):
+                        self.info.inflight -= 1
+                        self.info.inflight_dropped += 1
+                        client.inflight.return_send_quota()
+                        self.hooks.notify("on_qos_dropped", client, packet)
+
+    async def _sys_topic_loop(self) -> None:
+        try:
+            while True:
+                await asyncio.sleep(self.capabilities.sys_topic_interval)
+                self.publish_sys_topics()
+        except asyncio.CancelledError:
+            pass
+
+    def publish_sys_topics(self) -> None:
+        """Refresh + retain the $SYS/broker tree. Parity: server.go:1185-1237."""
+        info = self.info
+        info.time = int(time.time())
+        info.uptime = info.time - info.started
+        info.retained = self.topics.retained_count
+        info.subscriptions = self.topics.subscription_count
+        self.hooks.notify("on_sys_info_tick", info)
+        entries = {
+            "$SYS/broker/version": info.version,
+            "$SYS/broker/uptime": info.uptime,
+            "$SYS/broker/time": info.time,
+            "$SYS/broker/started": info.started,
+            "$SYS/broker/load/bytes/received": info.bytes_received,
+            "$SYS/broker/load/bytes/sent": info.bytes_sent,
+            "$SYS/broker/clients/connected": info.clients_connected,
+            "$SYS/broker/clients/disconnected": info.clients_disconnected,
+            "$SYS/broker/clients/maximum": info.clients_maximum,
+            "$SYS/broker/clients/total": info.clients_total,
+            "$SYS/broker/messages/received": info.messages_received,
+            "$SYS/broker/messages/sent": info.messages_sent,
+            "$SYS/broker/messages/dropped": info.messages_dropped,
+            "$SYS/broker/messages/inflight": info.inflight,
+            "$SYS/broker/messages/retained/count": info.retained,
+            "$SYS/broker/subscriptions/count": info.subscriptions,
+            "$SYS/broker/packets/received": info.packets_received,
+            "$SYS/broker/packets/sent": info.packets_sent,
+        }
+        for topic, value in entries.items():
+            packet = Packet(fixed=FixedHeader(type=PT.PUBLISH, retain=True),
+                            topic=topic, payload=str(value).encode(),
+                            origin="$SYS", created=time.time())
+            self.topics.retain(packet.copy())
+            if self.loop is not None:
+                task = self.loop.create_task(self.publish_to_subscribers(packet))
+                task.add_done_callback(lambda t: t.exception())
+
+    # ------------------------------------------------------------------
+    # Persistence restore (v2/server.go:1297-1434)
+    # ------------------------------------------------------------------
+
+    async def _restore_from_storage(self) -> None:
+        from ..hooks import storage as st  # local import to avoid cycle
+
+        for rec in self.hooks.first_non_empty("stored_clients"):
+            client = Client(self, None, None, rec.listener)
+            client.id = rec.client_id
+            client.properties.protocol_version = rec.protocol_version
+            client.properties.username = rec.username
+            client.properties.clean_start = rec.clean
+            client.properties.session_expiry = rec.session_expiry
+            client.properties.session_expiry_set = rec.session_expiry_set
+            client.disconnected_at = rec.disconnected_at or time.time()
+            self.clients.add(client)
+        for rec in self.hooks.first_non_empty("stored_subscriptions"):
+            sub = Subscription(filter=rec.filter, qos=rec.qos,
+                               no_local=rec.no_local,
+                               retain_as_published=rec.retain_as_published,
+                               retain_handling=rec.retain_handling,
+                               identifier=rec.identifier)
+            if self.topics.subscribe(rec.client_id, sub):
+                self.info.subscriptions += 1
+            client = self.clients.get(rec.client_id)
+            if client is not None:
+                client.subscriptions[rec.filter] = sub
+        for rec in self.hooks.first_non_empty("stored_retained_messages"):
+            self.topics.retain(rec.to_packet())
+            self.info.retained += 1
+        for rec in self.hooks.first_non_empty("stored_inflight_messages"):
+            client = self.clients.get(rec.client_id)
+            if client is not None:
+                client.inflight.set(rec.to_packet())
+                self.info.inflight += 1
+        stored_info = self.hooks.first_non_empty("stored_sys_info")
+        if stored_info is not None:
+            for k in ("bytes_received", "bytes_sent", "messages_received",
+                      "messages_sent", "messages_dropped", "packets_received",
+                      "packets_sent", "clients_maximum", "clients_total"):
+                setattr(self.info, k, getattr(stored_info, k, 0))

@@ -1,0 +1,13 @@
+"""Hook/plugin layer: event boundary, auth hooks, persistence."""
+
+from .auth import ACLRule, AllowHook, AuthRule, Ledger, LedgerHook
+from .base import Hook, Hooks, RejectPacket
+from .storage import (ClientRecord, MemoryStore, MessageRecord, SQLiteStore,
+                      StorageHook, Store, SubscriptionRecord)
+
+__all__ = [
+    "ACLRule", "AllowHook", "AuthRule", "Ledger", "LedgerHook",
+    "Hook", "Hooks", "RejectPacket",
+    "ClientRecord", "MemoryStore", "MessageRecord", "SQLiteStore",
+    "StorageHook", "Store", "SubscriptionRecord",
+]

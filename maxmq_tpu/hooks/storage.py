@@ -1,0 +1,331 @@
+"""Persistence: serializable session/message records plus two store-backed
+hooks (in-memory and SQLite). The broker restores from ``stored_*`` getters at
+serve time and writes through on every relevant event.
+
+Parity surface: vendor/github.com/mochi-co/mqtt/v2/hooks/storage/storage.go
+(record types) and the Stored* hook plumbing in hooks.go:511-606. The
+reference vendors no backend; here SQLite (stdlib) is a first-class one.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+
+from ..protocol.codec import FixedHeader, PacketType as PT
+from ..protocol.packets import Packet
+from ..protocol.properties import Properties
+from .base import Hook
+
+
+@dataclass
+class ClientRecord:
+    client_id: str
+    listener: str = ""
+    username: bytes = b""
+    clean: bool = False
+    protocol_version: int = 4
+    session_expiry: int = 0
+    session_expiry_set: bool = False
+    disconnected_at: float = 0.0
+
+    def to_json(self) -> str:
+        d = asdict(self)
+        d["username"] = self.username.decode("utf-8", "replace")
+        return json.dumps(d)
+
+    @classmethod
+    def from_json(cls, s: str) -> "ClientRecord":
+        d = json.loads(s)
+        d["username"] = d.get("username", "").encode()
+        return cls(**d)
+
+
+@dataclass
+class SubscriptionRecord:
+    client_id: str
+    filter: str
+    qos: int = 0
+    no_local: bool = False
+    retain_as_published: bool = False
+    retain_handling: int = 0
+    identifier: int = 0
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self))
+
+    @classmethod
+    def from_json(cls, s: str) -> "SubscriptionRecord":
+        return cls(**json.loads(s))
+
+
+@dataclass
+class MessageRecord:
+    """A retained or inflight message, wire-reconstructable."""
+
+    client_id: str = ""       # inflight owner; '' for retained
+    origin: str = ""
+    topic: str = ""
+    payload: bytes = b""
+    qos: int = 0
+    retain: bool = False
+    packet_id: int = 0
+    packet_type: int = PT.PUBLISH
+    created: float = 0.0
+    expiry: int | None = None
+    properties_json: str = "{}"
+
+    @classmethod
+    def from_packet(cls, packet: Packet, client_id: str = "") -> "MessageRecord":
+        props = {}
+        pr = packet.properties
+        for k in ("payload_format", "message_expiry", "content_type",
+                  "response_topic", "user_properties", "subscription_ids"):
+            v = getattr(pr, k)
+            if v:
+                props[k] = v if not isinstance(v, bytes) else v.hex()
+        if pr.correlation_data:
+            props["correlation_data"] = pr.correlation_data.hex()
+        return cls(client_id=client_id, origin=packet.origin,
+                   topic=packet.topic, payload=packet.payload,
+                   qos=packet.fixed.qos, retain=packet.fixed.retain,
+                   packet_id=packet.packet_id, packet_type=packet.fixed.type,
+                   created=packet.created,
+                   properties_json=json.dumps(props))
+
+    def to_packet(self) -> Packet:
+        props = Properties()
+        for k, v in json.loads(self.properties_json).items():
+            if k == "correlation_data":
+                props.correlation_data = bytes.fromhex(v)
+            elif k == "user_properties":
+                props.user_properties = [tuple(p) for p in v]
+            else:
+                setattr(props, k, v)
+        return Packet(
+            fixed=FixedHeader(type=self.packet_type, qos=self.qos,
+                              retain=self.retain),
+            topic=self.topic, payload=self.payload, packet_id=self.packet_id,
+            origin=self.origin, created=self.created, properties=props)
+
+    def to_json(self) -> str:
+        d = asdict(self)
+        d["payload"] = self.payload.hex()
+        return json.dumps(d)
+
+    @classmethod
+    def from_json(cls, s: str) -> "MessageRecord":
+        d = json.loads(s)
+        d["payload"] = bytes.fromhex(d.get("payload", ""))
+        return cls(**d)
+
+
+class StorageHook(Hook):
+    """Write-through persistence against an abstract key/value store with
+    namespaced buckets: clients, subscriptions, retained, inflight, sysinfo."""
+
+    id = "storage"
+
+    def __init__(self, store: "Store") -> None:
+        self.store = store
+
+    def stop(self) -> None:
+        self.store.close()
+
+    # -- restore getters ----------------------------------------------------
+
+    def stored_clients(self) -> list:
+        return [ClientRecord.from_json(v)
+                for v in self.store.all("clients").values()]
+
+    def stored_subscriptions(self) -> list:
+        return [SubscriptionRecord.from_json(v)
+                for v in self.store.all("subscriptions").values()]
+
+    def stored_retained_messages(self) -> list:
+        return [MessageRecord.from_json(v)
+                for v in self.store.all("retained").values()]
+
+    def stored_inflight_messages(self) -> list:
+        return [MessageRecord.from_json(v)
+                for v in self.store.all("inflight").values()]
+
+    def stored_sys_info(self):
+        from ..broker.sys_info import SysInfo
+        raw = self.store.get("sysinfo", "sysinfo")
+        if not raw:
+            return None
+        data = json.loads(raw)
+        data.pop("extra", None)
+        known = {f for f in SysInfo.__dataclass_fields__ if f != "extra"}
+        return SysInfo(**{k: v for k, v in data.items() if k in known})
+
+    # -- write-through events -----------------------------------------------
+
+    def _save_client(self, client) -> None:
+        rec = ClientRecord(
+            client_id=client.id, listener=client.listener,
+            username=client.properties.username,
+            clean=client.properties.clean_start,
+            protocol_version=client.properties.protocol_version,
+            session_expiry=client.properties.session_expiry,
+            session_expiry_set=client.properties.session_expiry_set,
+            disconnected_at=client.disconnected_at)
+        self.store.put("clients", client.id, rec.to_json())
+
+    def on_session_established(self, client, packet) -> None:
+        self._save_client(client)
+
+    def on_disconnect(self, client, err, expire: bool) -> None:
+        if expire:
+            self.store.delete("clients", client.id)
+            self.store.delete_prefix("subscriptions", client.id + "|")
+            self.store.delete_prefix("inflight", client.id + "|")
+        else:
+            self._save_client(client)
+
+    def on_client_expired(self, client) -> None:
+        self.store.delete("clients", client.id)
+        self.store.delete_prefix("subscriptions", client.id + "|")
+        self.store.delete_prefix("inflight", client.id + "|")
+
+    def on_subscribed(self, client, packet, reason_codes, counts) -> None:
+        for sub, code in zip(packet.filters, reason_codes):
+            if code >= 0x80:
+                continue
+            rec = SubscriptionRecord(
+                client_id=client.id, filter=sub.filter, qos=sub.qos,
+                no_local=sub.no_local,
+                retain_as_published=sub.retain_as_published,
+                retain_handling=sub.retain_handling, identifier=sub.identifier)
+            self.store.put("subscriptions", f"{client.id}|{sub.filter}",
+                           rec.to_json())
+
+    def on_unsubscribed(self, client, packet) -> None:
+        for sub in packet.filters:
+            self.store.delete("subscriptions", f"{client.id}|{sub.filter}")
+
+    def on_retain_message(self, client, packet, stored: int) -> None:
+        if stored == -1 or not packet.payload:
+            self.store.delete("retained", packet.topic)
+        else:
+            self.store.put("retained", packet.topic,
+                           MessageRecord.from_packet(packet).to_json())
+
+    def on_retained_expired(self, topic: str) -> None:
+        self.store.delete("retained", topic)
+
+    def on_qos_publish(self, client, packet, sent: float, resends: int) -> None:
+        self.store.put("inflight", f"{client.id}|{packet.packet_id}",
+                       MessageRecord.from_packet(packet, client.id).to_json())
+
+    def on_qos_complete(self, client, packet) -> None:
+        self.store.delete("inflight", f"{client.id}|{packet.packet_id}")
+
+    def on_qos_dropped(self, client, packet) -> None:
+        self.store.delete("inflight", f"{client.id}|{packet.packet_id}")
+
+    def on_sys_info_tick(self, info) -> None:
+        self.store.put("sysinfo", "sysinfo", json.dumps(
+            {k: v for k, v in asdict(info).items() if k != "extra"}))
+
+
+class Store:
+    """Abstract bucketed KV store."""
+
+    def put(self, bucket: str, key: str, value: str) -> None:
+        raise NotImplementedError
+
+    def get(self, bucket: str, key: str) -> str | None:
+        raise NotImplementedError
+
+    def delete(self, bucket: str, key: str) -> None:
+        raise NotImplementedError
+
+    def delete_prefix(self, bucket: str, prefix: str) -> None:
+        raise NotImplementedError
+
+    def all(self, bucket: str) -> dict[str, str]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class MemoryStore(Store):
+    def __init__(self) -> None:
+        self._data: dict[str, dict[str, str]] = {}
+
+    def put(self, bucket, key, value):
+        self._data.setdefault(bucket, {})[key] = value
+
+    def get(self, bucket, key):
+        return self._data.get(bucket, {}).get(key)
+
+    def delete(self, bucket, key):
+        self._data.get(bucket, {}).pop(key, None)
+
+    def delete_prefix(self, bucket, prefix):
+        b = self._data.get(bucket, {})
+        for k in [k for k in b if k.startswith(prefix)]:
+            del b[k]
+
+    def all(self, bucket):
+        return dict(self._data.get(bucket, {}))
+
+
+class SQLiteStore(Store):
+    """Durable store on stdlib sqlite3 (WAL mode)."""
+
+    def __init__(self, path: str) -> None:
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS kv ("
+                "bucket TEXT NOT NULL, key TEXT NOT NULL, value TEXT NOT NULL,"
+                "PRIMARY KEY (bucket, key))")
+            self._conn.commit()
+
+    def put(self, bucket, key, value):
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO kv (bucket, key, value) VALUES (?, ?, ?) "
+                "ON CONFLICT(bucket, key) DO UPDATE SET value=excluded.value",
+                (bucket, key, value))
+            self._conn.commit()
+
+    def get(self, bucket, key):
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT value FROM kv WHERE bucket=? AND key=?",
+                (bucket, key)).fetchone()
+        return row[0] if row else None
+
+    def delete(self, bucket, key):
+        with self._lock:
+            self._conn.execute("DELETE FROM kv WHERE bucket=? AND key=?",
+                               (bucket, key))
+            self._conn.commit()
+
+    def delete_prefix(self, bucket, prefix):
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM kv WHERE bucket=? AND key GLOB ?",
+                (bucket, prefix.replace("[", "[[]") + "*"))
+            self._conn.commit()
+
+    def all(self, bucket):
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT key, value FROM kv WHERE bucket=?", (bucket,)).fetchall()
+        return dict(rows)
+
+    def close(self):
+        with self._lock:
+            self._conn.commit()
+            self._conn.close()

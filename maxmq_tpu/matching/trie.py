@@ -130,9 +130,11 @@ class TopicIndex:
                 holders = node.shared.get(group)
                 if not holders or client_id not in holders:
                     return False
+                sub_filter = holders[client_id].filter
                 del holders[client_id]
                 if not holders:
                     del node.shared[group]
+                    self._share_cursor.pop((group, sub_filter), None)
             else:
                 if client_id not in node.subscriptions:
                     return False
@@ -174,9 +176,9 @@ class TopicIndex:
                 if wildcard_ok:
                     hash_child = node.children.get("#")
                     if hash_child is not None:
-                        self._collect(out, hash_child, "#-terminated")
+                        self._collect(out, hash_child)
                 if depth == len(levels):
-                    self._collect(out, node, "exact")
+                    self._collect(out, node)
                     continue
                 lit = node.children.get(levels[depth])
                 if lit is not None:
@@ -187,7 +189,7 @@ class TopicIndex:
                         stack.append((plus, depth + 1))
         return out
 
-    def _collect(self, out: SubscriberSet, node: _Node, _why: str) -> None:
+    def _collect(self, out: SubscriberSet, node: _Node) -> None:
         for client_id, sub in node.subscriptions.items():
             out.add(client_id, sub, sub.filter)
         for group, holders in node.shared.items():
@@ -297,21 +299,24 @@ class TopicIndex:
     # Introspection (NFA compiler input, $SYS counters)
     # ------------------------------------------------------------------
 
-    def all_subscriptions(self):
-        """Yield (filter, client_id, subscription, group) for every entry.
-        ``group`` is '' for non-shared. Used by the NFA compiler."""
+    def all_subscriptions(self) -> list[tuple[str, str, Subscription, str]]:
+        """All (filter, client_id, subscription, group) entries, materialized
+        under the lock so callers iterate a stable snapshot. ``group`` is ''
+        for non-shared. Used by the NFA compiler."""
+        out: list[tuple[str, str, Subscription, str]] = []
         with self._lock:
             stack: list[tuple[_Node, list[str]]] = [(self._root, [])]
             while stack:
                 node, path = stack.pop()
                 filt = "/".join(path)
                 for client_id, sub in node.subscriptions.items():
-                    yield filt, client_id, sub, ""
+                    out.append((filt, client_id, sub, ""))
                 for group, holders in node.shared.items():
                     for client_id, sub in holders.items():
-                        yield filt, client_id, sub, group
+                        out.append((filt, client_id, sub, group))
                 for name, child in node.children.items():
                     stack.append((child, path + [name]))
+        return out
 
 
 class TopicAliases:

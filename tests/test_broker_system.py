@@ -1,0 +1,276 @@
+"""Black-box system tests: a real broker on a real TCP socket, exercised by
+the in-repo MQTT client. Mirrors the reference's paho system suite
+(tests/system/mqtt_test.go): connect/disconnect, keepalive, wildcard
+subscribe with granted QoS, unsubscribe, QoS0 roundtrip, QoS1/QoS2
+offline-delivery, plus retained/will/takeover/shared-subscription scenarios.
+"""
+
+import asyncio
+from contextlib import asynccontextmanager
+
+import pytest
+
+from maxmq_tpu.broker import Broker, BrokerOptions, Capabilities, TCPListener
+from maxmq_tpu.hooks import AllowHook
+from maxmq_tpu.mqtt_client import MQTTClient
+from maxmq_tpu.protocol import Will
+
+
+@asynccontextmanager
+async def running_broker(**caps):
+    caps.setdefault("sys_topic_interval", 0)
+    b = Broker(BrokerOptions(capabilities=Capabilities(**caps)))
+    b.add_hook(AllowHook())
+    listener = b.add_listener(TCPListener("t1", "127.0.0.1:0"))
+    await b.serve()
+    b.test_port = listener._server.sockets[0].getsockname()[1]
+    try:
+        yield b
+    finally:
+        await b.close()
+
+
+async def connect(broker, client_id="", version=4, **kw) -> MQTTClient:
+    c = MQTTClient(client_id=client_id, version=version, **kw)
+    await c.connect("127.0.0.1", broker.test_port)
+    return c
+
+
+async def test_connect_disconnect():
+    async with running_broker() as broker:
+        c = await connect(broker, "c1")
+        assert c.connack.reason_code == 0
+        assert c.connack.session_present is False
+        assert broker.info.clients_connected == 1
+        await c.disconnect()
+        await asyncio.sleep(0.05)
+        assert broker.info.clients_connected == 0
+
+
+async def test_keepalive_ping():
+    async with running_broker() as broker:
+        c = await connect(broker, "c1", keepalive=2)
+        for _ in range(3):
+            await c.ping()
+            await asyncio.sleep(0.05)
+        await c.disconnect()
+
+
+async def test_keepalive_timeout_drops_client():
+    async with running_broker(keepalive_grace=0.2) as broker:
+        c = await connect(broker, "c1", keepalive=1)
+        await c.wait_closed(timeout=5)
+        await asyncio.sleep(0.05)
+        assert broker.info.clients_connected == 0
+
+
+async def test_subscribe_wildcards_granted_qos():
+    async with running_broker() as broker:
+        c = await connect(broker, "c1")
+        granted = await c.subscribe(("sensor/#", 0), ("data/+/raw", 1),
+                                    ("exact/topic", 2))
+        assert granted == [0, 1, 2]
+        assert broker.info.subscriptions == 3
+
+
+async def test_subscribe_invalid_filter_rejected():
+    async with running_broker() as broker:
+        c = await connect(broker, "c1", version=5)
+        granted = await c.subscribe("bad/#/filter")
+        assert granted == [0x8F]
+
+
+async def test_unsubscribe():
+    async with running_broker() as broker:
+        c = await connect(broker, "c1")
+        await c.subscribe("a/b")
+        await c.unsubscribe("a/b")
+        await c.publish("a/b", b"after-unsub")
+        with pytest.raises(asyncio.TimeoutError):
+            await c.next_message(timeout=0.2)
+
+
+async def test_qos0_roundtrip():
+    async with running_broker() as broker:
+        s = await connect(broker, "sub")
+        p = await connect(broker, "pub")
+        await s.subscribe("room/+/temp")
+        await p.publish("room/kitchen/temp", b"21.5")
+        msg = await s.next_message()
+        assert (msg.topic, msg.payload, msg.qos) == \
+            ("room/kitchen/temp", b"21.5", 0)
+
+
+@pytest.mark.parametrize("qos", [1, 2])
+async def test_offline_delivery(qos):
+    """Persistent session disconnects; messages published meanwhile are
+    delivered on reconnect (the reference's headline QoS1/QoS2 scenario)."""
+    async with running_broker() as broker:
+        s = await connect(broker, "subber", clean_start=False)
+        await s.subscribe(("queue/data", qos))
+        await s.close()  # network drop, not DISCONNECT: session persists
+        await asyncio.sleep(0.05)
+
+        p = await connect(broker, "pubber")
+        await p.publish("queue/data", b"while-away", qos=qos)
+        await p.disconnect()
+
+        s2 = MQTTClient(client_id="subber", version=4, clean_start=False)
+        await s2.connect("127.0.0.1", broker.test_port)
+        assert s2.connack.session_present is True
+        msg = await s2.next_message()
+        assert msg.payload == b"while-away"
+        assert msg.qos == qos
+        await s2.disconnect()
+
+
+async def test_qos2_exactly_once_dedup():
+    async with running_broker() as broker:
+        s = await connect(broker, "sub")
+        p = await connect(broker, "pub")
+        await s.subscribe(("once/t", 2))
+        for i in range(3):
+            await p.publish("once/t", f"m{i}".encode(), qos=2)
+        got = [await s.next_message() for _ in range(3)]
+        assert [m.payload for m in got] == [b"m0", b"m1", b"m2"]
+        with pytest.raises(asyncio.TimeoutError):
+            await s.next_message(timeout=0.2)
+
+
+async def test_retained_message_delivery():
+    async with running_broker() as broker:
+        p = await connect(broker, "pub")
+        await p.publish("config/node1", b"v1", retain=True)
+        await asyncio.sleep(0.05)
+        s = await connect(broker, "sub")
+        await s.subscribe("config/+")
+        msg = await s.next_message()
+        assert msg.payload == b"v1"
+        assert msg.retain is True
+        # clearing: empty retained payload
+        await p.publish("config/node1", b"", retain=True)
+        await asyncio.sleep(0.05)
+        s2 = await connect(broker, "sub2")
+        await s2.subscribe("config/+")
+        with pytest.raises(asyncio.TimeoutError):
+            await s2.next_message(timeout=0.2)
+
+
+async def test_will_on_abnormal_disconnect():
+    async with running_broker() as broker:
+        s = await connect(broker, "watcher")
+        await s.subscribe("wills/+")
+        w = await connect(broker, "doomed",
+                          will=Will(topic="wills/doomed", payload=b"gone"))
+        await w.close()  # abrupt close -> will fires
+        msg = await s.next_message()
+        assert (msg.topic, msg.payload) == ("wills/doomed", b"gone")
+
+
+async def test_no_will_on_clean_disconnect():
+    async with running_broker() as broker:
+        s = await connect(broker, "watcher")
+        await s.subscribe("wills/+")
+        w = await connect(broker, "polite",
+                          will=Will(topic="wills/polite", payload=b"gone"))
+        await w.disconnect()
+        with pytest.raises(asyncio.TimeoutError):
+            await s.next_message(timeout=0.2)
+
+
+async def test_session_takeover():
+    async with running_broker() as broker:
+        c1 = await connect(broker, "same-id", version=5)
+        c2 = await connect(broker, "same-id", version=5)
+        await c1.wait_closed()
+        assert c1.disconnect_packet is not None
+        assert c1.disconnect_packet.reason_code == 0x8E  # session taken over
+        await c2.ping()  # new connection is live
+        await c2.disconnect()
+
+
+async def test_shared_subscription_round_robin():
+    async with running_broker() as broker:
+        a = await connect(broker, "worker-a", version=5)
+        b = await connect(broker, "worker-b", version=5)
+        p = await connect(broker, "pub", version=5)
+        await a.subscribe("$share/grp/jobs")
+        await b.subscribe("$share/grp/jobs")
+        for i in range(4):
+            await p.publish("jobs", f"j{i}".encode())
+        await asyncio.sleep(0.1)
+        got_a, got_b = a.messages.qsize(), b.messages.qsize()
+        assert got_a + got_b == 4
+        assert got_a == 2 and got_b == 2  # round-robin fairness
+
+
+async def test_dollar_sys_subscription():
+    async with running_broker() as broker:
+        c = await connect(broker, "c1")
+        await c.subscribe("$SYS/#")
+        broker.publish_sys_topics()
+        msg = await c.next_message()
+        assert msg.topic.startswith("$SYS/")
+
+
+async def test_clients_cannot_publish_dollar_topics():
+    async with running_broker() as broker:
+        watcher = await connect(broker, "w")
+        await watcher.subscribe("$SYS/#")
+        c = await connect(broker, "c1")
+        await c.publish("$SYS/broker/version", b"fake")
+        with pytest.raises(asyncio.TimeoutError):
+            await watcher.next_message(timeout=0.2)
+
+
+async def test_no_local_v5():
+    async with running_broker() as broker:
+        c = await connect(broker, "c1", version=5)
+        await c.subscribe(("loop/t", 0), no_local=True)
+        await c.publish("loop/t", b"self")
+        with pytest.raises(asyncio.TimeoutError):
+            await c.next_message(timeout=0.2)
+
+
+async def test_v5_clean_start_discards_session():
+    async with running_broker() as broker:
+        c = await connect(broker, "cs", version=5, clean_start=False,
+                          session_expiry=300)
+        await c.subscribe("keep/me")
+        await c.close()
+        await asyncio.sleep(0.05)
+        c2 = MQTTClient(client_id="cs", version=5, clean_start=True)
+        await c2.connect("127.0.0.1", broker.test_port)
+        assert c2.connack.session_present is False
+        await c2.disconnect()
+
+
+async def test_second_connect_is_protocol_violation():
+    async with running_broker() as broker:
+        c = await connect(broker, "c1")
+        from maxmq_tpu.protocol import FixedHeader, Packet, PacketType as PT
+        dup = Packet(fixed=FixedHeader(type=PT.CONNECT), protocol_version=4,
+                     client_id="c1", clean_start=True)
+        c.writer.write(dup.encode())
+        await c.writer.drain()
+        await c.wait_closed()  # broker must drop the connection
+
+
+async def test_inline_publish_api():
+    async with running_broker() as broker:
+        c = await connect(broker, "c1")
+        await c.subscribe("inline/+")
+        await broker.publish("inline/x", b"from-server", retain=False)
+        msg = await c.next_message()
+        assert msg.payload == b"from-server"
+
+
+async def test_retained_qos_downgrade_and_sub_qos():
+    async with running_broker() as broker:
+        p = await connect(broker, "pub")
+        await p.publish("r/t", b"keep", qos=1, retain=True)
+        await asyncio.sleep(0.05)
+        s = await connect(broker, "sub")
+        await s.subscribe(("r/t", 0))  # subscription qos caps delivery
+        msg = await s.next_message()
+        assert msg.qos == 0 and msg.payload == b"keep"
