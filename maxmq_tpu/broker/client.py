@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import asyncio
 import time
+from collections import deque
 from dataclasses import dataclass, field
 
 from ..matching.trie import TopicAliases
@@ -61,6 +62,9 @@ class Client:
         self.inflight = Inflight()
         # QoS2 publishes we have PUBRECed but not yet PUBRELed (dedup set)
         self.pubrec_inbound: set[int] = set()
+        # outbound QoS packets parked on an exhausted send quota, FIFO;
+        # released as acks return quota (see Broker._release_held)
+        self.held_pids: deque[int] = deque()
         self.aliases: TopicAliases | None = None
         self.keepalive = 0
         self.last_received = time.monotonic()

@@ -47,13 +47,6 @@ class Inflight:
         other._messages = {k: v.copy() for k, v in self._messages.items()}
         return other
 
-    def next_immediate(self) -> Packet | None:
-        """Oldest packet flagged as blocked on quota (created == -1 marker)."""
-        for p in self.all():
-            if p.created == -1:
-                return p
-        return None
-
     # -- quotas (clamped to maxima) -----------------------------------------
 
     def take_receive_quota(self) -> bool:

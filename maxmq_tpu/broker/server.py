@@ -483,6 +483,9 @@ class Broker:
             self._publish_to_client(cid, sub, packet, shared=False)
 
     async def _match_async(self, topic: str) -> SubscriberSet:
+        async_fn = getattr(self.matcher, "subscribers_async", None)
+        if async_fn is not None:
+            return await async_fn(topic)
         result = self.matcher.subscribers(topic)
         if asyncio.iscoroutine(result):
             result = await result
@@ -534,7 +537,8 @@ class Broker:
             client.inflight.set(out.copy())
             self.info.inflight += 1
             if not client.inflight.take_send_quota():
-                # hold for later: quota-released resend picks it up
+                # park until an ack returns quota (_release_held)
+                client.held_pids.append(out.packet_id)
                 return
             self.hooks.notify("on_qos_publish", client, out, out.created, 0)
         if client.closed:
@@ -551,11 +555,28 @@ class Broker:
     # QoS acknowledgement state machines (v2/server.go:909-987)
     # ------------------------------------------------------------------
 
+    def _release_held(self, client: Client) -> None:
+        """Send parked QoS messages as send quota becomes available."""
+        while client.held_pids:
+            if not client.inflight.take_send_quota():
+                return
+            pid = client.held_pids.popleft()
+            held = client.inflight.get(pid)
+            if held is None:
+                client.inflight.return_send_quota()
+                continue
+            out = held.copy()
+            self.hooks.notify("on_qos_publish", client, out, time.time(), 0)
+            if not client.closed and not client.send(out):
+                self.info.messages_dropped += 1
+                self.hooks.notify("on_publish_dropped", client, out)
+
     def _process_puback(self, client: Client, packet: Packet) -> None:
         if client.inflight.delete(packet.packet_id):
             self.info.inflight -= 1
             client.inflight.return_send_quota()
             self.hooks.notify("on_qos_complete", client, packet)
+            self._release_held(client)
 
     def _process_pubrec(self, client: Client, packet: Packet) -> None:
         if packet.reason_code >= 0x80:
@@ -598,6 +619,7 @@ class Broker:
             self.info.inflight -= 1
             client.inflight.return_send_quota()
             self.hooks.notify("on_qos_complete", client, packet)
+            self._release_held(client)
 
     # ------------------------------------------------------------------
     # SUBSCRIBE / UNSUBSCRIBE (v2/server.go:990-1129)
@@ -640,7 +662,7 @@ class Broker:
             if is_new:
                 self.info.subscriptions += 1
             client.subscriptions[filt] = sub
-            accepted.append(sub)
+            accepted.append((sub, is_new))
             reason_codes.append(granted)
             counts.append(1 if is_new else 0)
         client.send(Packet(fixed=FixedHeader(type=PT.SUBACK),
@@ -648,8 +670,8 @@ class Broker:
                            packet_id=packet.packet_id,
                            reason_codes=reason_codes))
         self.hooks.notify("on_subscribed", client, packet, reason_codes, counts)
-        for sub, is_new_count in zip(accepted, counts):
-            self._publish_retained_to(client, sub, existing=is_new_count == 0)
+        for sub, is_new in accepted:
+            self._publish_retained_to(client, sub, existing=not is_new)
 
     def _publish_retained_to(self, client: Client, sub: Subscription,
                              existing: bool) -> None:
@@ -671,7 +693,12 @@ class Broker:
             out.fixed.retain = True
             out.fixed.qos = min(out.fixed.qos, sub.qos)
             out.fixed.dup = False
+            if out.protocol_version < 5:
+                out.properties = type(out.properties)()
             if out.fixed.qos > 0:
+                if len(client.inflight) >= self.capabilities.maximum_inflight:
+                    self.info.inflight_dropped += 1
+                    continue
                 try:
                     out.packet_id = client.next_packet_id()
                 except PacketIDExhausted:
@@ -679,8 +706,10 @@ class Broker:
                 out.created = now
                 client.inflight.set(out.copy())
                 self.info.inflight += 1
-            if out.protocol_version < 5:
-                out.properties = type(out.properties)()
+                if not client.inflight.take_send_quota():
+                    # respect the client's receive maximum [MQTT-3.3.4-9]
+                    client.held_pids.append(out.packet_id)
+                    continue
             if client.send(out):
                 self.hooks.notify("on_retain_published", client, out)
 

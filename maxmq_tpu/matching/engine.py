@@ -1,0 +1,220 @@
+"""Batched NFA matcher: JAX/XLA evaluation of the compiled subscription NFA.
+
+One scan step per topic level over the whole batch (the "sequence axis" of
+this workload — SURVEY.md section 2.3): the active node set advances through
+literal edges (vectorized open-addressing probes) and '+' edges, while '#'
+terminals OR their subscriber-bitmask rows into a per-topic accumulator.
+Static shapes throughout: fixed batch, fixed max levels, fixed active-set
+width, with per-topic overflow flags routing rare too-wide/too-deep topics to
+the exact CPU trie.
+
+Replaces the reference's lock-guarded recursive walk
+(vendor/github.com/mochi-co/mqtt/v2/topics.go:484-518) with a data-parallel
+batched evaluation designed for the MXU/VPU + HBM model.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .nfa import MAX_PROBES, NFATables, compile_trie, hash32
+from .trie import SubscriberSet, TopicIndex
+
+
+@partial(jax.jit, static_argnames=("width", "table_mask"))
+def match_batch_device(hash_node, hash_tok, hash_val, plus_child, node_mask,
+                       hash_mask, mask_pool, toks, lengths, dollar,
+                       width: int, table_mask: int):
+    """Match a tokenized topic batch against the device-resident NFA.
+
+    Args:
+      toks: int32[B, Lmax] level-token ids, -1 padded
+      lengths: int32[B] level counts (-1 = too deep -> overflow)
+      dollar: bool[B] first level begins with '$'
+    Returns:
+      acc: uint32[B, mask_words] subscriber-entry bitmask per topic
+      overflow: bool[B] active set exceeded `width` (needs CPU fallback)
+    """
+    batch, max_levels = toks.shape
+
+    active0 = jnp.full((batch, width), -1, dtype=jnp.int32).at[:, 0].set(0)
+    acc0 = jnp.zeros((batch, mask_pool.shape[1]), dtype=jnp.uint32)
+    overflow0 = lengths < 0
+
+    # Pad the token sequence with one trailing -1 column so the scan runs
+    # Lmax+1 steps: step L does the final (exact-depth) emission.
+    toks_t = jnp.concatenate(
+        [toks, jnp.full((batch, 1), -1, dtype=jnp.int32)], axis=1).T
+    level_ids = jnp.arange(max_levels + 1, dtype=jnp.int32)
+
+    def lookup_literal(active, tok):
+        """Vectorized (node, token) -> child via bounded linear probing.
+        active: [B, W], tok: [B, 1] broadcast over the active set."""
+        base = (hash32(active, tok) & jnp.uint32(table_mask)).astype(jnp.int32)
+        child = jnp.full_like(active, -1)
+        for p in range(MAX_PROBES):
+            slot = (base + p) & table_mask
+            hit = (hash_node[slot] == active) & (hash_tok[slot] == tok)
+            child = jnp.where((child < 0) & hit, hash_val[slot], child)
+        return child
+
+    def or_rows(acc, rows):
+        """acc |= OR over slots of mask_pool[rows]; row<0 hits zero-row 0."""
+        safe = jnp.maximum(rows, 0)
+        gathered = mask_pool[safe]            # [B, S, words]
+        reduced = jax.lax.reduce(gathered, np.uint32(0),
+                                 jax.lax.bitwise_or, (1,))
+        return acc | reduced
+
+    def step(carry, inputs):
+        active, acc, overflow = carry
+        tok, level = inputs                    # tok: [B], level: scalar
+        valid = active >= 0                    # [B, W]
+        not_done = level < lengths             # topic still has levels
+        at_end = level == lengths              # exact depth reached
+        # [MQTT-4.7.2-1]: '$'-topics never match root-level wildcards
+        wild_ok = ~(dollar & (level == 0))     # [B]
+
+        # '#'-terminal emission: matches at every prefix depth incl. parent
+        emit_hash = (not_done | at_end) & wild_ok
+        hash_rows = jnp.where(
+            valid & emit_hash[:, None],
+            hash_mask[jnp.maximum(active, 0)], -1)
+        self_rows = jnp.where(
+            valid & at_end[:, None],
+            node_mask[jnp.maximum(active, 0)], -1)
+        acc = or_rows(acc, jnp.concatenate([hash_rows, self_rows], axis=1))
+
+        # transitions (only for topics that still have levels)
+        lit = lookup_literal(jnp.maximum(active, 0), tok[:, None])
+        lit = jnp.where(valid & not_done[:, None], lit, -1)
+        plus = plus_child[jnp.maximum(active, 0)]
+        plus = jnp.where(valid & (not_done & wild_ok)[:, None], plus, -1)
+        cand = jnp.concatenate([lit, plus], axis=1)     # [B, 2W]
+
+        n_valid = jnp.sum((cand >= 0).astype(jnp.int32), axis=1)
+        overflow = overflow | (n_valid > width)
+        order = jnp.argsort(jnp.where(cand >= 0, 0, 1), axis=1, stable=True)
+        packed = jnp.take_along_axis(cand, order, axis=1)[:, :width]
+        active = jnp.where(not_done[:, None], packed, active)
+        return (active, acc, overflow), None
+
+    (_final, acc, overflow), _ = jax.lax.scan(
+        step, (active0, acc0, overflow0), (toks_t, level_ids))
+    return acc, overflow
+
+
+class NFAEngine:
+    """Device-resident matcher bound to a TopicIndex.
+
+    Compiles the trie into NFA tables, keeps them on the target device
+    (double-buffered: a publish sees either the old or new table, never a
+    torn one — the atomic swap the Go code gets from its root mutex), and
+    answers ``subscribers()`` with exact SubscriberSet semantics, falling
+    back to the CPU trie for overflow topics.
+    """
+
+    def __init__(self, index: TopicIndex, width: int = 32,
+                 max_levels: int = 16, device=None,
+                 auto_refresh: bool = True) -> None:
+        self.index = index
+        self.width = width
+        self.max_levels = max_levels
+        self.device = device
+        self.auto_refresh = auto_refresh
+        self._lock = threading.Lock()
+        self._tables: NFATables | None = None
+        self._device_tables = None
+        self.fallbacks = 0
+        self.matches = 0
+        self.refresh(force=True)
+
+    # ------------------------------------------------------------------
+
+    def refresh(self, force: bool = False) -> bool:
+        """Recompile + upload if the index changed. Cheap no-op otherwise."""
+        if (not force and self._tables is not None
+                and self._tables.version == self.index.version):
+            return False
+        tables = compile_trie(self.index)
+        arrays = (tables.hash_node, tables.hash_tok, tables.hash_val,
+                  tables.plus_child, tables.node_mask, tables.hash_mask,
+                  tables.mask_pool)
+        dev = [jax.device_put(a, self.device) for a in arrays]
+        with self._lock:
+            self._tables = tables
+            self._device_tables = dev
+        return True
+
+    @property
+    def tables(self) -> NFATables:
+        return self._tables
+
+    # ------------------------------------------------------------------
+
+    def match_raw(self, topics: list[str]):
+        """Device match of a topic batch. Returns (acc uint32[B, words],
+        overflow bool[B], tables) — the tables the batch actually ran on."""
+        if self.auto_refresh:
+            self.refresh()
+        with self._lock:
+            tables = self._tables
+            dev = self._device_tables
+        toks, lengths, dollar = tables.tokenize(topics, self.max_levels)
+        acc, overflow = match_batch_device(
+            *dev, jnp.asarray(toks), jnp.asarray(lengths),
+            jnp.asarray(dollar), width=self.width,
+            table_mask=tables.table_size - 1)
+        return np.asarray(acc), np.asarray(overflow), tables
+
+    def subscribers_batch(self, topics: list[str]) -> list[SubscriberSet]:
+        acc, overflow, tables = self.match_raw(topics)
+        out = []
+        for i, topic in enumerate(topics):
+            self.matches += 1
+            if overflow[i]:
+                self.fallbacks += 1
+                out.append(self.index.subscribers(topic))
+            else:
+                out.append(self.decode(acc[i], tables))
+        return out
+
+    def subscribers(self, topic: str) -> SubscriberSet:
+        """Single-topic match (the broker's pluggable-matcher entry point)."""
+        return self.subscribers_batch([topic])[0]
+
+    async def subscribers_async(self, topic: str) -> SubscriberSet:
+        """Event-loop-friendly match: recompiles (O(subs) Python + possible
+        XLA retrace) and matches in a worker thread so the broker's asyncio
+        loop never stalls behind the table swap."""
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, self.subscribers, topic)
+
+    @staticmethod
+    def decode(mask_words: np.ndarray, tables: NFATables) -> SubscriberSet:
+        """Unpack an entry bitmask into an exact SubscriberSet."""
+        result = SubscriberSet()
+        entries = tables.entries
+        for w in np.flatnonzero(mask_words):
+            bits = int(mask_words[w])
+            base = int(w) << 5
+            while bits:
+                low = bits & -bits
+                b = base + low.bit_length() - 1
+                bits ^= low
+                entry = entries[b]
+                if entry.shared:
+                    for cid, sub in entry.candidates.items():
+                        result.add_shared(entry.group, sub.filter, cid, sub)
+                else:
+                    sub = entry.subscription
+                    result.add(entry.client_id, sub, sub.filter)
+        return result

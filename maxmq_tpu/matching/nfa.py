@@ -1,0 +1,255 @@
+"""NFA compiler: flatten the subscription trie into level-indexed device
+tables for the batched TPU matcher.
+
+The compiled form (all numpy, moved to device by the engine):
+
+* literal edges -> open-addressing hash table keyed on (node, token):
+  ``hash_node/hash_tok/hash_val`` with linear probing bounded by MAX_PROBES
+  (the builder grows the table until every key probes within the bound)
+* ``plus_child[n]`` -> node id of the '+' child (-1 absent)
+* ``node_mask[n]`` / ``hash_mask[n]`` -> row in the bitmask pool holding the
+  subscribers of n itself / of n's '#' child (-1 none; '#' is always a leaf
+  per MQTT filter validity, so it needs no node of its own)
+* ``mask_pool[r]`` -> packed uint32 subscriber bitmask; bit b = entry b in
+  the entry table. Row 0 is all-zeros (gather target for "no mask").
+
+Each *bit* is one subscription entry — a (client, filter) pair for ordinary
+subscriptions, or one `$share` (group, filter) pair — so the host can
+reconstruct exact merge semantics (max QoS + id union) after matching.
+
+Parity surface: the trie this compiles mirrors
+vendor/github.com/mochi-co/mqtt/v2/topics.go's particle tree; the flattening
+itself is TPU-native design (see SURVEY.md section 7).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..protocol.packets import Subscription
+from .topics import parse_share, split_levels
+
+UNK = 0          # token id for levels never seen in any filter
+MAX_PROBES = 8   # linear-probe bound enforced at build time
+
+_MIX1 = np.uint32(0x9E3779B1)
+_MIX2 = np.uint32(0x85EBCA77)
+_MIX3 = np.uint32(0xC2B2AE35)
+
+
+def hash32(node, tok):
+    """Vectorizable (node, token) -> uint32 hash. The ONE definition shared
+    by the numpy builder and the jax kernel (numpy dtype scalars interoperate
+    with jnp arrays), so host and device can never diverge."""
+    with np.errstate(over="ignore"):  # uint32 wraparound is the point
+        h = node.astype(np.uint32) * _MIX1 + tok.astype(np.uint32) * _MIX2
+        h = h ^ (h >> np.uint32(15))
+        h = h * _MIX3
+        h = h ^ (h >> np.uint32(13))
+        return h
+
+
+def hash_slot(node, tok, table_mask):
+    """Builder-side slot index (numpy)."""
+    return (hash32(node, tok) & np.uint32(table_mask)).astype(np.int32)
+
+
+@dataclass
+class Entry:
+    """One subscriber bit: an ordinary (client, sub) or a shared pair."""
+
+    client_id: str = ""
+    subscription: Subscription | None = None
+    group: str = ""          # non-empty => shared pair
+    filter: str = ""
+    # shared pairs carry the full candidate map
+    candidates: dict[str, Subscription] = field(default_factory=dict)
+
+    @property
+    def shared(self) -> bool:
+        return bool(self.group)
+
+
+@dataclass
+class NFATables:
+    """The flattened matcher, plus the host-side decode table."""
+
+    n_nodes: int
+    hash_node: np.ndarray    # int32[H]
+    hash_tok: np.ndarray     # int32[H]
+    hash_val: np.ndarray     # int32[H]
+    plus_child: np.ndarray   # int32[N]
+    node_mask: np.ndarray    # int32[N]
+    hash_mask: np.ndarray    # int32[N]
+    mask_pool: np.ndarray    # uint32[R, W]
+    mask_words: int
+    vocab: dict[str, int]
+    entries: list[Entry]
+    version: int = -1
+
+    @property
+    def table_size(self) -> int:
+        return len(self.hash_node)
+
+    def tokenize(self, topics: list[str], max_levels: int):
+        """Host-side topic prep: token ids padded with -1, lengths, $-flags.
+        Topics deeper than max_levels report length -1 (engine falls back)."""
+        batch = len(topics)
+        toks = np.full((batch, max_levels), -1, dtype=np.int32)
+        lengths = np.zeros(batch, dtype=np.int32)
+        dollar = np.zeros(batch, dtype=bool)
+        vocab = self.vocab
+        for i, topic in enumerate(topics):
+            levels = split_levels(topic)
+            dollar[i] = topic.startswith("$")
+            if len(levels) > max_levels:
+                lengths[i] = -1
+                continue
+            lengths[i] = len(levels)
+            for j, level in enumerate(levels):
+                toks[i, j] = vocab.get(level, UNK)
+        return toks, lengths, dollar
+
+
+class _BuildNode:
+    __slots__ = ("children", "plus", "entry_bits", "hash_bits")
+
+    def __init__(self) -> None:
+        self.children: dict[str, _BuildNode] = {}
+        self.plus: _BuildNode | None = None
+        self.entry_bits: list[int] = []   # bits for subscribers at this node
+        self.hash_bits: list[int] = []    # bits for '#'-child subscribers
+
+
+def compile_trie(index, version: int | None = None) -> NFATables:
+    """Compile a TopicIndex (or anything with ``all_subscriptions()``) into
+    NFATables."""
+    # Read the version BEFORE snapshotting: a mutation racing the snapshot
+    # then stamps the tables older than the index, forcing one extra (safe)
+    # recompile rather than silently freezing stale tables.
+    if version is None:
+        version = getattr(index, "version", 0)
+    subs = index.all_subscriptions()
+    entries: list[Entry] = []
+    shared_bits: dict[tuple[str, str], int] = {}
+    root = _BuildNode()
+    vocab: dict[str, int] = {}
+
+    def intern(level: str) -> int:
+        tok = vocab.get(level)
+        if tok is None:
+            tok = len(vocab) + 1  # 0 is reserved for UNK
+            vocab[level] = tok
+        return tok
+
+    for filt, client_id, sub, group in subs:
+        # `filt` is the trie path: already '$share'-stripped for shared subs
+        levels = split_levels(filt)
+        terminal_is_hash = levels and levels[-1] == "#"
+        walk_levels = levels[:-1] if terminal_is_hash else levels
+        node = root
+        for level in walk_levels:
+            if level == "+":
+                if node.plus is None:
+                    node.plus = _BuildNode()
+                node = node.plus
+            else:
+                intern(level)
+                child = node.children.get(level)
+                if child is None:
+                    child = node.children[level] = _BuildNode()
+                node = child
+        if group:
+            key = (group, sub.filter)
+            bit = shared_bits.get(key)
+            if bit is None:
+                bit = len(entries)
+                shared_bits[key] = bit
+                entries.append(Entry(group=group, filter=sub.filter))
+            entries[bit].candidates[client_id] = sub
+        else:
+            bit = len(entries)
+            entries.append(Entry(client_id=client_id, subscription=sub,
+                                 filter=filt))
+        if terminal_is_hash:
+            node.hash_bits.append(bit)
+        else:
+            node.entry_bits.append(bit)
+
+    # ---- number nodes breadth-first --------------------------------------
+    nodes: list[_BuildNode] = [root]
+    order: dict[int, int] = {id(root): 0}
+    i = 0
+    while i < len(nodes):
+        node = nodes[i]
+        i += 1
+        for child in node.children.values():
+            order[id(child)] = len(nodes)
+            nodes.append(child)
+        if node.plus is not None:
+            order[id(node.plus)] = len(nodes)
+            nodes.append(node.plus)
+    n_nodes = len(nodes)
+
+    # ---- mask pool -------------------------------------------------------
+    n_bits = max(len(entries), 1)
+    mask_words = (n_bits + 31) // 32
+    rows: list[np.ndarray] = [np.zeros(mask_words, dtype=np.uint32)]
+
+    def mask_row(bits: list[int]) -> int:
+        if not bits:
+            return -1
+        row = np.zeros(mask_words, dtype=np.uint32)
+        for b in bits:
+            row[b >> 5] |= np.uint32(1) << np.uint32(b & 31)
+        rows.append(row)
+        return len(rows) - 1
+
+    plus_child = np.full(n_nodes, -1, dtype=np.int32)
+    node_mask = np.full(n_nodes, -1, dtype=np.int32)
+    hash_mask = np.full(n_nodes, -1, dtype=np.int32)
+    edges: list[tuple[int, int, int]] = []  # (node, token, child)
+    for node in nodes:
+        nid = order[id(node)]
+        if node.plus is not None:
+            plus_child[nid] = order[id(node.plus)]
+        node_mask[nid] = mask_row(node.entry_bits)
+        hash_mask[nid] = mask_row(node.hash_bits)
+        for level, child in node.children.items():
+            edges.append((nid, vocab[level], order[id(child)]))
+
+    # ---- open-addressing edge table --------------------------------------
+    size = 1
+    while size < max(len(edges) * 2, 8):
+        size *= 2
+    while True:
+        table_mask = size - 1
+        hash_node = np.full(size, -1, dtype=np.int32)
+        hash_tok = np.full(size, -1, dtype=np.int32)
+        hash_val = np.full(size, -1, dtype=np.int32)
+        ok = True
+        for nid, tok, child in edges:
+            h = int(hash_slot(np.int32(nid), np.int32(tok), table_mask))
+            for p in range(MAX_PROBES):
+                slot = (h + p) & table_mask
+                if hash_node[slot] == -1:
+                    hash_node[slot] = nid
+                    hash_tok[slot] = tok
+                    hash_val[slot] = child
+                    break
+            else:
+                ok = False
+                break
+        if ok:
+            break
+        size *= 2  # probe bound exceeded: grow and rebuild
+
+    return NFATables(
+        n_nodes=n_nodes,
+        hash_node=hash_node, hash_tok=hash_tok, hash_val=hash_val,
+        plus_child=plus_child, node_mask=node_mask, hash_mask=hash_mask,
+        mask_pool=np.stack(rows), mask_words=mask_words,
+        vocab=vocab, entries=entries, version=version,
+    )

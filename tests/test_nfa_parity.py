@@ -1,0 +1,181 @@
+"""Golden parity: the compiled NFA (JAX, CPU backend) must agree exactly with
+the CPU reference trie on randomized and adversarial filter/topic corpora —
+the TPU-build analogue of the reference's conformance suites."""
+
+import random
+
+import numpy as np
+import pytest
+
+from maxmq_tpu.matching import TopicIndex
+from maxmq_tpu.matching.engine import NFAEngine
+from maxmq_tpu.matching.nfa import compile_trie
+from maxmq_tpu.protocol import Subscription
+
+
+def normalize(ss):
+    """Comparable form of a SubscriberSet."""
+    subs = {cid: (s.qos, tuple(sorted(s.identifiers.items())))
+            for cid, s in ss.subscriptions.items()}
+    shared = {k: tuple(sorted(v)) for k, v in ss.shared.items()}
+    return subs, shared
+
+
+def check_parity(index, topics, **engine_kw):
+    engine = NFAEngine(index, **engine_kw)
+    got = engine.subscribers_batch(topics)
+    for topic, nfa_result in zip(topics, got):
+        trie_result = index.subscribers(topic)
+        assert normalize(nfa_result) == normalize(trie_result), (
+            f"mismatch on topic {topic!r}")
+    return engine
+
+
+def test_exact_and_wildcard_basics():
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="a/b/c", qos=1))
+    idx.subscribe("c2", Subscription(filter="a/+/c", qos=2))
+    idx.subscribe("c3", Subscription(filter="a/#"))
+    idx.subscribe("c4", Subscription(filter="#"))
+    idx.subscribe("c5", Subscription(filter="+"))
+    check_parity(idx, ["a/b/c", "a/x/c", "a", "a/b", "x", "x/y",
+                       "a/b/c/d", "$SYS/x", "$SYS"])
+
+
+def test_hash_parent_and_dollar_rules():
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="sport/tennis/#"))
+    idx.subscribe("c2", Subscription(filter="$SYS/#"))
+    idx.subscribe("c3", Subscription(filter="$SYS/+/x"))
+    idx.subscribe("c4", Subscription(filter="+/tennis/+"))
+    check_parity(idx, ["sport/tennis", "sport/tennis/p1", "sport",
+                       "$SYS/broker/x", "$SYS/broker", "$SYS",
+                       "a/tennis/b"])
+
+
+def test_empty_levels_and_unknown_tokens():
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="/"))
+    idx.subscribe("c2", Subscription(filter="//"))
+    idx.subscribe("c3", Subscription(filter="+/"))
+    idx.subscribe("c4", Subscription(filter="a//b"))
+    check_parity(idx, ["/", "//", "a//b", "never-seen-token/x", "a/b",
+                       "never/", "/"])
+
+
+def test_shared_subscriptions_parity():
+    idx = TopicIndex()
+    idx.subscribe("w1", Subscription(filter="$share/g1/t/+"))
+    idx.subscribe("w2", Subscription(filter="$share/g1/t/+"))
+    idx.subscribe("w3", Subscription(filter="$share/g2/t/a"))
+    idx.subscribe("n1", Subscription(filter="t/a", qos=1))
+    check_parity(idx, ["t/a", "t/b", "t", "x"])
+
+
+def test_overlap_merge_semantics():
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="m/+", qos=0, identifier=3))
+    idx.subscribe("c1", Subscription(filter="m/x", qos=2, identifier=9))
+    idx.subscribe("c1", Subscription(filter="m/#", qos=1, identifier=4))
+    check_parity(idx, ["m/x", "m/y", "m"])
+
+
+def test_overflow_falls_back_to_trie():
+    idx = TopicIndex()
+    # 8 overlapping '+' filters explode the active set beyond width=2
+    for i in range(8):
+        pattern = [("+" if (i >> b) & 1 else "L") for b in range(3)]
+        idx.subscribe(f"c{i}", Subscription(filter="/".join(pattern)))
+    engine = check_parity(idx, ["L/L/L"], width=2)
+    assert engine.fallbacks > 0  # exactness preserved through CPU fallback
+
+
+def test_too_deep_topic_falls_back():
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="a/#"))
+    deep = "a/" + "/".join(str(i) for i in range(40))
+    engine = check_parity(idx, [deep], max_levels=8)
+    assert engine.fallbacks == 1
+
+
+def test_incremental_refresh():
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="a/b"))
+    engine = NFAEngine(idx)
+    assert normalize(engine.subscribers("a/b"))[0].keys() == {"c1"}
+    idx.subscribe("c2", Subscription(filter="a/+"))
+    got = engine.subscribers("a/b")  # auto-refresh picks up the change
+    assert sorted(got.subscriptions) == ["c1", "c2"]
+    idx.unsubscribe("c1", "a/b")
+    got = engine.subscribers("a/b")
+    assert sorted(got.subscriptions) == ["c2"]
+
+
+def rand_corpus(rng, n_filters, n_clients, depth=5, alphabet=8):
+    tokens = [f"t{i}" for i in range(alphabet)]
+    filters = []
+    for _ in range(n_filters):
+        nlev = rng.randint(1, depth)
+        levels = []
+        for li in range(nlev):
+            r = rng.random()
+            if r < 0.15:
+                levels.append("+")
+            elif r < 0.22 and li == nlev - 1:
+                levels.append("#")
+            elif r < 0.25:
+                levels.append("")  # empty level
+            else:
+                levels.append(rng.choice(tokens))
+        f = "/".join(levels)
+        if rng.random() < 0.1:
+            f = f"$share/g{rng.randint(0, 2)}/{f}"
+        filters.append(f)
+    topics = []
+    for _ in range(n_filters):
+        nlev = rng.randint(1, depth + 1)
+        levels = [rng.choice(tokens + [""]) if rng.random() > 0.05
+                  else f"unseen{rng.randint(0, 9)}" for _ in range(nlev)]
+        t = "/".join(levels)
+        if rng.random() < 0.08:
+            t = "$" + t
+        topics.append(t)
+    return filters, topics
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_randomized_parity(seed):
+    rng = random.Random(seed)
+    idx = TopicIndex()
+    filters, topics = rand_corpus(rng, n_filters=120, n_clients=30)
+    from maxmq_tpu.matching.topics import valid_filter
+    for i, f in enumerate(filters):
+        if not valid_filter(f):
+            continue
+        idx.subscribe(f"c{i % 30}",
+                      Subscription(filter=f, qos=rng.randint(0, 2),
+                                   identifier=rng.randint(0, 5)))
+    check_parity(idx, topics)
+
+
+def test_compile_empty_index():
+    idx = TopicIndex()
+    engine = NFAEngine(idx)
+    res = engine.subscribers("anything/at/all")
+    assert len(res.subscriptions) == 0 and len(res.shared) == 0
+
+
+def test_hash_table_probe_bound():
+    """Builder must keep every edge within MAX_PROBES slots."""
+    idx = TopicIndex()
+    for i in range(500):
+        idx.subscribe("c", Subscription(filter=f"lvl{i}/x{i % 7}/end"))
+    tables = compile_trie(idx)
+    from maxmq_tpu.matching.nfa import MAX_PROBES, hash_slot
+    mask = tables.table_size - 1
+    occupied = np.flatnonzero(tables.hash_node >= 0)
+    for slot in occupied:
+        n, t = tables.hash_node[slot], tables.hash_tok[slot]
+        base = int(hash_slot(np.int32(n), np.int32(t), mask))
+        dist = (int(slot) - base) & mask
+        assert dist < MAX_PROBES
