@@ -1,0 +1,106 @@
+"""Headline benchmark: batched wildcard topic-match throughput.
+
+Measures BASELINE.json config #3 — mixed `+`/`#` wildcard tree, 100K subs,
+deep hierarchies — on the dense leveled matcher (maxmq_tpu/matching/
+dense.py, the production TPU path replacing the reference's
+`TopicsIndex.Subscribers`, vendor/github.com/mochi-co/mqtt/v2/
+topics.go:484-518). Timed region = host tokenization + ONE pipelined
+device dispatch over all micro-batches + host fetch of the sparse match
+words; compile excluded; decode to client sets is per-delivery work
+outside the matcher.
+
+`vs_baseline` is measured against the in-process Go trie rate implied by
+BASELINE.json's north star ("≥10M matches/sec ... ≥20x the in-process Go
+trie" => Go trie ≈ 500K matches/sec; no Go toolchain in this image to
+measure it directly).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Env knobs: MAXMQ_BENCH_SUBS, MAXMQ_BENCH_BATCH, MAXMQ_BENCH_ITERS.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import time
+
+GO_TRIE_BASELINE = 500_000.0  # matches/sec, see module docstring
+
+
+def build_corpus(n_subs: int, seed: int = 42):
+    """Config #3: mixed +/# wildcard filters over a deep a/b/c/d/e-style
+    hierarchy, plus the matching publish-topic generator."""
+    rng = random.Random(seed)
+    alphabet = [f"{c}{i}" for c in "abcdefgh" for i in range(12)]
+
+    filters = []
+    for _ in range(n_subs):
+        depth = rng.randint(3, 8)
+        levels = [rng.choice(alphabet) for _ in range(depth)]
+        r = rng.random()
+        if r < 0.3:                       # single-level wildcard(s)
+            for _ in range(rng.randint(1, 2)):
+                levels[rng.randrange(depth)] = "+"
+        elif r < 0.45:                    # multi-level terminal wildcard
+            levels = levels[: rng.randint(1, depth)] + ["#"]
+        filters.append("/".join(levels))
+
+    def topics(batch: int, seed2: int):
+        r2 = random.Random(seed2)
+        return ["/".join(r2.choice(alphabet)
+                         for _ in range(r2.randint(3, 8)))
+                for _ in range(batch)]
+
+    return filters, topics
+
+
+def main() -> None:
+    n_subs = int(os.environ.get("MAXMQ_BENCH_SUBS", 100_000))
+    batch = int(os.environ.get("MAXMQ_BENCH_BATCH", 8192))
+    iters = int(os.environ.get("MAXMQ_BENCH_ITERS", 30))
+
+    import jax
+
+    from maxmq_tpu.matching.dense import DenseEngine
+    from maxmq_tpu.matching.trie import TopicIndex
+    from maxmq_tpu.protocol.packets import Subscription
+
+    filters, topic_gen = build_corpus(n_subs)
+    index = TopicIndex()
+    for i, filt in enumerate(filters):
+        index.subscribe(f"cl-{i}", Subscription(filter=filt, qos=i % 3))
+
+    engine = DenseEngine(index, max_levels=10, auto_refresh=False)
+
+    batches = [topic_gen(batch, seed2=100 + i) for i in range(iters)]
+
+    # warmup: trigger compile at the exact pipeline shape
+    _, _, overflow, _ = engine.match_raw_many(batches)
+    n_over = int(overflow.sum())
+    # timed region = host tokenization + ONE pipelined device dispatch
+    # (lax.scan over the stacked micro-batches) + host fetch of the sparse
+    # match words — the production fan-out path end to end.
+    t0 = time.perf_counter()
+    word_idx, word_val, overflow, _ = engine.match_raw_many(batches)
+    word_idx.sum()
+    dt = time.perf_counter() - t0
+
+    rate = batch * iters / dt
+    result = {
+        "metric": "wildcard_topic_matches_per_sec_100k_subs",
+        "value": round(rate, 1),
+        "unit": "matches/sec",
+        "vs_baseline": round(rate / GO_TRIE_BASELINE, 3),
+        "detail": {
+            "subs": n_subs, "batch": batch, "iters": iters,
+            "overflow_fallbacks_warmup": n_over,
+            "backend": jax.default_backend(),
+            "devices": len(jax.devices()),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -2,15 +2,21 @@
 
 One scan step per topic level over the whole batch (the "sequence axis" of
 this workload — SURVEY.md section 2.3): the active node set advances through
-literal edges (vectorized open-addressing probes) and '+' edges, while '#'
-terminals OR their subscriber-bitmask rows into a per-topic accumulator.
-Static shapes throughout: fixed batch, fixed max levels, fixed active-set
-width, with per-topic overflow flags routing rare too-wide/too-deep topics to
-the exact CPU trie.
+literal edges (vectorized open-addressing probes) and '+' edges, while
+subscriber-carrying nodes emit their *row ids* into the scan output. A
+post-scan sort compacts the emitted ids into at most ``max_rows`` matches
+per topic; the host unions the rows' entry lists (NFATables.row_entries).
+
+The output is deliberately sparse — matched row ids, not bitmasks: a dense
+bitmask over 1M subscriptions is 125KB per publish and HBM-bandwidth-bound,
+while matched rows are a few dozen int32s. Static shapes throughout: fixed
+batch, fixed max levels, fixed active-set width, fixed max_rows, with
+per-topic overflow flags routing rare too-wide/too-deep topics to the exact
+CPU trie.
 
 Replaces the reference's lock-guarded recursive walk
 (vendor/github.com/mochi-co/mqtt/v2/topics.go:484-518) with a data-parallel
-batched evaluation designed for the MXU/VPU + HBM model.
+batched evaluation designed for the VPU + HBM model.
 """
 
 from __future__ import annotations
@@ -26,26 +32,39 @@ import jax.numpy as jnp
 from .nfa import MAX_PROBES, NFATables, compile_trie, hash32
 from .trie import SubscriberSet, TopicIndex
 
+_I32_MAX = np.int32(np.iinfo(np.int32).max)
 
-@partial(jax.jit, static_argnames=("width", "table_mask"))
-def match_batch_device(hash_node, hash_tok, hash_val, plus_child, node_mask,
-                       hash_mask, mask_pool, toks, lengths, dollar,
-                       width: int, table_mask: int):
-    """Match a tokenized topic batch against the device-resident NFA.
+
+def match_batch_body(hash_node, hash_tok, hash_val, plus_child, node_mask,
+                     hash_mask, toks, lengths, dollar,
+                     width: int, table_mask: int, max_rows: int,
+                     mesh_axes: tuple = ()):
+    """Traceable body of the batched NFA match (no jit wrapper, so the
+    sharded matcher in ``parallel/sharded.py`` can re-trace it inside a
+    ``shard_map``).
 
     Args:
       toks: int32[B, Lmax] level-token ids, -1 padded
       lengths: int32[B] level counts (-1 = too deep -> overflow)
       dollar: bool[B] first level begins with '$'
     Returns:
-      acc: uint32[B, mask_words] subscriber-entry bitmask per topic
-      overflow: bool[B] active set exceeded `width` (needs CPU fallback)
+      rows: int32[B, max_rows] matched row ids, ascending, -1 padded
+      overflow: bool[B] active set exceeded `width`, topic too deep, or
+        matches exceeded `max_rows` (caller falls back to the CPU trie)
     """
     batch, max_levels = toks.shape
 
     active0 = jnp.full((batch, width), -1, dtype=jnp.int32).at[:, 0].set(0)
-    acc0 = jnp.zeros((batch, mask_pool.shape[1]), dtype=jnp.uint32)
     overflow0 = lengths < 0
+    if mesh_axes:
+        # Under shard_map the scan carry must be typed as device-varying
+        # over the mesh axes from step 0 (the step fn mixes in sharded
+        # inputs), or the vma checker rejects the scan.
+        def vary(x):
+            need = tuple(a for a in mesh_axes if a not in jax.typeof(x).vma)
+            return jax.lax.pcast(x, need, to="varying") if need else x
+
+        active0, overflow0 = vary(active0), vary(overflow0)
 
     # Pad the token sequence with one trailing -1 column so the scan runs
     # Lmax+1 steps: step L does the final (exact-depth) emission.
@@ -64,16 +83,8 @@ def match_batch_device(hash_node, hash_tok, hash_val, plus_child, node_mask,
             child = jnp.where((child < 0) & hit, hash_val[slot], child)
         return child
 
-    def or_rows(acc, rows):
-        """acc |= OR over slots of mask_pool[rows]; row<0 hits zero-row 0."""
-        safe = jnp.maximum(rows, 0)
-        gathered = mask_pool[safe]            # [B, S, words]
-        reduced = jax.lax.reduce(gathered, np.uint32(0),
-                                 jax.lax.bitwise_or, (1,))
-        return acc | reduced
-
     def step(carry, inputs):
-        active, acc, overflow = carry
+        active, overflow = carry
         tok, level = inputs                    # tok: [B], level: scalar
         valid = active >= 0                    # [B, W]
         not_done = level < lengths             # topic still has levels
@@ -89,7 +100,7 @@ def match_batch_device(hash_node, hash_tok, hash_val, plus_child, node_mask,
         self_rows = jnp.where(
             valid & at_end[:, None],
             node_mask[jnp.maximum(active, 0)], -1)
-        acc = or_rows(acc, jnp.concatenate([hash_rows, self_rows], axis=1))
+        rows = jnp.concatenate([hash_rows, self_rows], axis=1)  # [B, 2W]
 
         # transitions (only for topics that still have levels)
         lit = lookup_literal(jnp.maximum(active, 0), tok[:, None])
@@ -103,11 +114,27 @@ def match_batch_device(hash_node, hash_tok, hash_val, plus_child, node_mask,
         order = jnp.argsort(jnp.where(cand >= 0, 0, 1), axis=1, stable=True)
         packed = jnp.take_along_axis(cand, order, axis=1)[:, :width]
         active = jnp.where(not_done[:, None], packed, active)
-        return (active, acc, overflow), None
+        return (active, overflow), rows
 
-    (_final, acc, overflow), _ = jax.lax.scan(
-        step, (active0, acc0, overflow0), (toks_t, level_ids))
-    return acc, overflow
+    (_active, overflow), emitted = jax.lax.scan(
+        step, (active0, overflow0), (toks_t, level_ids))
+
+    # emitted: [L+1, B, 2W] row ids (-1 = none). Compact per topic: sort
+    # ascending with -1 mapped to +inf, keep the first max_rows.
+    emitted = jnp.moveaxis(emitted, 0, 1).reshape(batch, -1)
+    emitted = jnp.where(emitted < 0, _I32_MAX, emitted)
+    emitted = jax.lax.sort(emitted, dimension=1)
+    n_matched = jnp.sum((emitted != _I32_MAX).astype(jnp.int32), axis=1)
+    overflow = overflow | (n_matched > max_rows)
+    rows = emitted[:, :max_rows]
+    rows = jnp.where(rows == _I32_MAX, -1, rows)
+    return rows, overflow
+
+
+match_batch_device = partial(
+    jax.jit,
+    static_argnames=("width", "table_mask", "max_rows", "mesh_axes"))(
+    match_batch_body)
 
 
 class NFAEngine:
@@ -121,11 +148,12 @@ class NFAEngine:
     """
 
     def __init__(self, index: TopicIndex, width: int = 32,
-                 max_levels: int = 16, device=None,
+                 max_levels: int = 16, max_rows: int = 128, device=None,
                  auto_refresh: bool = True) -> None:
         self.index = index
         self.width = width
         self.max_levels = max_levels
+        self.max_rows = max_rows
         self.device = device
         self.auto_refresh = auto_refresh
         self._lock = threading.Lock()
@@ -144,8 +172,7 @@ class NFAEngine:
             return False
         tables = compile_trie(self.index)
         arrays = (tables.hash_node, tables.hash_tok, tables.hash_val,
-                  tables.plus_child, tables.node_mask, tables.hash_mask,
-                  tables.mask_pool)
+                  tables.plus_child, tables.node_mask, tables.hash_mask)
         dev = [jax.device_put(a, self.device) for a in arrays]
         with self._lock:
             self._tables = tables
@@ -159,7 +186,7 @@ class NFAEngine:
     # ------------------------------------------------------------------
 
     def match_raw(self, topics: list[str]):
-        """Device match of a topic batch. Returns (acc uint32[B, words],
+        """Device match of a topic batch. Returns (rows int32[B, max_rows],
         overflow bool[B], tables) — the tables the batch actually ran on."""
         if self.auto_refresh:
             self.refresh()
@@ -167,14 +194,14 @@ class NFAEngine:
             tables = self._tables
             dev = self._device_tables
         toks, lengths, dollar = tables.tokenize(topics, self.max_levels)
-        acc, overflow = match_batch_device(
+        rows, overflow = match_batch_device(
             *dev, jnp.asarray(toks), jnp.asarray(lengths),
             jnp.asarray(dollar), width=self.width,
-            table_mask=tables.table_size - 1)
-        return np.asarray(acc), np.asarray(overflow), tables
+            table_mask=tables.table_size - 1, max_rows=self.max_rows)
+        return np.asarray(rows), np.asarray(overflow), tables
 
     def subscribers_batch(self, topics: list[str]) -> list[SubscriberSet]:
-        acc, overflow, tables = self.match_raw(topics)
+        rows, overflow, tables = self.match_raw(topics)
         out = []
         for i, topic in enumerate(topics):
             self.matches += 1
@@ -182,7 +209,7 @@ class NFAEngine:
                 self.fallbacks += 1
                 out.append(self.index.subscribers(topic))
             else:
-                out.append(self.decode(acc[i], tables))
+                out.append(self.decode(rows[i], tables))
         return out
 
     def subscribers(self, topic: str) -> SubscriberSet:
@@ -199,17 +226,16 @@ class NFAEngine:
         return await loop.run_in_executor(None, self.subscribers, topic)
 
     @staticmethod
-    def decode(mask_words: np.ndarray, tables: NFATables) -> SubscriberSet:
-        """Unpack an entry bitmask into an exact SubscriberSet."""
-        result = SubscriberSet()
+    def decode(row_ids: np.ndarray, tables: NFATables,
+               into: SubscriberSet | None = None) -> SubscriberSet:
+        """Union the matched rows' entry lists into an exact SubscriberSet."""
+        result = SubscriberSet() if into is None else into
         entries = tables.entries
-        for w in np.flatnonzero(mask_words):
-            bits = int(mask_words[w])
-            base = int(w) << 5
-            while bits:
-                low = bits & -bits
-                b = base + low.bit_length() - 1
-                bits ^= low
+        row_entries = tables.row_entries
+        for r in row_ids:
+            if r < 0:
+                break  # -1 padding is sorted to the tail
+            for b in row_entries[r]:
                 entry = entries[b]
                 if entry.shared:
                     for cid, sub in entry.candidates.items():

@@ -7,15 +7,21 @@ The compiled form (all numpy, moved to device by the engine):
   ``hash_node/hash_tok/hash_val`` with linear probing bounded by MAX_PROBES
   (the builder grows the table until every key probes within the bound)
 * ``plus_child[n]`` -> node id of the '+' child (-1 absent)
-* ``node_mask[n]`` / ``hash_mask[n]`` -> row in the bitmask pool holding the
-  subscribers of n itself / of n's '#' child (-1 none; '#' is always a leaf
-  per MQTT filter validity, so it needs no node of its own)
-* ``mask_pool[r]`` -> packed uint32 subscriber bitmask; bit b = entry b in
-  the entry table. Row 0 is all-zeros (gather target for "no mask").
+* ``node_mask[n]`` / ``hash_mask[n]`` -> *row id* for the subscriber set of
+  n itself / of n's '#' child (-1 none; '#' is always a leaf per MQTT
+  filter validity, so it needs no node of its own)
+* ``row_entries[r]`` -> host-side tuple of entry indices for row r. The
+  device never materializes subscriber bitmasks: the matcher returns the
+  (few) matched row ids per topic and the host unions the entry lists.
+  Row 0 is reserved empty.
 
-Each *bit* is one subscription entry — a (client, filter) pair for ordinary
+Each *entry* is one subscription — a (client, filter) pair for ordinary
 subscriptions, or one `$share` (group, filter) pair — so the host can
 reconstruct exact merge semantics (max QoS + id union) after matching.
+
+Sparse row-id output is what makes the target scale reachable: a dense
+1M-subscription bitmask is 125KB per publish (HBM-bandwidth-bound at
+~10M matches/sec), while matched rows are a few dozen int32s.
 
 Parity surface: the trie this compiles mirrors
 vendor/github.com/mochi-co/mqtt/v2/topics.go's particle tree; the flattening
@@ -83,8 +89,7 @@ class NFATables:
     plus_child: np.ndarray   # int32[N]
     node_mask: np.ndarray    # int32[N]
     hash_mask: np.ndarray    # int32[N]
-    mask_pool: np.ndarray    # uint32[R, W]
-    mask_words: int
+    row_entries: list[tuple[int, ...]]   # row id -> entry indices
     vocab: dict[str, int]
     entries: list[Entry]
     version: int = -1
@@ -123,6 +128,11 @@ class _BuildNode:
         self.hash_bits: list[int] = []    # bits for '#'-child subscribers
 
 
+class TableFull(Exception):
+    """A fixed-size edge table could not place every edge within the probe
+    bound (caller should grow the size and retry)."""
+
+
 def compile_trie(index, version: int | None = None) -> NFATables:
     """Compile a TopicIndex (or anything with ``all_subscriptions()``) into
     NFATables."""
@@ -131,11 +141,27 @@ def compile_trie(index, version: int | None = None) -> NFATables:
     # recompile rather than silently freezing stale tables.
     if version is None:
         version = getattr(index, "version", 0)
-    subs = index.all_subscriptions()
+    return compile_subscriptions(index.all_subscriptions(), version)
+
+
+def compile_subscriptions(subs, version: int = 0,
+                          table_size: int | None = None,
+                          vocab: dict[str, int] | None = None) -> NFATables:
+    """Compile a subscription list (as produced by
+    ``TopicIndex.all_subscriptions()``) into NFATables.
+
+    ``table_size`` fixes the edge-table size (power of two) — the sharded
+    engine uses this to give every mesh shard identically-shaped tables;
+    raises TableFull if the edges don't fit within the probe bound.
+    ``vocab`` shares one token-intern dict across shard compiles so the
+    same level string gets the same token id in every shard (topics are
+    tokenized once and replicated over the 'subs' mesh axis).
+    """
     entries: list[Entry] = []
     shared_bits: dict[tuple[str, str], int] = {}
     root = _BuildNode()
-    vocab: dict[str, int] = {}
+    if vocab is None:
+        vocab = {}
 
     def intern(level: str) -> int:
         tok = vocab.get(level)
@@ -164,11 +190,14 @@ def compile_trie(index, version: int | None = None) -> NFATables:
         if group:
             key = (group, sub.filter)
             bit = shared_bits.get(key)
-            if bit is None:
+            fresh = bit is None
+            if fresh:
                 bit = len(entries)
                 shared_bits[key] = bit
                 entries.append(Entry(group=group, filter=sub.filter))
             entries[bit].candidates[client_id] = sub
+            if not fresh:
+                continue  # the group's bit is already on the node
         else:
             bit = len(entries)
             entries.append(Entry(client_id=client_id, subscription=sub,
@@ -193,18 +222,13 @@ def compile_trie(index, version: int | None = None) -> NFATables:
             nodes.append(node.plus)
     n_nodes = len(nodes)
 
-    # ---- mask pool -------------------------------------------------------
-    n_bits = max(len(entries), 1)
-    mask_words = (n_bits + 31) // 32
-    rows: list[np.ndarray] = [np.zeros(mask_words, dtype=np.uint32)]
+    # ---- row table (host-side decode lists) ------------------------------
+    rows: list[tuple[int, ...]] = [()]   # row 0 reserved empty
 
     def mask_row(bits: list[int]) -> int:
         if not bits:
             return -1
-        row = np.zeros(mask_words, dtype=np.uint32)
-        for b in bits:
-            row[b >> 5] |= np.uint32(1) << np.uint32(b & 31)
-        rows.append(row)
+        rows.append(tuple(bits))
         return len(rows) - 1
 
     plus_child = np.full(n_nodes, -1, dtype=np.int32)
@@ -221,9 +245,12 @@ def compile_trie(index, version: int | None = None) -> NFATables:
             edges.append((nid, vocab[level], order[id(child)]))
 
     # ---- open-addressing edge table --------------------------------------
-    size = 1
-    while size < max(len(edges) * 2, 8):
-        size *= 2
+    if table_size is None:
+        size = 1
+        while size < max(len(edges) * 2, 8):
+            size *= 2
+    else:
+        size = table_size
     while True:
         table_mask = size - 1
         hash_node = np.full(size, -1, dtype=np.int32)
@@ -244,12 +271,14 @@ def compile_trie(index, version: int | None = None) -> NFATables:
                 break
         if ok:
             break
+        if table_size is not None:
+            raise TableFull(size)
         size *= 2  # probe bound exceeded: grow and rebuild
 
     return NFATables(
         n_nodes=n_nodes,
         hash_node=hash_node, hash_tok=hash_tok, hash_val=hash_val,
         plus_child=plus_child, node_mask=node_mask, hash_mask=hash_mask,
-        mask_pool=np.stack(rows), mask_words=mask_words,
+        row_entries=rows,
         vocab=vocab, entries=entries, version=version,
     )

@@ -1,0 +1,147 @@
+"""Golden parity for the dense leveled matcher (the production TPU path):
+must agree exactly with the CPU reference trie on the same corpora the NFA
+matcher is held to."""
+
+import random
+
+import pytest
+
+from maxmq_tpu.matching import TopicIndex
+from maxmq_tpu.matching.dense import DenseEngine
+from maxmq_tpu.protocol import Subscription
+
+from test_nfa_parity import normalize, rand_corpus
+
+
+def check_parity(index, topics, **engine_kw):
+    engine = DenseEngine(index, **engine_kw)
+    got = engine.subscribers_batch(topics)
+    for topic, result in zip(topics, got):
+        want = index.subscribers(topic)
+        assert normalize(result) == normalize(want), (
+            f"mismatch on topic {topic!r}")
+    return engine
+
+
+def test_exact_and_wildcard_basics():
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="a/b/c", qos=1))
+    idx.subscribe("c2", Subscription(filter="a/+/c", qos=2))
+    idx.subscribe("c3", Subscription(filter="a/#"))
+    idx.subscribe("c4", Subscription(filter="#"))
+    idx.subscribe("c5", Subscription(filter="+"))
+    check_parity(idx, ["a/b/c", "a/x/c", "a", "a/b", "x", "x/y",
+                       "a/b/c/d", "$SYS/x", "$SYS"])
+
+
+def test_hash_parent_and_dollar_rules():
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="sport/tennis/#"))
+    idx.subscribe("c2", Subscription(filter="$SYS/#"))
+    idx.subscribe("c3", Subscription(filter="$SYS/+/x"))
+    idx.subscribe("c4", Subscription(filter="+/tennis/+"))
+    check_parity(idx, ["sport/tennis", "sport/tennis/p1", "sport",
+                       "$SYS/broker/x", "$SYS/broker", "$SYS",
+                       "a/tennis/b"])
+
+
+def test_empty_levels_and_unknown_tokens():
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="/"))
+    idx.subscribe("c2", Subscription(filter="//"))
+    idx.subscribe("c3", Subscription(filter="+/"))
+    idx.subscribe("c4", Subscription(filter="a//b"))
+    check_parity(idx, ["/", "//", "a//b", "never-seen-token/x", "a/b",
+                       "never/", "/"])
+
+
+def test_shared_subscriptions_parity():
+    idx = TopicIndex()
+    idx.subscribe("w1", Subscription(filter="$share/g1/t/+"))
+    idx.subscribe("w2", Subscription(filter="$share/g1/t/+"))
+    idx.subscribe("w3", Subscription(filter="$share/g2/t/a"))
+    idx.subscribe("n1", Subscription(filter="t/a", qos=1))
+    check_parity(idx, ["t/a", "t/b", "t", "x"])
+
+
+def test_overlap_merge_semantics():
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="m/+", qos=0, identifier=3))
+    idx.subscribe("c1", Subscription(filter="m/x", qos=2, identifier=9))
+    idx.subscribe("c1", Subscription(filter="m/#", qos=1, identifier=4))
+    check_parity(idx, ["m/x", "m/y", "m"])
+
+
+def test_too_deep_topic_falls_back():
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="a/#"))
+    deep = "a/" + "/".join(str(i) for i in range(40))
+    engine = check_parity(idx, [deep], max_levels=8)
+    assert engine.fallbacks == 1
+
+
+def test_word_overflow_falls_back():
+    idx = TopicIndex()
+    # 33+ distinct matching rows spread over >max_words words
+    for i in range(64):
+        idx.subscribe(f"c{i}", Subscription(filter=f"x/{i}/+"))
+        idx.subscribe(f"d{i}", Subscription(filter=f"x/{i}/y"))
+    engine = DenseEngine(idx, max_words=2)
+    got = engine.subscribers("x/5/y")
+    want = idx.subscribers("x/5/y")
+    assert normalize(got) == normalize(want)
+
+
+def test_incremental_refresh():
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="a/b"))
+    engine = DenseEngine(idx)
+    assert normalize(engine.subscribers("a/b"))[0].keys() == {"c1"}
+    idx.subscribe("c2", Subscription(filter="a/+"))
+    got = engine.subscribers("a/b")  # auto-refresh picks up the change
+    assert sorted(got.subscriptions) == ["c1", "c2"]
+    idx.unsubscribe("c1", "a/b")
+    got = engine.subscribers("a/b")
+    assert sorted(got.subscriptions) == ["c2"]
+
+
+def test_hash_at_max_levels_boundary():
+    # '#' at level index == max_levels must still parent-match the
+    # exactly-max_levels-deep topic (regression: the level loop used to
+    # stop one short and silently return empty)
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="l0/l1/l2/l3/#"))
+    engine = DenseEngine(idx, max_levels=4)
+    got = engine.subscribers("l0/l1/l2/l3")
+    assert sorted(got.subscriptions) == ["c1"]
+    assert engine.fallbacks == 0
+
+
+def test_shared_group_rows_deduplicated():
+    idx = TopicIndex()
+    for i in range(5):
+        idx.subscribe(f"w{i}", Subscription(filter="$share/g1/t/+"))
+    engine = DenseEngine(idx)
+    rows = [r for r in engine.tables.row_entries if r]
+    assert rows == [(0,)]  # one entry bit for the whole group, no dupes
+
+
+def test_empty_index():
+    idx = TopicIndex()
+    engine = DenseEngine(idx)
+    assert len(engine.subscribers("a/b")) == 0
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4])
+def test_randomized_parity(seed):
+    rng = random.Random(seed)
+    idx = TopicIndex()
+    filters, topics = rand_corpus(rng, n_filters=120, n_clients=30)
+    from maxmq_tpu.matching.topics import valid_filter
+    for i, f in enumerate(filters):
+        if not valid_filter(f):
+            continue
+        idx.subscribe(f"c{i % 30}",
+                      Subscription(filter=f, qos=rng.randint(0, 2),
+                                   identifier=rng.randint(0, 5)))
+    check_parity(idx, topics)

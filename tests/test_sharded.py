@@ -1,0 +1,93 @@
+"""Cluster-mode matcher: shard_map parity on the 8-device CPU mesh."""
+
+import random
+
+import pytest
+
+import jax
+
+from maxmq_tpu.matching.trie import TopicIndex
+from maxmq_tpu.parallel.sharded import ShardedNFAEngine, make_mesh
+from maxmq_tpu.protocol.packets import Subscription
+
+ALPHABET = ["alpha", "beta", "gamma", "delta", "eps", "zeta"]
+
+
+def random_corpus(n_filters, n_topics, seed):
+    rng = random.Random(seed)
+
+    def filt():
+        depth = rng.randint(1, 6)
+        levels = [rng.choice(ALPHABET) for _ in range(depth)]
+        r = rng.random()
+        if r < 0.3:
+            levels[rng.randrange(depth)] = "+"
+        elif r < 0.45:
+            levels = levels[: rng.randint(1, depth)] + ["#"]
+        f = "/".join(levels)
+        if rng.random() < 0.15:
+            f = f"$share/grp{rng.randint(0, 2)}/{f}"
+        return f
+
+    filters = [filt() for _ in range(n_filters)]
+    topics = ["/".join(rng.choice(ALPHABET)
+                       for _ in range(rng.randint(1, 6)))
+              for _ in range(n_topics)]
+    topics += ["$SYS/broker/load", "a//b", "/leading"]
+    return filters, topics
+
+
+def build_index(filters):
+    index = TopicIndex()
+    for i, f in enumerate(filters):
+        index.subscribe(f"c{i}", Subscription(filter=f, qos=i % 3))
+    return index
+
+
+def assert_same(got, want, topic):
+    assert set(got.subscriptions) == set(want.subscriptions), topic
+    for cid, sub in want.subscriptions.items():
+        assert got.subscriptions[cid].qos == sub.qos, (topic, cid)
+    assert set(got.shared) == set(want.shared), topic
+    for key, members in want.shared.items():
+        assert set(got.shared[key]) == set(members), (topic, key)
+
+
+@pytest.mark.parametrize("shape", [(1, 8), (2, 4), (4, 2)])
+def test_sharded_parity_vs_trie(shape):
+    filters, topics = random_corpus(300, 64, seed=shape[0] * 31 + shape[1])
+    index = build_index(filters)
+    mesh = make_mesh(shape=shape)
+    engine = ShardedNFAEngine(index, mesh=mesh, width=32, max_levels=8)
+    got = engine.subscribers_batch(topics)
+    for topic, s in zip(topics, got):
+        assert_same(s, index.subscribers(topic), topic)
+
+
+def test_sharded_tracks_index_mutations():
+    filters, topics = random_corpus(50, 16, seed=9)
+    index = build_index(filters)
+    engine = ShardedNFAEngine(index, width=32, max_levels=8)
+    index.subscribe("late", Subscription(filter="alpha/#", qos=1))
+    got = engine.subscribers("alpha/beta")
+    assert "late" in got.subscriptions
+
+
+def test_make_mesh_default_shape():
+    mesh = make_mesh()
+    assert mesh.devices.size == len(jax.devices())
+    assert set(mesh.axis_names) == {"data", "subs"}
+
+
+def test_graft_entry_single_chip():
+    import __graft_entry__ as ge
+
+    fn, example_args = ge.entry()
+    acc, overflow = fn(*example_args)
+    assert acc.shape[0] == example_args[0].shape[0]
+
+
+def test_graft_entry_multichip():
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(8)
