@@ -1,0 +1,7 @@
+"""``python -m maxmq_tpu`` — the process entry point (cmd/maxmq/main.go)."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,0 +1,192 @@
+"""Process bootstrap: config → logger → broker + metrics server → run until
+signalled.
+
+Parity surface: internal/cli/start.go in the reference — ``runServer``
+(start.go:111-181) loads config, builds the snowflake-ID logger, spawns the
+metrics and MQTT servers concurrently, waits for SIGINT/SIGTERM
+(start.go:69-77), and optionally writes CPU/heap profiles (128-137,165-180).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import signal
+
+from .broker import Broker, BrokerOptions, Capabilities, TCPListener
+from .broker.listeners import HTTPStatsListener, UnixListener, WSListener
+from .hooks import AllowHook
+from .hooks.logging import LoggingHook
+from .hooks.storage import MemoryStore, SQLiteStore, StorageHook
+from .metrics import MetricsServer, Registry, register_broker_metrics
+from .utils.config import Config, config_as_dict
+from .utils.logger import Logger
+from .utils.snowflake import Snowflake
+
+BANNER = r"""
+  __  __            __  __  ___    _____ ___ _   _
+ |  \/  | __ ___  _|  \/  |/ _ \  |_   _| _ \ | | |
+ | |\/| |/ _` \ \/ / |\/| | (_) |   | | |  _/ |_| |
+ |_|  |_|\__,_|_|\_\_|  |_|\__\_\   |_| |_|  \___/
+        TPU-native MQTT broker
+"""
+
+
+def capabilities_from_config(conf: Config) -> Capabilities:
+    """Map the flat config onto broker capabilities, the way the reference's
+    facade maps its Config into mochi Capabilities (internal/mqtt/
+    server.go:76-91)."""
+    return Capabilities(
+        maximum_session_expiry_interval=conf.mqtt_session_expiry_interval,
+        maximum_message_expiry_interval=conf.mqtt_max_message_expiry_interval,
+        receive_maximum=conf.mqtt_receive_maximum,
+        maximum_qos=conf.mqtt_max_qos,
+        retain_available=conf.mqtt_retain_available,
+        maximum_packet_size=conf.mqtt_max_packet_size,
+        topic_alias_maximum=conf.mqtt_max_topic_alias,
+        wildcard_sub_available=conf.mqtt_wildcard_subscription_available,
+        sub_id_available=conf.mqtt_subscription_id_available,
+        shared_sub_available=conf.mqtt_shared_subscription_available,
+        maximum_keepalive=conf.mqtt_max_keep_alive,
+        maximum_client_writes_pending=conf.mqtt_max_outbound_queue,
+        maximum_inflight=conf.mqtt_max_inflight_messages,
+        sys_topic_interval=float(conf.mqtt_sys_topic_interval),
+    )
+
+
+def build_matcher(conf: Config, broker: Broker):
+    """Attach the configured matcher engine to the broker.
+
+    ``trie`` is the CPU reference path (broker default, no attach needed);
+    ``nfa``/``dense`` are the device paths; a ``matcher_mesh`` like "2x4"
+    shards the NFA over a device mesh (cluster mode)."""
+    if conf.matcher in ("", "trie"):
+        return None
+    if conf.matcher_mesh:
+        from .parallel.sharded import ShardedNFAEngine, make_mesh
+        rows, _, cols = conf.matcher_mesh.partition("x")
+        mesh = make_mesh(shape=(int(rows), int(cols or 1)))
+        engine = ShardedNFAEngine(broker.topics, mesh=mesh,
+                                  max_levels=conf.matcher_max_levels)
+    elif conf.matcher == "nfa":
+        from .matching.engine import NFAEngine
+        engine = NFAEngine(broker.topics,
+                           max_levels=conf.matcher_max_levels)
+    elif conf.matcher == "dense":
+        from .matching.dense import DenseEngine
+        engine = DenseEngine(broker.topics,
+                             max_levels=conf.matcher_max_levels)
+    else:
+        raise ValueError(f"unknown matcher {conf.matcher!r}")
+    from .matching.batcher import MicroBatcher
+    batcher = MicroBatcher(engine,
+                           window_us=conf.matcher_batch_window_us,
+                           max_batch=conf.matcher_max_batch)
+    broker.attach_matcher(batcher)
+    return batcher
+
+
+def build_broker(conf: Config, logger: Logger) -> Broker:
+    """Assemble a broker from config: capabilities, listeners, hooks,
+    matcher. Mirrors internal/mqtt/server.go:38-118."""
+    broker = Broker(BrokerOptions(capabilities=capabilities_from_config(conf),
+                                  logger=logger.with_prefix("mqtt")))
+    broker.add_hook(LoggingHook(logger.with_prefix("mqtt")))
+    broker.add_hook(AllowHook())
+    if conf.storage_backend:
+        store = (MemoryStore() if conf.storage_backend == "memory"
+                 else SQLiteStore(conf.storage_path))
+        broker.add_hook(StorageHook(store))
+    if conf.mqtt_tcp_address:
+        broker.add_listener(TCPListener("tcp", conf.mqtt_tcp_address))
+    if conf.mqtt_ws_address:
+        broker.add_listener(WSListener("ws", conf.mqtt_ws_address))
+    if conf.mqtt_unix_socket:
+        broker.add_listener(UnixListener("unix", conf.mqtt_unix_socket))
+    if conf.mqtt_sys_http_address:
+        broker.add_listener(HTTPStatsListener(
+            "sys-http", conf.mqtt_sys_http_address, lambda: broker.info))
+    build_matcher(conf, broker)
+    return broker
+
+
+def build_metrics(conf: Config, broker: Broker,
+                  logger: Logger) -> MetricsServer | None:
+    if not conf.metrics_enabled:
+        return None
+    registry = Registry()
+    register_broker_metrics(registry, broker)
+    return MetricsServer(conf.metrics_address, registry,
+                         path=conf.metrics_path,
+                         profiling=conf.metrics_profiling,
+                         logger=logger.with_prefix("metrics"))
+
+
+def new_logger_from_config(conf: Config) -> Logger:
+    from .utils.logger import new_logger
+    sf = Snowflake(machine_id=conf.machine_id)
+    return new_logger(fmt=conf.log_format, level=conf.log_level,
+                      log_id_gen=sf.next_id)
+
+
+async def run_server(conf: Config, logger: Logger,
+                     ready: asyncio.Event | None = None,
+                     stop: asyncio.Event | None = None) -> None:
+    """Run broker + metrics until ``stop`` is set or SIGINT/SIGTERM.
+
+    ``ready``/``stop`` let tests drive the full bootstrap in-process the way
+    the reference's start_test.go runs runServer with a cancellable context.
+    """
+    boot = logger.with_prefix("bootstrap")
+    boot.debug("effective configuration", **config_as_dict(conf))
+
+    profiler = heap_tracer = None
+    if conf.profile:
+        import cProfile
+        import tracemalloc
+        profiler = cProfile.Profile()
+        profiler.enable()
+        tracemalloc.start()
+        heap_tracer = True
+
+    broker = build_broker(conf, logger)
+    metrics = build_metrics(conf, broker, logger)
+
+    if stop is None:
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:
+                pass
+
+    if metrics is not None:
+        metrics.start()
+    await broker.serve()
+    boot.info("server started", tcp=conf.mqtt_tcp_address,
+              matcher=conf.matcher or "trie")
+    if ready is not None:
+        ready.set()
+
+    try:
+        await stop.wait()
+    finally:
+        boot.info("shutting down")
+        await broker.close()
+        if metrics is not None:
+            metrics.stop()
+        matcher = broker.matcher
+        if matcher is not None and hasattr(matcher, "close"):
+            await matcher.close()
+        if profiler is not None:
+            import pstats
+            profiler.disable()
+            profiler.dump_stats(f"{conf.profile_path}/cpu.prof")
+            import tracemalloc
+            snap = tracemalloc.take_snapshot()
+            with open(f"{conf.profile_path}/heap.prof", "w") as f:
+                for s in snap.statistics("lineno")[:256]:
+                    f.write(str(s) + "\n")
+            tracemalloc.stop()
+            boot.info("profiles written", path=conf.profile_path)
+        boot.info("server stopped")

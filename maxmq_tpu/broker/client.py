@@ -67,6 +67,7 @@ class Client:
         self.held_pids: deque[int] = deque()
         self.aliases: TopicAliases | None = None
         self.keepalive = 0
+        self.requested_keepalive = 0
         self.last_received = time.monotonic()
         self.connected_at = 0.0
         self.disconnected_at = 0.0
@@ -94,7 +95,13 @@ class Client:
         p.clean_start = packet.clean_start
         p.username = packet.username
         self.id = packet.client_id
+        self.requested_keepalive = packet.keepalive
         self.keepalive = packet.keepalive
+        caps_ka = self.server.capabilities.maximum_keepalive
+        if caps_ka and (self.keepalive == 0 or self.keepalive > caps_ka):
+            # clamp to the operator limit; v5 clients learn the new value
+            # via ServerKeepAlive in CONNACK [MQTT-3.1.2-21]
+            self.keepalive = caps_ka
         pr = packet.properties
         if packet.protocol_version >= 5:
             p.session_expiry = pr.session_expiry or 0

@@ -298,6 +298,54 @@ class _WSWriter:
         return self._raw.get_extra_info(name, default)
 
 
+class HTTPStatsListener(Listener):
+    """HTTP endpoint serving the broker's ``$SYS`` counters as JSON.
+
+    Parity surface: vendor/.../v2/listeners/http_sysinfo.go:22-120 in the
+    reference. ``info_fn`` returns the live SysInfo; every GET returns one
+    JSON object snapshot.
+    """
+
+    def __init__(self, id_: str, address: str, info_fn) -> None:
+        super().__init__(id_, address)
+        self.info_fn = info_fn
+
+    @property
+    def protocol(self) -> str:
+        return "http"
+
+    async def serve(self, establish) -> None:
+        host, _, port = self.address.rpartition(":")
+
+        async def handler(reader, writer):
+            import dataclasses
+            import json
+            try:
+                # consume the request head; the response is the same for
+                # every path, like the reference's single-route mux
+                await asyncio.wait_for(
+                    reader.readuntil(b"\r\n\r\n"), timeout=5.0)
+            except Exception:
+                writer.close()
+                return
+            info = self.info_fn()
+            d = dataclasses.asdict(info)
+            d.pop("extra", None)
+            body = json.dumps(d).encode()
+            writer.write(b"HTTP/1.1 200 OK\r\n"
+                         b"Content-Type: application/json\r\n"
+                         b"Content-Length: " + str(len(body)).encode() +
+                         b"\r\nConnection: close\r\n\r\n" + body)
+            try:
+                await writer.drain()
+            except Exception:
+                pass
+            writer.close()
+
+        self._server = await asyncio.start_server(
+            handler, host or "0.0.0.0", int(port))
+
+
 class Listeners:
     """Registry of listeners; serve-all / close-all.
 

@@ -46,6 +46,7 @@ class Capabilities:
     shared_sub_available: bool = True
     minimum_protocol_version: int = 3
     maximum_clients: int = 0  # 0 = unlimited
+    maximum_keepalive: int = 0  # 0 = unlimited; else clamp + v5 ServerKeepAlive
     maximum_client_writes_pending: int = 1024 * 8
     maximum_inflight: int = 1024 * 8
     sys_topic_interval: float = 30.0  # seconds; 0 disables
@@ -277,6 +278,9 @@ class Broker:
             pr.shared_sub_available = None if caps.shared_sub_available else 0
             if getattr(client, "assigned_id", False):
                 pr.assigned_client_id = client.id
+            if (caps.maximum_keepalive
+                    and client.keepalive != client.requested_keepalive):
+                pr.server_keep_alive = client.keepalive
         client.send_now(packet)
 
     async def _detach_client(self, client: Client, err: ProtocolError | None) -> None:

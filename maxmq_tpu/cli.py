@@ -1,0 +1,72 @@
+"""Command-line interface: ``maxmq start`` and ``maxmq version``.
+
+Parity surface: cmd/maxmq/main.go + internal/cli in the reference — a root
+command with ``start`` (boot the broker, run until SIGINT/SIGTERM,
+start.go:50-80) and ``version`` (version.go:22-33) subcommands.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+from .bootstrap import BANNER, new_logger_from_config, run_server
+from .utils.build import get_info
+from .utils.config import load_config
+
+
+def make_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="maxmq",
+        description="maxmq-tpu: a TPU-native MQTT message broker")
+    sub = parser.add_subparsers(dest="command")
+
+    start = sub.add_parser("start", help="start the broker server")
+    start.add_argument("--config", "-c", default=None,
+                       help="path to maxmq.conf (TOML); default: search "
+                            "., /etc/maxmq, /etc")
+    start.add_argument("--profile", action="store_true",
+                       help="write cpu.prof and heap.prof on shutdown")
+    start.add_argument("--no-banner", action="store_true")
+
+    sub.add_parser("version", help="print version information")
+    return parser
+
+
+def cmd_version() -> int:
+    print(get_info().long_version())
+    return 0
+
+
+def cmd_start(args: argparse.Namespace) -> int:
+    conf = load_config(path=args.config)
+    if args.profile:
+        conf.profile = True
+    logger = new_logger_from_config(conf)
+    if not args.no_banner:
+        print(BANNER, file=sys.stderr)
+    try:
+        asyncio.run(run_server(conf, logger))
+    except KeyboardInterrupt:
+        pass
+    except Exception as exc:
+        logger.with_prefix("bootstrap").fatal("server failed",
+                                              error=str(exc))
+        return 1
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = make_parser()
+    args = parser.parse_args(argv)
+    if args.command == "version":
+        return cmd_version()
+    if args.command == "start":
+        return cmd_start(args)
+    parser.print_help()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

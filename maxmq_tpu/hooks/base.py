@@ -25,7 +25,11 @@ if TYPE_CHECKING:
 
 class Hook:
     """Base hook: override any subset of events. All defaults are no-ops that
-    preserve the modify-chain value unchanged."""
+    preserve the modify-chain value unchanged.
+
+    Modify-chain events receive the VALUE FIRST (packet/will/subscriber set),
+    then the client — the order the Hooks.modify dispatcher passes them in.
+    """
 
     id = "hook"
 
@@ -53,21 +57,21 @@ class Hook:
     def on_session_establish(self, client, packet: "Packet") -> None: ...
     def on_session_established(self, client, packet: "Packet") -> None: ...
     def on_disconnect(self, client, err, expire: bool) -> None: ...
-    def on_auth_packet(self, client, packet: "Packet") -> "Packet":
+    def on_auth_packet(self, packet: "Packet", client) -> "Packet":
         return packet
 
     # -- packet flow --------------------------------------------------------
-    def on_packet_read(self, client, packet: "Packet") -> "Packet":
+    def on_packet_read(self, packet: "Packet", client) -> "Packet":
         return packet
 
-    def on_packet_encode(self, client, packet: "Packet") -> "Packet":
+    def on_packet_encode(self, packet: "Packet", client) -> "Packet":
         return packet
 
     def on_packet_sent(self, client, packet: "Packet", nbytes: int) -> None: ...
     def on_packet_processed(self, client, packet: "Packet", err) -> None: ...
 
     # -- subscribe / unsubscribe -------------------------------------------
-    def on_subscribe(self, client, packet: "Packet") -> "Packet":
+    def on_subscribe(self, packet: "Packet", client) -> "Packet":
         return packet
 
     def on_subscribed(self, client, packet: "Packet",
@@ -77,13 +81,13 @@ class Hook:
                               packet: "Packet") -> "SubscriberSet":
         return subscribers
 
-    def on_unsubscribe(self, client, packet: "Packet") -> "Packet":
+    def on_unsubscribe(self, packet: "Packet", client) -> "Packet":
         return packet
 
     def on_unsubscribed(self, client, packet: "Packet") -> None: ...
 
     # -- publish ------------------------------------------------------------
-    def on_publish(self, client, packet: "Packet") -> "Packet":
+    def on_publish(self, packet: "Packet", client) -> "Packet":
         """May raise RejectPacket to drop, or ProtocolError to disconnect."""
         return packet
 
@@ -103,7 +107,7 @@ class Hook:
     def on_packet_id_exhausted(self, client, packet: "Packet") -> None: ...
 
     # -- wills / expiry -----------------------------------------------------
-    def on_will(self, client, will: "Will") -> "Will":
+    def on_will(self, will: "Will", client) -> "Will":
         return will
 
     def on_will_sent(self, client, packet: "Packet") -> None: ...

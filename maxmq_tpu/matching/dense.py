@@ -40,11 +40,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .nfa import Entry
-from .topics import split_levels
+from .nfa import Entry, EntryBuilder
+from .topics import UNK, intern_level, split_levels, tokenize_topics
 from .trie import SubscriberSet, TopicIndex
 
-UNK = 0
 PLUS = -2    # '+' sentinel in child_tok
 HASH = -3    # '#' sentinel in child_tok
 
@@ -71,23 +70,8 @@ class DenseTables:
     version: int = -1
 
     def tokenize(self, topics: list[str], max_levels: int):
-        """Host-side topic prep: token ids padded with -1, lengths, $-flags.
-        Topics deeper than max_levels report length -1 (engine falls back)."""
-        batch = len(topics)
-        toks = np.full((batch, max_levels), -1, dtype=np.int32)
-        lengths = np.zeros(batch, dtype=np.int32)
-        dollar = np.zeros(batch, dtype=bool)
-        vocab = self.vocab
-        for i, topic in enumerate(topics):
-            levels = split_levels(topic)
-            dollar[i] = topic.startswith("$")
-            if len(levels) > max_levels:
-                lengths[i] = -1
-                continue
-            lengths[i] = len(levels)
-            for j, level in enumerate(levels):
-                toks[i, j] = vocab.get(level, UNK)
-        return toks, lengths, dollar
+        """Host-side topic prep (shared impl: topics.tokenize_topics)."""
+        return tokenize_topics(self.vocab, topics, max_levels)
 
 
 class _Node:
@@ -112,42 +96,25 @@ def compile_dense_subscriptions(subs, version: int = 0,
                                 ) -> DenseTables:
     """Build the leveled slot arrays from a subscription snapshot (same
     input contract as nfa.compile_subscriptions)."""
-    entries: list[Entry] = []
-    shared_bits: dict[tuple[str, str], int] = {}
+    builder = EntryBuilder()
     if vocab is None:
         vocab = {}
     root = _Node()
-
-    def intern(level: str) -> int:
-        tok = vocab.get(level)
-        if tok is None:
-            tok = len(vocab) + 1  # 0 reserved for UNK
-            vocab[level] = tok
-        return tok
 
     for filt, client_id, sub, group in subs:
         # `filt` is the trie path: already '$share'-stripped for shared subs
         node = root
         for level in split_levels(filt):
             if level not in ("+", "#"):
-                intern(level)
+                intern_level(vocab, level)
             child = node.children.get(level)
             if child is None:
                 child = node.children[level] = _Node()
             node = child
-        if group:
-            key = (group, sub.filter)
-            bit = shared_bits.get(key)
-            if bit is None:
-                bit = len(entries)
-                shared_bits[key] = bit
-                entries.append(Entry(group=group, filter=sub.filter))
-                node.bits.append(bit)
-            entries[bit].candidates[client_id] = sub
-        else:
-            node.bits.append(len(entries))
-            entries.append(Entry(client_id=client_id, subscription=sub,
-                                 filter=filt))
+        bit = builder.add(filt, client_id, sub, group)
+        if bit is not None:
+            node.bits.append(bit)
+    entries = builder.entries
 
     # ---- BFS levels: slots = children of previous level -------------------
     # Subscriber-carrying slots are ordered FIRST within each level, so the

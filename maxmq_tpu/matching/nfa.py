@@ -35,9 +35,9 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..protocol.packets import Subscription
-from .topics import parse_share, split_levels
+from .topics import (UNK, intern_level, parse_share, split_levels,
+                     tokenize_topics)
 
-UNK = 0          # token id for levels never seen in any filter
 MAX_PROBES = 8   # linear-probe bound enforced at build time
 
 _MIX1 = np.uint32(0x9E3779B1)
@@ -78,6 +78,39 @@ class Entry:
         return bool(self.group)
 
 
+class EntryBuilder:
+    """Accumulates Entry records with `$share` (group, filter) dedup — the
+    common subscriber-bit construction used by BOTH compiled-table flavors
+    (nfa.compile_subscriptions and dense.compile_dense_subscriptions), so
+    merge semantics can never diverge between them."""
+
+    def __init__(self) -> None:
+        self.entries: list[Entry] = []
+        self._shared: dict[tuple[str, str], int] = {}
+
+    def add(self, filt: str, client_id: str, sub: Subscription,
+            group: str) -> int | None:
+        """Record one subscription. Returns the bit index to place on the
+        trie node, or None when this shared (group, filter) pair already has
+        its bit placed (the new member only joins the candidate map)."""
+        if group:
+            key = (group, sub.filter)
+            bit = self._shared.get(key)
+            if bit is not None:
+                self.entries[bit].candidates[client_id] = sub
+                return None
+            bit = len(self.entries)
+            self._shared[key] = bit
+            entry = Entry(group=group, filter=sub.filter)
+            entry.candidates[client_id] = sub
+            self.entries.append(entry)
+            return bit
+        bit = len(self.entries)
+        self.entries.append(Entry(client_id=client_id, subscription=sub,
+                                  filter=filt))
+        return bit
+
+
 @dataclass
 class NFATables:
     """The flattened matcher, plus the host-side decode table."""
@@ -99,23 +132,8 @@ class NFATables:
         return len(self.hash_node)
 
     def tokenize(self, topics: list[str], max_levels: int):
-        """Host-side topic prep: token ids padded with -1, lengths, $-flags.
-        Topics deeper than max_levels report length -1 (engine falls back)."""
-        batch = len(topics)
-        toks = np.full((batch, max_levels), -1, dtype=np.int32)
-        lengths = np.zeros(batch, dtype=np.int32)
-        dollar = np.zeros(batch, dtype=bool)
-        vocab = self.vocab
-        for i, topic in enumerate(topics):
-            levels = split_levels(topic)
-            dollar[i] = topic.startswith("$")
-            if len(levels) > max_levels:
-                lengths[i] = -1
-                continue
-            lengths[i] = len(levels)
-            for j, level in enumerate(levels):
-                toks[i, j] = vocab.get(level, UNK)
-        return toks, lengths, dollar
+        """Host-side topic prep (shared impl: topics.tokenize_topics)."""
+        return tokenize_topics(self.vocab, topics, max_levels)
 
 
 class _BuildNode:
@@ -157,18 +175,10 @@ def compile_subscriptions(subs, version: int = 0,
     same level string gets the same token id in every shard (topics are
     tokenized once and replicated over the 'subs' mesh axis).
     """
-    entries: list[Entry] = []
-    shared_bits: dict[tuple[str, str], int] = {}
+    builder = EntryBuilder()
     root = _BuildNode()
     if vocab is None:
         vocab = {}
-
-    def intern(level: str) -> int:
-        tok = vocab.get(level)
-        if tok is None:
-            tok = len(vocab) + 1  # 0 is reserved for UNK
-            vocab[level] = tok
-        return tok
 
     for filt, client_id, sub, group in subs:
         # `filt` is the trie path: already '$share'-stripped for shared subs
@@ -182,30 +192,19 @@ def compile_subscriptions(subs, version: int = 0,
                     node.plus = _BuildNode()
                 node = node.plus
             else:
-                intern(level)
+                intern_level(vocab, level)
                 child = node.children.get(level)
                 if child is None:
                     child = node.children[level] = _BuildNode()
                 node = child
-        if group:
-            key = (group, sub.filter)
-            bit = shared_bits.get(key)
-            fresh = bit is None
-            if fresh:
-                bit = len(entries)
-                shared_bits[key] = bit
-                entries.append(Entry(group=group, filter=sub.filter))
-            entries[bit].candidates[client_id] = sub
-            if not fresh:
-                continue  # the group's bit is already on the node
-        else:
-            bit = len(entries)
-            entries.append(Entry(client_id=client_id, subscription=sub,
-                                 filter=filt))
+        bit = builder.add(filt, client_id, sub, group)
+        if bit is None:
+            continue  # shared pair: the group's bit is already on the node
         if terminal_is_hash:
             node.hash_bits.append(bit)
         else:
             node.entry_bits.append(bit)
+    entries = builder.entries
 
     # ---- number nodes breadth-first --------------------------------------
     nodes: list[_BuildNode] = [root]

@@ -66,3 +66,40 @@ def is_dollar(topic: str) -> bool:
     """Topics beginning with '$' are excluded from root-level wildcard
     matching [MQTT-4.7.2-1]."""
     return topic.startswith("$")
+
+
+UNK = 0  # token id reserved for levels never seen in any filter
+
+
+def intern_level(vocab: dict[str, int], level: str) -> int:
+    """Assign/look up the token id for a level string (0 reserved for UNK).
+    The ONE intern rule shared by the NFA and dense compilers, so a shared
+    vocab always produces identical token ids in both."""
+    tok = vocab.get(level)
+    if tok is None:
+        tok = len(vocab) + 1
+        vocab[level] = tok
+    return tok
+
+
+def tokenize_topics(vocab: dict[str, int], topics: list[str],
+                    max_levels: int):
+    """Host-side topic prep shared by both compiled-table flavors: token ids
+    padded with -1, lengths, $-flags. Topics deeper than max_levels report
+    length -1 (engines fall back to the CPU trie)."""
+    import numpy as np
+
+    batch = len(topics)
+    toks = np.full((batch, max_levels), -1, dtype=np.int32)
+    lengths = np.zeros(batch, dtype=np.int32)
+    dollar = np.zeros(batch, dtype=bool)
+    for i, topic in enumerate(topics):
+        levels = split_levels(topic)
+        dollar[i] = topic.startswith("$")
+        if len(levels) > max_levels:
+            lengths[i] = -1
+            continue
+        lengths[i] = len(levels)
+        for j, level in enumerate(levels):
+            toks[i, j] = vocab.get(level, UNK)
+    return toks, lengths, dollar

@@ -1,0 +1,259 @@
+"""Metrics HTTP server with Prometheus text exposition + profiling endpoints.
+
+Parity surface: internal/metrics/server.go in the reference — an HTTP server
+exposing Prometheus ``/metrics`` (server.go:49-50) and, when profiling is
+enabled, live ``/debug/pprof/*`` endpoints (51-58), with graceful shutdown
+(111-124). The reference leans on client_golang; here the exposition format
+(text format 0.0.4) is emitted directly from a tiny function-backed registry —
+the same shape as prometheus ``GaugeFunc``/``CounterFunc``, which is all the
+reference uses (internal/mqtt/metrics.go:31-88).
+
+Profiling endpoints are the Python equivalents of net/http/pprof:
+``/debug/pprof/threads`` (all-thread stack dump), ``/debug/pprof/profile``
+(cProfile for ?seconds=N, pstats text), ``/debug/pprof/heap`` (tracemalloc
+snapshot when tracing is active).
+"""
+
+from __future__ import annotations
+
+import http.server
+import threading
+from typing import Callable
+
+from .utils.logger import Logger
+
+
+class Metric:
+    """A function-backed metric: value is read at scrape time."""
+
+    __slots__ = ("name", "kind", "help", "fn", "labels")
+
+    def __init__(self, name: str, kind: str, help_: str,
+                 fn: Callable[[], float],
+                 labels: dict[str, str] | None = None) -> None:
+        assert kind in ("counter", "gauge")
+        self.name = name
+        self.kind = kind
+        self.help = help_
+        self.fn = fn
+        self.labels = labels or {}
+
+
+class Registry:
+    """Scrape-time metric registry emitting Prometheus text format 0.0.4."""
+
+    def __init__(self) -> None:
+        self._metrics: list[Metric] = []
+        self._lock = threading.Lock()
+
+    def gauge_func(self, name: str, help_: str, fn: Callable[[], float],
+                   labels: dict[str, str] | None = None) -> None:
+        with self._lock:
+            self._metrics.append(Metric(name, "gauge", help_, fn, labels))
+
+    def counter_func(self, name: str, help_: str, fn: Callable[[], float],
+                     labels: dict[str, str] | None = None) -> None:
+        with self._lock:
+            self._metrics.append(Metric(name, "counter", help_, fn, labels))
+
+    def expose(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics)
+        out: list[str] = []
+        seen_header: set[str] = set()
+        for m in metrics:
+            if m.name not in seen_header:
+                out.append(f"# HELP {m.name} {m.help}")
+                out.append(f"# TYPE {m.name} {m.kind}")
+                seen_header.add(m.name)
+            try:
+                value = float(m.fn())
+            except Exception:
+                continue
+            if m.labels:
+                lbl = ",".join(f'{k}="{v}"' for k, v in m.labels.items())
+                out.append(f"{m.name}{{{lbl}}} {_fmt(value)}")
+            else:
+                out.append(f"{m.name} {_fmt(value)}")
+        return "\n".join(out) + "\n"
+
+
+def _fmt(v: float) -> str:
+    return str(int(v)) if v == int(v) else repr(v)
+
+
+def _dump_threads() -> str:
+    import sys
+    import threading as _threading
+    import traceback
+    names = {t.ident: t.name for t in _threading.enumerate()}
+    out: list[str] = []
+    for ident, frame in sys._current_frames().items():
+        out.append(f"Thread {names.get(ident, '?')} (id={ident}):")
+        out.extend(line.rstrip() for line in traceback.format_stack(frame))
+        out.append("")
+    return "\n".join(out)
+
+
+def _heap_snapshot() -> str:
+    import tracemalloc
+    if not tracemalloc.is_tracing():
+        return ("tracemalloc not tracing; start the broker with "
+                "MAXMQ_PROFILE=1 or call tracemalloc.start()\n")
+    snap = tracemalloc.take_snapshot()
+    lines = [str(s) for s in snap.statistics("lineno")[:64]]
+    return "\n".join(lines) + "\n"
+
+
+def _cpu_profile(seconds: float, interval: float = 0.005) -> str:
+    """Statistical all-thread CPU profile: sample every thread's stack for
+    ``seconds`` and report frame hit counts. (cProfile only instruments the
+    calling thread, which here would just be this handler sleeping — a
+    sampler is the faithful whole-process equivalent of pprof's profile.)"""
+    import sys
+    import time
+    own = {__import__("threading").get_ident()}
+    counts: dict[tuple[str, int, str], int] = {}
+    samples = 0
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        for ident, top in sys._current_frames().items():
+            if ident in own:
+                continue
+            frame = top
+            while frame is not None:
+                key = (frame.f_code.co_filename, frame.f_lineno,
+                       frame.f_code.co_name)
+                counts[key] = counts.get(key, 0) + 1
+                frame = frame.f_back
+        samples += 1
+        time.sleep(interval)
+    out = [f"# {samples} samples over {seconds:.1f}s, "
+           f"{interval * 1000:.1f}ms interval", "# hits  location"]
+    for (fname, lineno, func), n in sorted(counts.items(),
+                                           key=lambda kv: -kv[1])[:128]:
+        out.append(f"{n:7d}  {func} ({fname}:{lineno})")
+    return "\n".join(out) + "\n"
+
+
+class MetricsServer:
+    """Threaded HTTP server for /metrics and optional /debug/pprof/*."""
+
+    def __init__(self, address: str, registry: Registry,
+                 path: str = "/metrics", profiling: bool = False,
+                 logger: Logger | None = None) -> None:
+        if not address or ":" not in address:
+            raise ValueError(f"invalid metrics address {address!r}")
+        host, _, port_s = address.rpartition(":")
+        self.host = host or "0.0.0.0"
+        self.port = int(port_s)
+        self.registry = registry
+        self.path = path
+        self.profiling = profiling
+        self.logger = logger
+        self._httpd: http.server.ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    @property
+    def bound_port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else self.port
+
+    def start(self) -> None:
+        registry, path, profiling = self.registry, self.path, self.profiling
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 (http.server API)
+                target = self.path.split("?", 1)[0]
+                if target == path:
+                    body = registry.expose().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif profiling and target.startswith("/debug/pprof"):
+                    body, ctype = self._pprof(target)
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _pprof(self, target: str) -> tuple[bytes, str]:
+                if target.endswith("/threads") or target.rstrip("/").endswith("pprof"):
+                    return _dump_threads().encode(), "text/plain"
+                if target.endswith("/heap"):
+                    return _heap_snapshot().encode(), "text/plain"
+                if target.endswith("/profile"):
+                    from urllib.parse import parse_qs, urlparse
+                    q = parse_qs(urlparse(self.path).query)
+                    seconds = float(q.get("seconds", ["1"])[0])
+                    return _cpu_profile(min(seconds, 30.0)).encode(), "text/plain"
+                return b"unknown pprof endpoint\n", "text/plain"
+
+            def log_message(self, fmt: str, *args) -> None:
+                pass  # quiet; scrape logging is noise
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            (self.host, self.port), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="metrics-http",
+            daemon=True)
+        self._thread.start()
+        if self.logger:
+            self.logger.info("metrics server started",
+                             address=f"{self.host}:{self.bound_port}")
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if self.logger:
+            self.logger.info("metrics server stopped")
+
+
+def register_broker_metrics(registry: Registry, broker) -> None:
+    """Register the ``maxmq_mqtt_*`` metric family reading the broker's
+    ``$SYS`` counters at scrape time (internal/mqtt/metrics.go:31-88: 15
+    counter/gauge funcs over mochi's atomic system.Info)."""
+    info = broker.info
+    counters = [
+        ("bytes_received", "Total number of bytes received"),
+        ("bytes_sent", "Total number of bytes sent"),
+        ("messages_received", "Total number of publish messages received"),
+        ("messages_sent", "Total number of publish messages sent"),
+        ("messages_dropped", "Total number of publish messages dropped"),
+        ("packets_received", "Total number of packets received"),
+        ("packets_sent", "Total number of packets sent"),
+        ("clients_total", "Total number of clients known to the broker"),
+        ("inflight_dropped", "Total number of inflight messages dropped"),
+    ]
+    gauges = [
+        ("clients_connected", "Number of currently connected clients"),
+        ("clients_disconnected", "Number of disconnected persistent sessions"),
+        ("clients_maximum", "Maximum number of concurrently connected clients"),
+        ("retained", "Number of retained messages"),
+        ("inflight", "Number of inflight messages"),
+        ("subscriptions", "Number of active subscriptions"),
+        ("uptime", "Broker uptime in seconds"),
+    ]
+    for name, help_ in counters:
+        registry.counter_func(f"maxmq_mqtt_{name}", help_,
+                              lambda n=name: getattr(info, n))
+    for name, help_ in gauges:
+        registry.gauge_func(f"maxmq_mqtt_{name}", help_,
+                            lambda n=name: getattr(info, n))
+    # matcher-side metrics (TPU path; no reference equivalent)
+    matcher = getattr(broker, "matcher", None)
+    if matcher is not None and hasattr(matcher, "matches"):
+        registry.counter_func(
+            "maxmq_matcher_matches_total",
+            "Topic matches answered by the device matcher",
+            lambda: matcher.matches)
+        registry.counter_func(
+            "maxmq_matcher_fallbacks_total",
+            "Topic matches that overflowed to the CPU trie fallback",
+            lambda: matcher.fallbacks)

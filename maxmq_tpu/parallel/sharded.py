@@ -108,6 +108,8 @@ class ShardedNFAEngine:
         # concurrent match_raw always pairs vocab, tables and compiled fn
         self._state = None
         self._refresh_lock = threading.Lock()
+        self.matches = 0
+        self.fallbacks = 0
         self.refresh(force=True)
 
     # ------------------------------------------------------------------
@@ -185,7 +187,9 @@ class ShardedNFAEngine:
         rows, overflow, shards = self.match_raw(topics)
         out = []
         for i, topic in enumerate(topics):
+            self.matches += 1
             if overflow[:, i].any():
+                self.fallbacks += 1
                 out.append(self.index.subscribers(topic))
                 continue
             result = SubscriberSet()
@@ -196,3 +200,10 @@ class ShardedNFAEngine:
 
     def subscribers(self, topic: str) -> SubscriberSet:
         return self.subscribers_batch([topic])[0]
+
+    async def subscribers_async(self, topic: str) -> SubscriberSet:
+        """Event-loop-friendly match (worker thread, like NFAEngine's)."""
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, self.subscribers, topic)

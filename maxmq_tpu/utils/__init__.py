@@ -1,0 +1,11 @@
+"""Operational utilities: snowflake IDs, structured logging, config, build
+info. Parity surface: the reference's first-party shell — internal/snowflake,
+internal/logger, internal/config, internal/build."""
+
+from .snowflake import Snowflake
+from .logger import Logger, new_logger
+from .config import Config, load_config, read_config_file
+from .build import get_info, BuildInfo
+
+__all__ = ["Snowflake", "Logger", "new_logger", "Config", "load_config",
+           "read_config_file", "get_info", "BuildInfo"]

@@ -1,0 +1,122 @@
+"""Flat configuration with TOML file + ``MAXMQ_*`` environment overlay.
+
+Parity surface: internal/config/config.go in the reference — one flat struct
+of snake_case keys covering logging, metrics, and broker settings; defaults
+(config.go:98-119); a TOML ``maxmq.conf`` searched in the working directory,
+``/etc/maxmq``, then ``/etc`` (126-142); environment variables named
+``MAXMQ_<UPPER_KEY>`` override the file (149-183). The TPU build adds the
+matcher/runtime knobs the reference has no equivalent for.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tomllib
+from dataclasses import dataclass, fields
+
+
+@dataclass
+class Config:
+    # -- logging (config.go: log block) -------------------------------------
+    log_format: str = "pretty"          # pretty | json
+    log_level: str = "info"             # trace|debug|info|warn|error|fatal
+    machine_id: int = 0                 # snowflake machine id, [0,1023]
+
+    # -- metrics HTTP server ------------------------------------------------
+    metrics_enabled: bool = True
+    metrics_address: str = ":8888"
+    metrics_path: str = "/metrics"
+    metrics_profiling: bool = False
+
+    # -- broker listeners ---------------------------------------------------
+    mqtt_tcp_address: str = ":1883"
+    mqtt_ws_address: str = ""           # optional websocket listener
+    mqtt_unix_socket: str = ""          # optional unix-socket listener
+    mqtt_sys_http_address: str = ""     # optional $SYS JSON stats endpoint
+
+    # -- broker capabilities (internal/mqtt/config.go fields → mochi
+    #    Capabilities, server.go:76-91) --------------------------------------
+    mqtt_max_keep_alive: int = 7200
+    mqtt_session_expiry_interval: int = 0xFFFFFFFF
+    mqtt_max_message_expiry_interval: int = 0xFFFFFFFF
+    mqtt_max_packet_size: int = 0       # 0 = unlimited
+    mqtt_max_inflight_messages: int = 1024
+    mqtt_receive_maximum: int = 1024
+    mqtt_max_qos: int = 2
+    mqtt_max_topic_alias: int = 65535
+    mqtt_retain_available: bool = True
+    mqtt_wildcard_subscription_available: bool = True
+    mqtt_subscription_id_available: bool = True
+    mqtt_shared_subscription_available: bool = True
+    mqtt_max_outbound_queue: int = 1024
+    mqtt_sys_topic_interval: int = 1    # seconds between $SYS refreshes
+
+    # -- persistence --------------------------------------------------------
+    storage_backend: str = ""           # "" | memory | sqlite
+    storage_path: str = "maxmq.db"
+
+    # -- TPU matcher runtime (no reference equivalent: the north-star path) --
+    matcher: str = "dense"              # trie | nfa | dense
+    matcher_batch_window_us: int = 200
+    matcher_max_batch: int = 256
+    matcher_max_levels: int = 16
+    matcher_mesh: str = ""              # e.g. "2x4" to shard over a mesh
+
+    # -- profiling ----------------------------------------------------------
+    profile: bool = False
+    profile_path: str = "."
+
+
+DEFAULT_CONFIG_NAME = "maxmq.conf"
+CONFIG_SEARCH_PATHS = (".", "/etc/maxmq", "/etc")
+
+
+def default_config() -> Config:
+    return Config()
+
+
+def read_config_file(path: str | None = None) -> dict:
+    """Read the TOML config file. With no explicit path, search the standard
+    locations; a missing file is not an error (returns {})."""
+    if path is not None:
+        with open(path, "rb") as f:
+            return tomllib.load(f)
+    for d in CONFIG_SEARCH_PATHS:
+        candidate = os.path.join(d, DEFAULT_CONFIG_NAME)
+        if os.path.isfile(candidate):
+            with open(candidate, "rb") as f:
+                return tomllib.load(f)
+    return {}
+
+
+def _coerce(value, typ):
+    if typ is bool:
+        if isinstance(value, bool):
+            return value
+        return str(value).strip().lower() in ("1", "true", "yes", "on")
+    if typ is int:
+        return int(value)
+    return str(value)
+
+
+def load_config(path: str | None = None,
+                env: dict[str, str] | None = None) -> Config:
+    """defaults ← TOML file ← MAXMQ_* env, in increasing precedence."""
+    env = os.environ if env is None else env
+    data = read_config_file(path)
+    conf = Config()
+    defaults = Config()
+    for f in fields(Config):
+        typ = type(getattr(defaults, f.name))
+        if f.name in data:
+            setattr(conf, f.name, _coerce(data[f.name], typ))
+        env_key = "MAXMQ_" + f.name.upper()
+        if env_key in env:
+            setattr(conf, f.name, _coerce(env[env_key], typ))
+    return conf
+
+
+def config_as_dict(conf: Config) -> dict:
+    """The full effective config, for the DEBUG boot log (start.go:119-123)."""
+    return dataclasses.asdict(conf)

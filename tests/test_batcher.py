@@ -1,0 +1,112 @@
+"""Tests for the micro-batching matcher front end (SURVEY §7 stage 4: the
+publish micro-batch queue in front of the device matcher)."""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from maxmq_tpu.matching.batcher import MicroBatcher
+from maxmq_tpu.matching.trie import TopicIndex
+from maxmq_tpu.protocol.packets import Subscription
+
+
+class FakeEngine:
+    """Records the batch shapes the batcher dispatches."""
+
+    def __init__(self) -> None:
+        self.index = TopicIndex()
+        self.calls: list[list[str]] = []
+
+    def subscribers_batch(self, topics):
+        self.calls.append(list(topics))
+        return [f"result:{t}" for t in topics]
+
+    def subscribers(self, topic):
+        return self.subscribers_batch([topic])[0]
+
+    def refresh(self, force=False):
+        return False
+
+
+async def test_concurrent_requests_coalesce():
+    eng = FakeEngine()
+    batcher = MicroBatcher(eng, window_us=2000, max_batch=64)
+    try:
+        results = await asyncio.gather(
+            *[batcher.subscribers_async(f"t/{i}") for i in range(16)])
+        assert results == [f"result:t/{i}" for i in range(16)]
+        # all 16 concurrent requests land in ONE device dispatch
+        assert len(eng.calls) == 1
+        assert len(eng.calls[0]) == 16
+        assert batcher.batches == 1
+        assert batcher.largest_batch == 16
+    finally:
+        await batcher.close()
+
+
+async def test_max_batch_splits():
+    eng = FakeEngine()
+    batcher = MicroBatcher(eng, window_us=1000, max_batch=4)
+    try:
+        results = await asyncio.gather(
+            *[batcher.subscribers_async(f"t/{i}") for i in range(10)])
+        assert results == [f"result:t/{i}" for i in range(10)]
+        assert all(len(c) <= 4 for c in eng.calls)
+        assert sum(len(c) for c in eng.calls) == 10
+    finally:
+        await batcher.close()
+
+
+async def test_single_request_low_latency():
+    eng = FakeEngine()
+    batcher = MicroBatcher(eng, window_us=100, max_batch=64)
+    try:
+        out = await asyncio.wait_for(batcher.subscribers_async("a/b"),
+                                     timeout=1)
+        assert out == "result:a/b"
+    finally:
+        await batcher.close()
+
+
+async def test_engine_error_propagates():
+    class Boom(FakeEngine):
+        def subscribers_batch(self, topics):
+            raise RuntimeError("device fell over")
+
+    batcher = MicroBatcher(Boom(), window_us=100)
+    try:
+        with pytest.raises(RuntimeError):
+            await batcher.subscribers_async("a/b")
+    finally:
+        await batcher.close()
+
+
+async def test_batched_dense_engine_parity():
+    """End to end with the real dense device matcher: batched answers equal
+    the exact CPU trie."""
+    from maxmq_tpu.matching.dense import DenseEngine
+
+    index = TopicIndex()
+    for i, f in enumerate(["a/+", "a/b", "a/#", "x/y", "+/y", "$sys/#"]):
+        index.subscribe(f"cl-{i}", Subscription(filter=f, qos=1))
+    engine = DenseEngine(index, max_levels=6)
+    batcher = MicroBatcher(engine, window_us=500, max_batch=32)
+    try:
+        topics = ["a/b", "a/c", "x/y", "q/y", "$sys/health", "nope"] * 3
+        got = await asyncio.gather(
+            *[batcher.subscribers_async(t) for t in topics])
+        for topic, s in zip(topics, got):
+            want = index.subscribers(topic)
+            assert set(s.subscriptions) == set(want.subscriptions), topic
+    finally:
+        await batcher.close()
+
+
+def test_batcher_delegates_sync_surface():
+    eng = FakeEngine()
+    batcher = MicroBatcher(eng)
+    assert batcher.subscribers("a") == "result:a"
+    assert batcher.refresh() is False
+    assert batcher.index is eng.index

@@ -64,6 +64,19 @@ async def test_keepalive_timeout_drops_client():
         assert broker.info.clients_connected == 0
 
 
+async def test_keepalive_clamped_to_maximum():
+    """Operator keepalive limit: clamp + v5 ServerKeepAlive [MQTT-3.1.2-21]."""
+    async with running_broker(maximum_keepalive=5) as broker:
+        c = await connect(broker, "c1", version=5, keepalive=60)
+        assert c.connack.properties.server_keep_alive == 5
+        assert broker.clients.get("c1").keepalive == 5
+        await c.disconnect()
+        # keepalive 0 (never drop) is also subject to the operator limit
+        c2 = await connect(broker, "c2", version=5, keepalive=0)
+        assert c2.connack.properties.server_keep_alive == 5
+        await c2.disconnect()
+
+
 async def test_subscribe_wildcards_granted_qos():
     async with running_broker() as broker:
         c = await connect(broker, "c1")

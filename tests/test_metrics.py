@@ -1,0 +1,194 @@
+"""Tests for the metrics server, the maxmq_mqtt_* Prometheus bridge, the
+logging hook, and the $SYS HTTP stats listener.
+
+Models internal/metrics/server_test.go (constructor validation, bad address,
+start/stop, scrape) and internal/mqtt/logging_test.go (log output per hook
+event) in the reference."""
+
+from __future__ import annotations
+
+import io
+import json
+import urllib.request
+
+import pytest
+
+from maxmq_tpu.broker import Broker, BrokerOptions, Capabilities
+from maxmq_tpu.broker.listeners import HTTPStatsListener
+from maxmq_tpu.hooks.logging import LoggingHook
+from maxmq_tpu.metrics import (MetricsServer, Registry,
+                               register_broker_metrics)
+from maxmq_tpu.protocol.codec import FixedHeader, PacketType
+from maxmq_tpu.protocol.packets import Packet, Subscription
+from maxmq_tpu.utils.logger import Logger, set_severity_level
+
+
+def scrape(port: int, path: str = "/metrics") -> tuple[int, str]:
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+        return r.status, r.read().decode()
+
+
+class TestRegistry:
+    def test_exposition_format(self):
+        reg = Registry()
+        reg.counter_func("test_total", "A counter.", lambda: 41)
+        reg.gauge_func("test_now", "A gauge.", lambda: 1.5,
+                       labels={"kind": "x"})
+        text = reg.expose()
+        assert "# HELP test_total A counter." in text
+        assert "# TYPE test_total counter" in text
+        assert "test_total 41" in text
+        assert 'test_now{kind="x"} 1.5' in text
+
+    def test_failing_metric_skipped(self):
+        reg = Registry()
+
+        def boom():
+            raise RuntimeError
+
+        reg.gauge_func("bad", "x", boom)
+        reg.gauge_func("good", "x", lambda: 2)
+        text = reg.expose()
+        assert "good 2" in text
+        assert not any(line.startswith("bad ")
+                       for line in text.splitlines())
+
+
+class TestMetricsServer:
+    def test_invalid_address(self):
+        with pytest.raises(ValueError):
+            MetricsServer("no-port", Registry())
+
+    def test_scrape_and_stop(self):
+        reg = Registry()
+        reg.gauge_func("up", "Server is up.", lambda: 1)
+        srv = MetricsServer("127.0.0.1:0", reg)
+        srv.start()
+        try:
+            status, text = scrape(srv.bound_port)
+            assert status == 200
+            assert "up 1" in text
+            with pytest.raises(Exception):
+                scrape(srv.bound_port, "/nope")
+        finally:
+            srv.stop()
+
+    def test_profiling_endpoints(self):
+        srv = MetricsServer("127.0.0.1:0", Registry(), profiling=True)
+        srv.start()
+        try:
+            status, text = scrape(srv.bound_port, "/debug/pprof/threads")
+            assert status == 200
+            assert "Thread" in text or "File" in text
+            status, _ = scrape(srv.bound_port, "/debug/pprof/heap")
+            assert status == 200
+        finally:
+            srv.stop()
+
+    def test_profiling_disabled_404(self):
+        srv = MetricsServer("127.0.0.1:0", Registry(), profiling=False)
+        srv.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError):
+                scrape(srv.bound_port, "/debug/pprof/threads")
+        finally:
+            srv.stop()
+
+
+class TestBrokerBridge:
+    def test_registers_mqtt_metrics(self):
+        broker = Broker(BrokerOptions(capabilities=Capabilities()))
+        broker.info.messages_received = 5
+        broker.info.clients_connected = 2
+        reg = Registry()
+        register_broker_metrics(reg, broker)
+        text = reg.expose()
+        assert "maxmq_mqtt_messages_received 5" in text
+        assert "maxmq_mqtt_clients_connected 2" in text
+        # live read at scrape time, not registration time
+        broker.info.messages_received = 9
+        assert "maxmq_mqtt_messages_received 9" in reg.expose()
+
+
+class _FakeClient:
+    id = "cl1"
+    listener = "t1"
+    remote = "127.0.0.1:1"
+    keepalive = 60
+    inflight = ()
+
+
+def _publish(topic="a/b", qos=0):
+    p = Packet(fixed=FixedHeader(type=PacketType.PUBLISH, qos=qos))
+    p.topic = topic
+    p.payload = b"hi"
+    return p
+
+
+class TestLoggingHook:
+    def _hook(self) -> tuple[LoggingHook, io.StringIO]:
+        buf = io.StringIO()
+        set_severity_level("trace")
+        hook = LoggingHook(Logger(out=buf, fmt="json"))
+        return hook, buf
+
+    def _events(self, buf) -> list[dict]:
+        return [json.loads(line) for line in buf.getvalue().splitlines()]
+
+    def test_lifecycle_and_publish_events(self):
+        hook, buf = self._hook()
+        hook.on_started()
+        hook.on_publish(_publish(), _FakeClient())
+        hook.on_publish_dropped(_FakeClient(), _publish())
+        hook.on_stopped()
+        set_severity_level("info")
+        events = self._events(buf)
+        assert [e["message"] for e in events] == [
+            "broker started", "received PUBLISH",
+            "publish dropped (slow consumer)", "broker stopped"]
+        assert events[1]["topic"] == "a/b"
+        assert events[2]["level"] == "warn"
+
+    def test_packet_read_is_modify_passthrough(self):
+        hook, buf = self._hook()
+        p = _publish()
+        assert hook.on_packet_read(p, _FakeClient()) is p
+        set_severity_level("info")
+        event = self._events(buf)[0]
+        assert event["type"] == "PUBLISH"
+        assert event["level"] == "trace"
+
+    def test_subscribe_events(self):
+        hook, buf = self._hook()
+        p = Packet(fixed=FixedHeader(type=PacketType.SUBSCRIBE))
+        p.filters = [Subscription(filter="a/+", qos=1)]
+        hook.on_subscribed(_FakeClient(), p, [1], [1])
+        hook.on_unsubscribed(_FakeClient(), p)
+        set_severity_level("info")
+        events = self._events(buf)
+        assert events[0]["filters"] == ["a/+"]
+        assert events[1]["message"] == "client unsubscribed"
+
+
+async def test_http_stats_listener():
+    broker = Broker(BrokerOptions(capabilities=Capabilities(
+        sys_topic_interval=0)))
+    from maxmq_tpu.hooks import AllowHook
+    broker.add_hook(AllowHook())
+    listener = broker.add_listener(
+        HTTPStatsListener("stats", "127.0.0.1:0", lambda: broker.info))
+    await broker.serve()
+    try:
+        port = listener._server.sockets[0].getsockname()[1]
+        import asyncio
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(b"GET /sys HTTP/1.1\r\nHost: x\r\n\r\n")
+        await writer.drain()
+        raw = await asyncio.wait_for(reader.read(), timeout=5)
+        head, _, body = raw.partition(b"\r\n\r\n")
+        assert b"200 OK" in head
+        data = json.loads(body)
+        assert data["version"] == broker.info.version
+        assert "clients_connected" in data
+    finally:
+        await broker.close()
