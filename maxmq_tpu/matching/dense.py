@@ -204,8 +204,14 @@ def dense_match_body(level_consts, toks, lengths, dollar, n_rows: int,
         matched = jnp.concatenate(emitted, axis=1)   # [B, R] col == row id
     else:
         matched = jnp.zeros((batch, 0), dtype=bool)
+    return pack_and_extract(matched, lengths, n_rows, max_words)
 
-    # pack columns into uint32 words
+
+def pack_and_extract(matched, lengths, n_rows: int, max_words: int):
+    """Shared tail of every device matcher: pack the [B, R] matched-row
+    matrix into uint32 words and extract the (few) nonzero words sparsely.
+    Used by both the XLA dense walk and the Pallas kernel wrapper."""
+    batch = matched.shape[0]
     n_words = max((n_rows + 31) // 32, max_words)
     pad = n_words * 32 - matched.shape[1]
     if pad:
@@ -238,12 +244,18 @@ class DenseEngine:
 
     def __init__(self, index: TopicIndex, max_levels: int = 16,
                  max_words: int = 32, device=None,
-                 auto_refresh: bool = True) -> None:
+                 auto_refresh: bool = True,
+                 use_pallas: bool | str = False) -> None:
+        """``use_pallas``: False = XLA dense walk; True = Pallas fused
+        kernel (error if the tables exceed its VMEM capacity); "auto" =
+        Pallas while the tables fit, XLA walk once they outgrow it."""
         self.index = index
         self.max_levels = max_levels
         self.max_words = max_words
         self.device = device
         self.auto_refresh = auto_refresh
+        self.use_pallas = use_pallas
+        self.pallas_active = False
         # (tables, consts, fn, fn_many): swapped as ONE attribute so a
         # concurrent match_raw always sees a consistent compile
         self._state = None
@@ -265,6 +277,28 @@ class DenseEngine:
                     and state[0].version == self.index.version):
                 return False
             tables = compile_dense(self.index)
+            if self.use_pallas:
+                from . import pallas_kernel
+                if pallas_kernel.fits(tables):
+                    matcher = pallas_kernel.PallasMatcher(
+                        tables, self.max_levels, self.max_words)
+
+                    def fn_many_pallas(toks, lengths, dollar):
+                        def step(carry, inp):
+                            return carry, matcher._fn(*inp)
+                        _, out = jax.lax.scan(
+                            step, 0, (toks, lengths, dollar))
+                        return out
+
+                    self.pallas_active = True
+                    self._state = (tables, None, matcher._fn,
+                                   jax.jit(fn_many_pallas))
+                    return True
+                if self.use_pallas is True:
+                    raise ValueError(
+                        "use_pallas=True but tables exceed kernel capacity"
+                        " (use 'auto' to fall back to the XLA walk)")
+                self.pallas_active = False
             consts = tuple(
                 (jax.device_put(jnp.asarray(lv.child_tok), self.device),
                  jax.device_put(jnp.asarray(lv.parent_idx), self.device),

@@ -1,0 +1,235 @@
+"""Pallas TPU kernel for the dense trie walk — the fused-VMEM matcher.
+
+This is the "micro-batched Pallas trie-walk kernel" of the north star: the
+whole L-level walk runs inside ONE kernel, the active-state matrix never
+leaves VMEM between levels, and the one data-dependent operation of the walk
+— reading each slot's parent state — is formulated as a one-hot *expansion
+matmul* on the MXU instead of a gather (TPU has no fast vector gather; a
+[B, S] x [S, S] one-hot matmul IS the hardware's native way to permute /
+replicate columns):
+
+    s_{l+1} = (s_l @ E_l) * match(tok_l, child_tok_l)
+
+where ``E_l[p, j] = 1`` iff slot j's parent at level l-1 is p (exactly one 1
+per column, so the product is an exact 0/1 selection even in bfloat16).
+Everything else is broadcast compares on the VPU — identical semantics to
+``dense.dense_match_body`` (MQTT-4.7.1-2/3 wildcards, 4.7.1.2 parent match,
+4.7.2-1 '$' guard), and exact-parity-tested against it.
+
+The expansion matrices make VMEM the budget: E is [S, S] bf16 per level, so
+this path is for small/medium tables (S <= 512 slots/level, <= 2048 subscriber
+rows by default — roughly tens of thousands of subscriptions depending on
+trie shape). ``fits()`` reports whether a compiled DenseTables qualifies;
+DenseEngine(use_pallas=...) falls back to the XLA dense walk otherwise.
+Batch is tiled over a grid; table inputs are replicated per tile.
+
+Parity surface: vendor/github.com/mochi-co/mqtt/v2/topics.go:484-555 in the
+reference (Subscribers/scanSubscribers), via dense.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .dense import HASH, PLUS, DenseTables, pack_and_extract
+
+NEVER = -5            # child_tok value for padding slots: matches nothing
+
+# Default capacity limits — chosen so every buffer (E stack dominates:
+# L * S * S * 2 bytes) stays well inside the ~16MB VMEM budget.
+MAX_SLOTS = 512       # S: slots per level, padded to a lane multiple
+MAX_LEVELS = 8        # L: trie depth the kernel unrolls
+MAX_ROWS = 2048       # R: subscriber-carrying rows (output width)
+BATCH_TILE = 256      # topics per grid step
+
+
+@dataclass
+class PallasTables:
+    """Host-side staging of DenseTables in kernel layout."""
+
+    child_tok: np.ndarray   # int32[L, S]
+    expand: np.ndarray      # bfloat16[L, S, S]  E_l, one-hot per column
+    emit_exact: np.ndarray  # int32[L, S] 1 = at_end-gated emitter slot
+    n_emit: list[int]       # emitting slots per level (prefix of the level)
+    emit_base: list[int]    # global row offset of each level's emitters
+    n_rows: int
+    n_levels: int
+    slots: int
+
+
+def fits(tables: DenseTables, max_slots: int = MAX_SLOTS,
+         max_levels: int = MAX_LEVELS, max_rows: int = MAX_ROWS) -> bool:
+    """Whether the compiled dense tables qualify for the Pallas path."""
+    if tables.n_rows > max_rows or len(tables.levels) > max_levels:
+        return False
+    return all(len(lv.child_tok) <= max_slots for lv in tables.levels)
+
+
+def stage(tables: DenseTables, slots: int | None = None,
+          max_levels: int | None = None) -> PallasTables:
+    """Pad/stack DenseTables' ragged per-level arrays into kernel layout.
+
+    ``max_levels`` trims trie levels deeper than the tokenizer window, the
+    same cut dense_match_body makes (deeper filters only match topics that
+    overflow to the CPU trie anyway)."""
+    levels = tables.levels
+    if max_levels is not None:
+        levels = levels[:max_levels + 1]
+    n_levels = max(len(levels), 1)
+    if slots is None:
+        width = max([1] + [len(lv.child_tok) for lv in levels])
+        slots = max(128, -(-width // 128) * 128)
+
+    child_tok = np.full((n_levels, slots), NEVER, dtype=np.int32)
+    expand = np.zeros((n_levels, slots, slots), dtype=np.float32)
+    emit_exact = np.zeros((n_levels, slots), dtype=np.int32)
+    n_emit: list[int] = []
+    emit_base: list[int] = []
+    base = 0
+    for l, lv in enumerate(levels):
+        s_l = len(lv.child_tok)
+        child_tok[l, :s_l] = lv.child_tok
+        # E_l: one 1 per column at the parent's index. Level 0's conceptual
+        # parent is the root; its parent_idx is all zeros, and the initial
+        # state is all-ones, so column sums of 1 keep s exact.
+        expand[l, lv.parent_idx, np.arange(s_l)] = 1.0
+        t = len(lv.emit_exact)
+        emit_exact[l, :t] = lv.emit_exact.astype(np.int32)
+        n_emit.append(t)
+        emit_base.append(base)
+        base += t
+    return PallasTables(
+        child_tok=child_tok,
+        expand=expand.astype(jnp.bfloat16),
+        emit_exact=emit_exact, n_emit=n_emit, emit_base=emit_base,
+        n_rows=tables.n_rows, n_levels=n_levels, slots=slots)
+
+
+def _make_kernel(pt: PallasTables, rows_pad: int):
+    """The kernel body, with the level loop unrolled at trace time (level
+    count, slot widths and emission offsets are all static)."""
+    n_levels, slots = pt.n_levels, pt.slots
+    n_emit, emit_base = pt.n_emit, pt.emit_base
+
+    def kernel(toks_ref, lengths_ref, dollar_ref, child_ref, expand_ref,
+               exact_ref, out_ref):
+        out_ref[:] = jnp.zeros_like(out_ref)
+        tb = toks_ref.shape[0]
+        lengths = lengths_ref[:, 0][:, None]           # [TB, 1]
+        dollar = dollar_ref[:, 0][:, None] != 0        # [TB, 1]
+        s = jnp.ones((tb, slots), dtype=jnp.float32)
+        for l in range(n_levels):
+            tok = toks_ref[:, l][:, None]              # [TB, 1]
+            ct = child_ref[l, :][None, :]              # [1, S]
+            eq = tok == ct
+            plus_ok = (ct == PLUS) & (tok >= 0)
+            hash_ok = ct == HASH     # incl. first pad -1: 4.7.1.2
+            wild = plus_ok | hash_ok
+            if l == 0:
+                wild = wild & ~dollar                  # [MQTT-4.7.2-1]
+            # parent gather as one-hot expansion matmul (exact 0/1)
+            s_par = jax.lax.dot(
+                s.astype(jnp.bfloat16), expand_ref[l],
+                preferred_element_type=jnp.float32)
+            s = jnp.where(eq | wild, s_par, 0.0)
+            t = n_emit[l]
+            if t:
+                cols = s[:, :t] > 0.0
+                at_end = lengths == l + 1
+                exact = exact_ref[l, :t][None, :] != 0
+                gate = at_end | ~exact                 # '#' rows ungated
+                base = emit_base[l]
+                out_ref[:, base:base + t] = (cols & gate).astype(jnp.float32)
+
+    return kernel
+
+
+class PallasMatcher:
+    """Compiled Pallas matcher over one DenseTables snapshot.
+
+    ``__call__(toks, lengths, dollar)`` has the same contract as
+    ``dense_match_body``: (word_idx, word_val, overflow).
+    """
+
+    def __init__(self, tables: DenseTables, max_levels: int,
+                 max_words: int = 32, batch_tile: int = BATCH_TILE,
+                 interpret: bool | None = None) -> None:
+        if not fits(tables):
+            raise ValueError("tables exceed the Pallas kernel capacity; "
+                             "use the XLA dense path")
+        self.tables = tables
+        self.max_levels = max_levels
+        self.max_words = max_words
+        self.batch_tile = batch_tile
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        self.interpret = interpret
+        pt = stage(tables, max_levels=max_levels)
+        self.pt = pt
+        self.rows_pad = max(128, -(-max(pt.n_rows, 1) // 128) * 128)
+        self._dev = (jnp.asarray(pt.child_tok), jnp.asarray(pt.expand),
+                     jnp.asarray(pt.emit_exact))
+        self._fn = jax.jit(self._build())
+
+    def _build(self):
+        pt, rows_pad, tile = self.pt, self.rows_pad, self.batch_tile
+        kernel = _make_kernel(pt, rows_pad)
+        n_levels, slots = pt.n_levels, pt.slots
+        interpret = self.interpret
+        n_rows, max_words = pt.n_rows, self.max_words
+
+        def run(toks, lengths, dollar):
+            batch = toks.shape[0]
+            tb = min(tile, max(8, batch))
+            padded = -(-batch // tb) * tb
+            if padded != batch:
+                toks = jnp.pad(toks, ((0, padded - batch), (0, 0)),
+                               constant_values=-1)
+                lengths = jnp.pad(lengths, (0, padded - batch))
+                dollar = jnp.pad(dollar, (0, padded - batch))
+            # one trailing pad column: '#' parent match at the last level
+            toks = jnp.concatenate(
+                [toks, jnp.full((padded, 1), -1, dtype=jnp.int32)], axis=1)
+            toks = toks[:, :max(n_levels, 1)]
+            grid = (padded // tb,)
+            matched = pl.pallas_call(
+                kernel,
+                grid=grid,
+                in_specs=[
+                    pl.BlockSpec((tb, toks.shape[1]), lambda i: (i, 0),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((tb, 1), lambda i: (i, 0),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((tb, 1), lambda i: (i, 0),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((n_levels, slots), lambda i: (0, 0),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((n_levels, slots, slots),
+                                 lambda i: (0, 0, 0),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((n_levels, slots), lambda i: (0, 0),
+                                 memory_space=pltpu.VMEM),
+                ],
+                out_specs=pl.BlockSpec((tb, rows_pad), lambda i: (i, 0),
+                                       memory_space=pltpu.VMEM),
+                out_shape=jax.ShapeDtypeStruct((padded, rows_pad),
+                                               jnp.float32),
+                interpret=interpret,
+            )(toks, lengths[:, None].astype(jnp.int32),
+              dollar[:, None].astype(jnp.int32), *self._dev)
+            matched = matched[:batch, :n_rows] > 0.0
+            return pack_and_extract(matched, lengths[:batch], n_rows,
+                                    max_words)
+
+        return run
+
+    def __call__(self, toks, lengths, dollar):
+        return self._fn(jnp.asarray(toks), jnp.asarray(lengths),
+                        jnp.asarray(dollar))

@@ -1,0 +1,155 @@
+"""Exact parity for the Pallas fused trie-walk kernel (interpret mode on the
+CPU backend) against the CPU reference trie and the XLA dense walk — same
+corpora the other device matchers are held to."""
+
+import random
+
+import pytest
+
+from maxmq_tpu.matching import TopicIndex
+from maxmq_tpu.matching.dense import DenseEngine, compile_dense
+from maxmq_tpu.matching.pallas_kernel import PallasMatcher, fits, stage
+from maxmq_tpu.protocol import Subscription
+
+from test_nfa_parity import normalize, rand_corpus
+
+
+def check_parity(index, topics, **engine_kw):
+    engine = DenseEngine(index, use_pallas=True, **engine_kw)
+    assert engine.pallas_active
+    got = engine.subscribers_batch(topics)
+    for topic, result in zip(topics, got):
+        want = index.subscribers(topic)
+        assert normalize(result) == normalize(want), (
+            f"mismatch on topic {topic!r}")
+    return engine
+
+
+def test_exact_and_wildcard_basics():
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="a/b/c", qos=1))
+    idx.subscribe("c2", Subscription(filter="a/+/c", qos=2))
+    idx.subscribe("c3", Subscription(filter="a/#"))
+    idx.subscribe("c4", Subscription(filter="#"))
+    idx.subscribe("c5", Subscription(filter="+"))
+    check_parity(idx, ["a/b/c", "a/x/c", "a", "a/b", "x", "x/y",
+                       "a/b/c/d", "$SYS/x", "$SYS"])
+
+
+def test_hash_parent_and_dollar_rules():
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="sport/tennis/#"))
+    idx.subscribe("c2", Subscription(filter="$SYS/#"))
+    idx.subscribe("c3", Subscription(filter="$SYS/+/x"))
+    idx.subscribe("c4", Subscription(filter="+/tennis/+"))
+    check_parity(idx, ["sport/tennis", "sport/tennis/p1", "sport",
+                       "$SYS/broker/x", "$SYS/broker", "$SYS",
+                       "a/tennis/b"])
+
+
+def test_shared_and_merge_semantics():
+    idx = TopicIndex()
+    idx.subscribe("w1", Subscription(filter="$share/g1/t/+"))
+    idx.subscribe("w2", Subscription(filter="$share/g1/t/+"))
+    idx.subscribe("w3", Subscription(filter="$share/g2/t/a"))
+    idx.subscribe("c1", Subscription(filter="t/+", qos=0, identifier=3))
+    idx.subscribe("c1", Subscription(filter="t/a", qos=2, identifier=9))
+    idx.subscribe("c1", Subscription(filter="t/#", qos=1, identifier=4))
+    check_parity(idx, ["t/a", "t/b", "t", "x"])
+
+
+def test_hash_at_max_levels_boundary():
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="l0/l1/l2/l3/#"))
+    engine = check_parity(idx, ["l0/l1/l2/l3"], max_levels=4)
+    assert engine.fallbacks == 0
+
+
+def test_too_deep_topic_falls_back():
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="a/#"))
+    deep = "a/" + "/".join(str(i) for i in range(40))
+    engine = check_parity(idx, [deep], max_levels=8)
+    assert engine.fallbacks == 1
+
+
+def test_batch_padding_to_tile():
+    """Batch sizes that don't divide the tile exercise the pad/trim path."""
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="a/+"))
+    idx.subscribe("c2", Subscription(filter="b/#"))
+    topics = [f"a/{i}" for i in range(7)] + ["b", "b/x/y", "c"]
+    check_parity(idx, topics)
+
+
+def test_capacity_gate_and_auto_fallback():
+    tiny = TopicIndex()
+    tiny.subscribe("c1", Subscription(filter="a/b"))
+    assert fits(compile_dense(tiny))
+
+    # exceed MAX_ROWS so the kernel refuses and 'auto' falls back
+    big = TopicIndex()
+    for i in range(3000):
+        big.subscribe(f"c{i}", Subscription(filter=f"t/{i}"))
+    tables = compile_dense(big)
+    assert not fits(tables)
+    with pytest.raises(ValueError):
+        PallasMatcher(tables, max_levels=8)
+    with pytest.raises(ValueError):
+        DenseEngine(big, use_pallas=True)
+    engine = DenseEngine(big, use_pallas="auto")
+    assert not engine.pallas_active
+    assert sorted(engine.subscribers("t/7").subscriptions) == ["c7"]
+
+
+def test_stage_layout():
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="a/b"))
+    idx.subscribe("c2", Subscription(filter="a/+"))
+    idx.subscribe("c3", Subscription(filter="x/#"))
+    pt = stage(compile_dense(idx))
+    assert pt.slots % 128 == 0
+    # every expansion column is one-hot (exactly one parent per slot) or
+    # all-zero padding
+    sums = pt.expand.astype(float).sum(axis=1)
+    assert ((sums == 1.0) | (sums == 0.0)).all()
+
+
+def test_matches_dense_body_word_output():
+    """The kernel wrapper and the XLA walk must produce identical sparse
+    word outputs, not just identical decoded sets."""
+    import numpy as np
+
+    idx = TopicIndex()
+    rng = random.Random(9)
+    for i in range(60):
+        parts = [rng.choice(["a", "b", "c", "+"])
+                 for _ in range(rng.randint(1, 4))]
+        if rng.random() < 0.3:
+            parts.append("#")
+        idx.subscribe(f"c{i}", Subscription(filter="/".join(parts)))
+    xla = DenseEngine(idx, max_levels=8)
+    pk = DenseEngine(idx, max_levels=8, use_pallas=True)
+    topics = ["/".join(rng.choice(["a", "b", "c", "d"])
+                       for _ in range(rng.randint(1, 5)))
+              for _ in range(33)]
+    wi1, wv1, of1, _ = xla.match_raw(topics)
+    wi2, wv2, of2, _ = pk.match_raw(topics)
+    assert np.array_equal(of1, of2)
+    assert np.array_equal(wi1, wi2)
+    assert np.array_equal(wv1, wv2)
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_randomized_parity(seed):
+    rng = random.Random(seed)
+    idx = TopicIndex()
+    filters, topics = rand_corpus(rng, n_filters=100, n_clients=25)
+    from maxmq_tpu.matching.topics import valid_filter
+    for i, f in enumerate(filters):
+        if not valid_filter(f):
+            continue
+        idx.subscribe(f"c{i % 25}",
+                      Subscription(filter=f, qos=rng.randint(0, 2),
+                                   identifier=rng.randint(0, 5)))
+    check_parity(idx, topics)
