@@ -41,7 +41,7 @@ import jax
 import jax.numpy as jnp
 
 from .nfa import Entry, EntryBuilder
-from .topics import UNK, intern_level, split_levels, tokenize_topics
+from .topics import UNK, intern_level, split_levels, tokenize_cached
 from .trie import SubscriberSet, TopicIndex
 
 PLUS = -2    # '+' sentinel in child_tok
@@ -70,8 +70,9 @@ class DenseTables:
     version: int = -1
 
     def tokenize(self, topics: list[str], max_levels: int):
-        """Host-side topic prep (shared impl: topics.tokenize_topics)."""
-        return tokenize_topics(self.vocab, topics, max_levels)
+        """Host-side topic prep (C++ tokenizer when built, else the shared
+        Python impl — topics.tokenize_cached)."""
+        return tokenize_cached(self, topics, max_levels)
 
 
 class _Node:

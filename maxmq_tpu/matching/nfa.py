@@ -36,7 +36,7 @@ import numpy as np
 
 from ..protocol.packets import Subscription
 from .topics import (UNK, intern_level, parse_share, split_levels,
-                     tokenize_topics)
+                     tokenize_cached)
 
 MAX_PROBES = 8   # linear-probe bound enforced at build time
 
@@ -132,8 +132,9 @@ class NFATables:
         return len(self.hash_node)
 
     def tokenize(self, topics: list[str], max_levels: int):
-        """Host-side topic prep (shared impl: topics.tokenize_topics)."""
-        return tokenize_topics(self.vocab, topics, max_levels)
+        """Host-side topic prep (C++ tokenizer when built, else the shared
+        Python impl — topics.tokenize_cached)."""
+        return tokenize_cached(self, topics, max_levels)
 
 
 class _BuildNode:

@@ -82,6 +82,27 @@ def intern_level(vocab: dict[str, int], level: str) -> int:
     return tok
 
 
+def tokenize_cached(tables, topics: list[str], max_levels: int):
+    """Tokenize via the C++ native tokenizer when available, else the Python
+    loop. ``tables`` is an immutable compiled-table snapshot with a ``vocab``
+    dict; the native vocab mirror is built once per snapshot and cached on
+    it (compiles always start from a fresh vocab, so the snapshot's dict
+    never mutates afterwards)."""
+    nv = tables.__dict__.get("_native_vocab", False)
+    if nv is False:
+        nv = None
+        try:
+            from ..native import NativeVocab, available
+            if available():
+                nv = NativeVocab(tables.vocab)
+        except Exception:
+            nv = None
+        tables.__dict__["_native_vocab"] = nv
+    if nv is not None:
+        return nv.tokenize(topics, max_levels)
+    return tokenize_topics(tables.vocab, topics, max_levels)
+
+
 def tokenize_topics(vocab: dict[str, int], topics: list[str],
                     max_levels: int):
     """Host-side topic prep shared by both compiled-table flavors: token ids

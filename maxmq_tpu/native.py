@@ -1,0 +1,180 @@
+"""ctypes bindings for the C++ native host runtime (native/maxmq_native.cpp).
+
+Loads ``libmaxmq_native.so`` (building it with ``make -C native`` on first
+use if a compiler is available), and exposes:
+
+* ``NativeVocab`` / ``tokenize`` — the batch topic tokenizer feeding the TPU
+  matchers; exact drop-in for matching/topics.py:tokenize_topics.
+* ``scan_frames`` — the MQTT fixed-header frame scanner; slices a byte
+  buffer of concatenated control packets into frames without per-byte
+  Python work (same framing rules as protocol/codec.py).
+
+Everything degrades gracefully: ``available()`` is False when the library
+can't be built/loaded (or MAXMQ_NO_NATIVE is set) and callers fall back to
+the pure-Python paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libmaxmq_native.so")
+
+_lib = None
+_load_lock = threading.Lock()
+_load_attempted = False
+
+
+def _try_load():
+    global _lib, _load_attempted
+    with _load_lock:
+        if _load_attempted:
+            return _lib
+        _load_attempted = True
+        if os.environ.get("MAXMQ_NO_NATIVE"):
+            return None
+        if not os.path.exists(_SO_PATH) and os.path.isdir(_NATIVE_DIR):
+            try:
+                subprocess.run(["make", "-C", _NATIVE_DIR, "-s"],
+                               check=True, capture_output=True, timeout=120)
+            except Exception:
+                return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            return None
+        lib.mq_vocab_new.restype = ctypes.c_void_p
+        lib.mq_vocab_free.argtypes = [ctypes.c_void_p]
+        lib.mq_vocab_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int64, ctypes.c_int32]
+        lib.mq_vocab_size.argtypes = [ctypes.c_void_p]
+        lib.mq_vocab_size.restype = ctypes.c_int64
+        lib.mq_tokenize.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            np.ctypeslib.ndpointer(np.int64), ctypes.c_int64,
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.int32),
+            np.ctypeslib.ndpointer(np.int32),
+            np.ctypeslib.ndpointer(np.uint8)]
+        lib.mq_tokenize_joined.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.int32),
+            np.ctypeslib.ndpointer(np.int32),
+            np.ctypeslib.ndpointer(np.uint8)]
+        lib.mq_scan_frames.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.int64),
+            np.ctypeslib.ndpointer(np.int64),
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int64)]
+        lib.mq_scan_frames.restype = ctypes.c_int64
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _try_load() is not None
+
+
+class NativeVocab:
+    """C++ mirror of a matcher vocabulary dict (level string -> token id).
+    Built once per table refresh; reads are lock-free in C++."""
+
+    def __init__(self, vocab: dict[str, int]) -> None:
+        lib = _try_load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._handle = ctypes.c_void_p(lib.mq_vocab_new())
+        for level, tok in vocab.items():
+            raw = level.encode("utf-8")
+            lib.mq_vocab_add(self._handle, raw, len(raw), tok)
+
+    def __len__(self) -> int:
+        return int(self._lib.mq_vocab_size(self._handle))
+
+    def __del__(self):
+        handle, self._handle = getattr(self, "_handle", None), None
+        if handle and getattr(self, "_lib", None) is not None:
+            self._lib.mq_vocab_free(handle)
+
+    def tokenize(self, topics: list[str], max_levels: int):
+        """Same contract as matching/topics.py:tokenize_topics. Topics are
+        shipped as ONE NUL-joined utf-8 buffer (U+0000 can't appear in an
+        MQTT topic name [MQTT-1.5.4-2]) and split in C."""
+        n = len(topics)
+        buf = "\x00".join(topics).encode("utf-8")
+        toks = np.empty((n, max_levels), dtype=np.int32)
+        lengths = np.empty(n, dtype=np.int32)
+        dollar = np.empty(n, dtype=np.uint8)
+        self._lib.mq_tokenize_joined(self._handle, buf, len(buf), n,
+                                     max_levels, toks, lengths, dollar)
+        return toks, lengths, dollar.astype(bool)
+
+
+class MalformedFrame(ValueError):
+    """The buffer contains an invalid fixed header (reserved type 0 or a
+    variable-byte integer longer than 4 bytes, MQTT-1.5.5)."""
+
+
+def scan_frames(data: bytes, max_frames: int = 4096
+                ) -> tuple[list[tuple[int, int]], int]:
+    """Scan ``data`` for complete MQTT frames.
+
+    Returns ``(frames, consumed)`` where frames is a list of (start, end)
+    byte ranges and consumed is the offset scanning stopped at (start of the
+    first incomplete frame — the caller keeps ``data[consumed:]`` for the
+    next read). Raises MalformedFrame on an invalid header.
+    """
+    lib = _try_load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    starts = np.empty(max_frames, dtype=np.int64)
+    totals = np.empty(max_frames, dtype=np.int64)
+    consumed = ctypes.c_int64(0)
+    n = lib.mq_scan_frames(data, len(data), starts, totals, max_frames,
+                           ctypes.byref(consumed))
+    if n < 0:
+        raise MalformedFrame(f"invalid fixed header at offset {consumed.value}")
+    return ([(int(starts[i]), int(starts[i] + totals[i])) for i in range(n)],
+            int(consumed.value))
+
+
+def scan_frames_py(data: bytes, max_frames: int = 4096
+                   ) -> tuple[list[tuple[int, int]], int]:
+    """Pure-Python reference for scan_frames (also the fallback)."""
+    frames: list[tuple[int, int]] = []
+    pos = 0
+    while pos < len(data) and len(frames) < max_frames:
+        if (data[pos] >> 4) == 0:
+            raise MalformedFrame(f"invalid fixed header at offset {pos}")
+        rem = 0
+        shift = 0
+        vpos = pos + 1
+        complete = False
+        while vpos < len(data):
+            b = data[vpos]
+            vpos += 1
+            rem |= (b & 0x7F) << shift
+            shift += 7
+            if not b & 0x80:
+                complete = True
+                break
+            if shift > 21:
+                raise MalformedFrame(
+                    f"invalid fixed header at offset {pos}")
+        if not complete:
+            break
+        total = (vpos - pos) + rem
+        if pos + total > len(data):
+            break
+        frames.append((pos, pos + total))
+        pos += total
+    return frames, pos

@@ -1,0 +1,182 @@
+// Native host runtime for maxmq-tpu: the host-side hot loops that feed the
+// TPU matcher, in C++ behind a C ABI (loaded from Python via ctypes).
+//
+// Two components:
+//   1. Batch topic tokenizer — splits topic strings on '/', interns levels
+//      against the matcher vocabulary and emits the fixed-width int32 token
+//      matrix the device kernels consume. Replaces the per-topic Python loop
+//      in maxmq_tpu/matching/topics.py:tokenize_topics (the semantics MUST
+//      stay identical — parity-tested from tests/test_native.py).
+//   2. MQTT frame scanner — walks a byte buffer of concatenated MQTT control
+//      packets (fixed header: type byte + variable-byte-integer remaining
+//      length, MQTT 5.0 spec 2.1.1/1.5.5) and returns frame boundaries, so a
+//      listener can slice a large read into packets without touching Python
+//      per byte. Mirrors the framing rules of
+//      maxmq_tpu/protocol/codec.py:FixedHeader/read_varint.
+//
+// The reference broker has no native components (SURVEY.md section 2: pure
+// Go); these are the TPU build's native equivalents for its zero-alloc hot
+// paths (vendor/github.com/mochi-co/mqtt/v2/packets/codec.go:15-19).
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+
+namespace {
+
+struct Vocab {
+  std::unordered_map<std::string, int32_t> map;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* mq_vocab_new() { return new Vocab(); }
+
+void mq_vocab_free(void* v) { delete static_cast<Vocab*>(v); }
+
+void mq_vocab_add(void* v, const char* s, int64_t len, int32_t tok) {
+  static_cast<Vocab*>(v)->map.emplace(std::string(s, len), tok);
+}
+
+int64_t mq_vocab_size(void* v) {
+  return static_cast<int64_t>(static_cast<Vocab*>(v)->map.size());
+}
+
+// Tokenize n_topics topics stored concatenated in `buf` with boundaries
+// `offsets` (length n_topics + 1, offsets[i]..offsets[i+1] is topic i).
+// Outputs (caller-allocated):
+//   toks    int32[n_topics * max_levels]  token ids, -1 padded
+//   lengths int32[n_topics]               level count, -1 if > max_levels
+//   dollar  uint8[n_topics]               1 if the topic starts with '$'
+// Unknown levels get token 0 (UNK). Split keeps empty levels, matching
+// topics.py:split_levels ("a//b" -> 3 levels).
+void mq_tokenize(void* v, const char* buf, const int64_t* offsets,
+                 int64_t n_topics, int64_t max_levels, int32_t* toks,
+                 int32_t* lengths, uint8_t* dollar) {
+  const auto& map = static_cast<Vocab*>(v)->map;
+  for (int64_t i = 0; i < n_topics; ++i) {
+    const char* start = buf + offsets[i];
+    const int64_t tlen = offsets[i + 1] - offsets[i];
+    dollar[i] = (tlen > 0 && start[0] == '$') ? 1 : 0;
+    int32_t* row = toks + i * max_levels;
+    for (int64_t j = 0; j < max_levels; ++j) row[j] = -1;
+
+    int64_t n_levels = 0;
+    int64_t level_start = 0;
+    bool overflow = false;
+    for (int64_t p = 0; p <= tlen; ++p) {
+      if (p == tlen || start[p] == '/') {
+        if (n_levels >= max_levels) {
+          overflow = true;
+          break;
+        }
+        std::string level(start + level_start, p - level_start);
+        auto it = map.find(level);
+        row[n_levels] = (it == map.end()) ? 0 : it->second;
+        ++n_levels;
+        level_start = p + 1;
+      }
+    }
+    if (overflow) {
+      lengths[i] = -1;
+      for (int64_t j = 0; j < max_levels; ++j) row[j] = -1;
+    } else {
+      lengths[i] = static_cast<int32_t>(n_levels);
+    }
+  }
+}
+
+// Like mq_tokenize, but topics arrive as ONE UTF-8 buffer separated by NUL
+// bytes (U+0000 is forbidden inside MQTT topic names [MQTT-1.5.4-2], so the
+// separator is unambiguous). Avoids per-topic Python string marshalling.
+void mq_tokenize_joined(void* v, const char* buf, int64_t buf_len,
+                        int64_t n_topics, int64_t max_levels, int32_t* toks,
+                        int32_t* lengths, uint8_t* dollar) {
+  const auto& map = static_cast<Vocab*>(v)->map;
+  int64_t topic_start = 0;
+  int64_t i = 0;
+  for (int64_t end = 0; end <= buf_len && i < n_topics; ++end) {
+    if (end != buf_len && buf[end] != '\0') continue;
+    const char* start = buf + topic_start;
+    const int64_t tlen = end - topic_start;
+    dollar[i] = (tlen > 0 && start[0] == '$') ? 1 : 0;
+    int32_t* row = toks + i * max_levels;
+    for (int64_t j = 0; j < max_levels; ++j) row[j] = -1;
+    int64_t n_levels = 0;
+    int64_t level_start = 0;
+    bool overflow = false;
+    for (int64_t p = 0; p <= tlen; ++p) {
+      if (p == tlen || start[p] == '/') {
+        if (n_levels >= max_levels) {
+          overflow = true;
+          break;
+        }
+        auto it = map.find(std::string(start + level_start, p - level_start));
+        row[n_levels] = (it == map.end()) ? 0 : it->second;
+        ++n_levels;
+        level_start = p + 1;
+      }
+    }
+    if (overflow) {
+      lengths[i] = -1;
+      for (int64_t j = 0; j < max_levels; ++j) row[j] = -1;
+    } else {
+      lengths[i] = static_cast<int32_t>(n_levels);
+    }
+    topic_start = end + 1;
+    ++i;
+  }
+}
+
+// Scan `buf` (len bytes) for complete MQTT control-packet frames.
+// For each complete frame i < max_frames: starts[i] = offset of the fixed
+// header byte, totals[i] = total frame size (header + varint + body).
+// Returns the number of complete frames found (scanning stops at the first
+// incomplete frame — its offset is *consumed_out), or -1 if a malformed
+// variable-byte integer is encountered (more than 4 continuation bytes,
+// MQTT-1.5.5) or a zero packet type.
+int64_t mq_scan_frames(const uint8_t* buf, int64_t len, int64_t* starts,
+                       int64_t* totals, int64_t max_frames,
+                       int64_t* consumed_out) {
+  int64_t pos = 0;
+  int64_t count = 0;
+  while (pos < len && count < max_frames) {
+    if ((buf[pos] >> 4) == 0) {
+      *consumed_out = pos;
+      return -1;  // packet type 0 is reserved/invalid
+    }
+    // variable-byte integer remaining length
+    int64_t rem = 0;
+    int shift = 0;
+    int64_t vpos = pos + 1;
+    bool complete = false;
+    while (vpos < len) {
+      uint8_t b = buf[vpos++];
+      rem |= static_cast<int64_t>(b & 0x7F) << shift;
+      shift += 7;
+      if ((b & 0x80) == 0) {
+        complete = true;
+        break;
+      }
+      if (shift > 21) {
+        *consumed_out = pos;
+        return -1;  // > 4 varint bytes is malformed [MQTT-1.5.5]
+      }
+    }
+    if (!complete) break;  // header truncated: wait for more bytes
+    const int64_t total = (vpos - pos) + rem;
+    if (pos + total > len) break;  // body truncated
+    starts[count] = pos;
+    totals[count] = total;
+    ++count;
+    pos += total;
+  }
+  *consumed_out = pos;
+  return count;
+}
+
+}  // extern "C"

@@ -1,0 +1,151 @@
+"""Tests for the C++ native host runtime: tokenizer parity with the Python
+path and frame-scanner parity with both the Python reference scanner and the
+real packet codec."""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from maxmq_tpu import native
+from maxmq_tpu.matching.topics import tokenize_topics
+from maxmq_tpu.protocol.codec import FixedHeader, PacketType
+from maxmq_tpu.protocol.packets import Packet, Subscription
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library not built")
+
+
+def rand_topics(rng: random.Random, n: int) -> list[str]:
+    segs = ["sensor", "data", "", "Ω-unit", "dev1", "$SYS", "a" * 60, "+",
+            "#", "x"]
+    out = []
+    for _ in range(n):
+        depth = rng.randint(1, 12)
+        out.append("/".join(rng.choice(segs) for _ in range(depth)))
+    out += ["", "/", "//", "$", "$SYS/broker/load", "no-slash"]
+    return out
+
+
+class TestTokenizer:
+    def test_parity_with_python(self):
+        rng = random.Random(5)
+        vocab = {}
+        for i, level in enumerate(["sensor", "data", "dev1", "$SYS", "",
+                                   "Ω-unit", "x"]):
+            vocab[level] = i + 1
+        nv = native.NativeVocab(vocab)
+        assert len(nv) == len(vocab)
+        topics = rand_topics(rng, 500)
+        for max_levels in (1, 4, 16):
+            t1, l1, d1 = tokenize_topics(vocab, topics, max_levels)
+            t2, l2, d2 = nv.tokenize(topics, max_levels)
+            assert np.array_equal(l1, l2)
+            assert np.array_equal(d1, d2)
+            assert np.array_equal(t1, t2)
+
+    def test_unknown_levels_get_unk(self):
+        nv = native.NativeVocab({"a": 1})
+        toks, lengths, dollar = nv.tokenize(["a/zzz/a"], 4)
+        assert toks.tolist() == [[1, 0, 1, -1]]
+        assert lengths.tolist() == [3]
+        assert not dollar[0]
+
+    def test_overflow_marks_minus_one(self):
+        nv = native.NativeVocab({})
+        toks, lengths, _ = nv.tokenize(["a/b/c/d/e"], 3)
+        assert lengths.tolist() == [-1]
+        assert (toks == -1).all()
+
+    def test_engine_uses_native_tokenizer(self):
+        from maxmq_tpu.matching import TopicIndex
+        from maxmq_tpu.matching.dense import DenseEngine
+        idx = TopicIndex()
+        idx.subscribe("c1", Subscription(filter="a/+"))
+        engine = DenseEngine(idx)
+        assert sorted(engine.subscribers("a/b").subscriptions) == ["c1"]
+        assert engine.tables.__dict__.get("_native_vocab") is not None
+
+
+def encode(ptype: int, payload: bytes = b"") -> bytes:
+    out = bytearray([ptype << 4])
+    rem = len(payload)
+    while True:
+        b = rem % 128
+        rem //= 128
+        out.append(b | (0x80 if rem else 0))
+        if not rem:
+            break
+    return bytes(out) + payload
+
+
+class TestFrameScanner:
+    def test_complete_frames(self):
+        data = (encode(PacketType.PINGREQ) +
+                encode(PacketType.PUBLISH, b"x" * 300) +
+                encode(PacketType.DISCONNECT))
+        frames, consumed = native.scan_frames(data)
+        assert consumed == len(data)
+        assert [data[s] >> 4 for s, _ in frames] == [
+            PacketType.PINGREQ, PacketType.PUBLISH, PacketType.DISCONNECT]
+        assert frames == native.scan_frames_py(data)[0]
+
+    def test_partial_tail_frame(self):
+        full = encode(PacketType.PUBLISH, b"y" * 50)
+        data = encode(PacketType.PINGREQ) + full[:20]
+        frames, consumed = native.scan_frames(data)
+        assert len(frames) == 1
+        assert consumed == 2  # scanning stopped at the truncated PUBLISH
+        assert native.scan_frames_py(data) == (frames, consumed)
+
+    def test_truncated_varint_waits(self):
+        data = bytes([PacketType.PUBLISH << 4, 0x80, 0x80])
+        frames, consumed = native.scan_frames(data)
+        assert frames == [] and consumed == 0
+
+    def test_malformed_type_zero(self):
+        with pytest.raises(native.MalformedFrame):
+            native.scan_frames(b"\x00\x00")
+        with pytest.raises(native.MalformedFrame):
+            native.scan_frames_py(b"\x00\x00")
+
+    def test_malformed_overlong_varint(self):
+        data = bytes([PacketType.PUBLISH << 4, 0x80, 0x80, 0x80, 0x80, 0x80])
+        with pytest.raises(native.MalformedFrame):
+            native.scan_frames(data)
+        with pytest.raises(native.MalformedFrame):
+            native.scan_frames_py(data)
+
+    def test_parity_against_real_codec_stream(self):
+        """Scan a stream of real encoded packets; boundaries must slice
+        each packet exactly."""
+        packets = []
+        p = Packet(fixed=FixedHeader(type=PacketType.PUBLISH, qos=1))
+        p.topic, p.packet_id, p.payload = "a/b", 7, b"hello"
+        packets.append(p.encode())
+        s = Packet(fixed=FixedHeader(type=PacketType.SUBSCRIBE),
+                   protocol_version=5)
+        s.packet_id = 9
+        s.filters = [Subscription(filter="x/#", qos=1)]
+        packets.append(s.encode())
+        packets.append(Packet(
+            fixed=FixedHeader(type=PacketType.PINGRESP)).encode())
+        data = b"".join(packets)
+        frames, consumed = native.scan_frames(data)
+        assert consumed == len(data)
+        assert [data[a:b] for a, b in frames] == packets
+
+    def test_random_fuzz_parity(self):
+        rng = random.Random(11)
+        for _ in range(50):
+            data = bytes(rng.randrange(256)
+                         for _ in range(rng.randint(0, 200)))
+            try:
+                got = native.scan_frames(data)
+            except native.MalformedFrame:
+                with pytest.raises(native.MalformedFrame):
+                    native.scan_frames_py(data)
+                continue
+            assert got == native.scan_frames_py(data)
