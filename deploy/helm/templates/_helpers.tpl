@@ -1,0 +1,7 @@
+{{- define "maxmq-tpu.name" -}}
+{{- default .Chart.Name | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "maxmq-tpu.fullname" -}}
+{{- printf "%s-%s" .Release.Name (include "maxmq-tpu.name" .) | trunc 63 | trimSuffix "-" -}}
+{{- end -}}

@@ -91,7 +91,11 @@ def build_broker(conf: Config, logger: Logger) -> Broker:
     broker = Broker(BrokerOptions(capabilities=capabilities_from_config(conf),
                                   logger=logger.with_prefix("mqtt")))
     broker.add_hook(LoggingHook(logger.with_prefix("mqtt")))
-    broker.add_hook(AllowHook())
+    if conf.auth_ledger:
+        from .hooks.auth import Ledger, LedgerHook
+        broker.add_hook(LedgerHook(Ledger.from_file(conf.auth_ledger)))
+    else:
+        broker.add_hook(AllowHook())
     if conf.storage_backend:
         store = (MemoryStore() if conf.storage_backend == "memory"
                  else SQLiteStore(conf.storage_path))

@@ -102,6 +102,26 @@ class Ledger:
             ledger.acl.append(ACLRule(**r))
         return ledger
 
+    @classmethod
+    def from_json(cls, text: str) -> "Ledger":
+        import json
+        return cls.from_dict(json.loads(text))
+
+    @classmethod
+    def from_yaml(cls, text: str) -> "Ledger":
+        import yaml
+        return cls.from_dict(yaml.safe_load(text) or {})
+
+    @classmethod
+    def from_file(cls, path: str) -> "Ledger":
+        """Load rules from a .json or .yaml/.yml file (the reference's
+        ledger is YAML/JSON loadable, hooks/auth/ledger.go)."""
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        if path.endswith((".yaml", ".yml")):
+            return cls.from_yaml(text)
+        return cls.from_json(text)
+
 
 class LedgerHook(Hook):
     """Rule-based authentication + topic ACLs."""

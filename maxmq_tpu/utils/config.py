@@ -56,6 +56,10 @@ class Config:
     storage_backend: str = ""           # "" | memory | sqlite
     storage_path: str = "maxmq.db"
 
+    # -- auth ---------------------------------------------------------------
+    auth_ledger: str = ""               # path to rules (.json/.yaml); empty
+                                        # = allow-all
+
     # -- TPU matcher runtime (no reference equivalent: the north-star path) --
     matcher: str = "dense"              # trie | nfa | dense
     matcher_batch_window_us: int = 200

@@ -1,0 +1,151 @@
+"""Tests for the auth hooks: the rule ledger (auth + ACL), file loading, and
+end-to-end broker enforcement. Models vendor/.../v2/hooks/auth tests in the
+reference."""
+
+from __future__ import annotations
+
+import pytest
+
+from maxmq_tpu.hooks.auth import (ACLRule, AllowHook, AuthRule, Ledger,
+                                  LedgerHook, _filter_covers)
+
+
+class FakeClient:
+    def __init__(self, remote="10.0.0.1:5", cid="c1", username=b"u"):
+        self.remote = remote
+        self.id = cid
+
+        class P:
+            pass
+
+        self.properties = P()
+        self.properties.username = username
+
+
+class FakePacket:
+    def __init__(self, username=b"", password=b""):
+        self.username = username
+        self.password = password
+
+
+class TestRules:
+    def test_auth_rule_matching(self):
+        rule = AuthRule(username="alice", password="pw")
+        assert rule.matches("alice", "pw", "x", "y")
+        assert not rule.matches("alice", "bad", "x", "y")
+        assert not rule.matches("bob", "pw", "x", "y")
+
+    def test_prefix_wildcard_and_empty(self):
+        rule = AuthRule(remote="10.0.*")
+        assert rule.matches("anyone", "", "10.0.0.1:5", "c")
+        assert not rule.matches("anyone", "", "192.168.0.1:5", "c")
+        assert AuthRule().matches("", "", "", "")  # empty matches all
+
+    def test_filter_covers(self):
+        assert _filter_covers("a/+/c", "a/b/c")
+        assert _filter_covers("a/#", "a/b/c/d")
+        assert _filter_covers("#", "anything")
+        assert not _filter_covers("a/+", "a/b/c")
+        assert not _filter_covers("a/b", "a")
+
+    def test_acl_rule_access_levels(self):
+        rule = ACLRule(username="alice",
+                       filters={"secret/#": "deny", "data/+": "read",
+                                "cmd/#": "write", "open/#": "readwrite"})
+        assert rule.check("alice", "", "", "secret/x", False) is False
+        assert rule.check("alice", "", "", "data/a", False) is True
+        assert rule.check("alice", "", "", "data/a", True) is False
+        assert rule.check("alice", "", "", "cmd/go", True) is True
+        assert rule.check("alice", "", "", "open/x", True) is True
+        assert rule.check("alice", "", "", "other", False) is None
+        assert rule.check("bob", "", "", "secret/x", False) is None
+
+
+class TestLedgerHook:
+    def _ledger(self):
+        return Ledger(
+            auth=[AuthRule(username="admin", password="root", allow=True),
+                  AuthRule(username="banned", allow=False),
+                  AuthRule(remote="127.0.0.1*", allow=True)],
+            acl=[ACLRule(username="admin", filters={"#": "readwrite"}),
+                 ACLRule(filters={"$SYS/#": "read", "locked/#": "deny"})])
+
+    def test_authenticate_first_match_wins(self):
+        hook = LedgerHook(self._ledger())
+        assert hook.on_connect_authenticate(
+            FakeClient(remote="1.2.3.4:1"), FakePacket(b"admin", b"root"))
+        assert not hook.on_connect_authenticate(
+            FakeClient(remote="1.2.3.4:1"), FakePacket(b"banned", b""))
+        assert hook.on_connect_authenticate(
+            FakeClient(remote="127.0.0.1:99"), FakePacket(b"", b""))
+        assert not hook.on_connect_authenticate(
+            FakeClient(remote="8.8.8.8:1"), FakePacket(b"nobody", b""))
+
+    def test_acl_enforcement(self):
+        hook = LedgerHook(self._ledger())
+        admin = FakeClient(username=b"admin")
+        other = FakeClient(username=b"sensor")
+        assert hook.on_acl_check(admin, "locked/x", True)
+        assert not hook.on_acl_check(other, "locked/x", False)
+        assert hook.on_acl_check(other, "$SYS/health", False)
+        assert not hook.on_acl_check(other, "$SYS/health", True)
+        assert hook.on_acl_check(other, "free/topic", True)  # no rule = allow
+
+
+class TestLoading:
+    DATA = {"auth": [{"username": "a", "password": "p"}],
+            "acl": [{"username": "a", "filters": {"t/#": "readwrite"}}]}
+
+    def test_from_json_file(self, tmp_path):
+        import json
+        p = tmp_path / "rules.json"
+        p.write_text(json.dumps(self.DATA))
+        ledger = Ledger.from_file(str(p))
+        assert ledger.auth[0].username == "a"
+        assert ledger.acl[0].filters == {"t/#": "readwrite"}
+
+    def test_from_yaml_file(self, tmp_path):
+        p = tmp_path / "rules.yaml"
+        p.write_text("auth:\n- username: a\n  password: p\n"
+                     "acl:\n- username: a\n  filters:\n    t/#: readwrite\n")
+        ledger = Ledger.from_file(str(p))
+        assert ledger.auth[0].password == "p"
+        assert ledger.acl[0].check("a", "", "", "t/x", True) is True
+
+
+async def test_broker_enforces_ledger(tmp_path):
+    """End to end: bad credentials are refused at CONNECT; ACL-denied
+    subscriptions get reason 0x87 (not authorized)."""
+    import json
+
+    from maxmq_tpu.bootstrap import build_broker
+    from maxmq_tpu.mqtt_client import MQTTClient, MQTTError
+    from maxmq_tpu.utils.config import Config
+    from maxmq_tpu.utils.logger import Logger
+    import io
+
+    rules = {"auth": [{"username": "good", "password": "pw"}],
+             "acl": [{"filters": {"locked/#": "deny"}}]}
+    path = tmp_path / "rules.json"
+    path.write_text(json.dumps(rules))
+    conf = Config(mqtt_tcp_address="127.0.0.1:0", metrics_enabled=False,
+                  matcher="trie", mqtt_sys_topic_interval=0,
+                  auth_ledger=str(path))
+    broker = build_broker(conf, Logger(out=io.StringIO(), fmt="json"))
+    await broker.serve()
+    try:
+        port = broker.listeners.get("tcp")._server.sockets[0].getsockname()[1]
+        ok = MQTTClient(client_id="c-ok", version=5, username="good",
+                        password="pw")
+        await ok.connect("127.0.0.1", port)
+        assert ok.connack.reason_code == 0
+        granted = await ok.subscribe(("locked/x", 0), ("fine/x", 0))
+        assert granted == [0x87, 0]
+        await ok.disconnect()
+
+        bad = MQTTClient(client_id="c-bad", version=5, username="who",
+                         password="nope")
+        with pytest.raises((MQTTError, OSError, ConnectionError)):
+            await bad.connect("127.0.0.1", port)
+    finally:
+        await broker.close()
