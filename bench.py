@@ -1,13 +1,16 @@
 """Headline benchmark: batched wildcard topic-match throughput.
 
 Measures BASELINE.json config #3 — mixed `+`/`#` wildcard tree, 100K subs,
-deep hierarchies — on the dense leveled matcher (maxmq_tpu/matching/
-dense.py, the production TPU path replacing the reference's
-`TopicsIndex.Subscribers`, vendor/github.com/mochi-co/mqtt/v2/
-topics.go:484-518). Timed region = host tokenization + ONE pipelined
-device dispatch over all micro-batches + host fetch of the sparse match
-words; compile excluded; decode to client sets is per-delivery work
-outside the matcher.
+deep hierarchies — end to end through the signature matcher
+(maxmq_tpu/matching/sig.py, the production TPU path replacing the
+reference's `TopicsIndex.Subscribers`, vendor/github.com/mochi-co/mqtt/v2/
+topics.go:484-518). The timed region is the full production fan-out match:
+host tokenization, host->device upload, the device signature-compare
+program, device->host fetch of the fixed match slots, and the host-side
+exact-filter probe — pipelined over chunks so host prep, device compute
+and transfers overlap (double buffering). Decoding candidate rows to
+client sets is per-delivery work outside the matcher (same boundary as
+the reference's `Subscribers` return).
 
 `vs_baseline` is measured against the in-process Go trie rate implied by
 BASELINE.json's north star ("≥10M matches/sec ... ≥20x the in-process Go
@@ -15,7 +18,8 @@ trie" => Go trie ≈ 500K matches/sec; no Go toolchain in this image to
 measure it directly).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Env knobs: MAXMQ_BENCH_SUBS, MAXMQ_BENCH_BATCH, MAXMQ_BENCH_ITERS.
+Env knobs: MAXMQ_BENCH_SUBS, MAXMQ_BENCH_BATCH, MAXMQ_BENCH_ITERS,
+MAXMQ_BENCH_ENGINE (sig|dense), MAXMQ_BENCH_DEPTH (pipeline depth).
 """
 
 from __future__ import annotations
@@ -55,14 +59,42 @@ def build_corpus(n_subs: int, seed: int = 42):
     return filters, topics
 
 
+def run_sig(engine, batches, depth: int):
+    """Pipelined fixed-slot matching: keep ``depth`` chunks in flight so
+    batch i+1's host prep and upload overlap batch i's device work and
+    fetch. Returns (total matched candidate rows, overflow topics)."""
+    from collections import deque
+
+    matched = 0
+    overflow = 0
+    pending = deque()
+
+    def drain_one():
+        nonlocal matched, overflow
+        out = pending.popleft()
+        cnt, _rows, hostrows, _t = engine.match_fixed([], out=out)
+        ovf = cnt == 15
+        overflow += int(ovf.sum())
+        matched += int(cnt[~ovf].sum()) + sum(len(h) for h in hostrows)
+
+    for topics in batches:
+        pending.append(engine.dispatch_fixed(topics))
+        if len(pending) >= depth:
+            drain_one()
+    while pending:
+        drain_one()
+    return matched, overflow
+
+
 def main() -> None:
     n_subs = int(os.environ.get("MAXMQ_BENCH_SUBS", 100_000))
-    batch = int(os.environ.get("MAXMQ_BENCH_BATCH", 8192))
-    iters = int(os.environ.get("MAXMQ_BENCH_ITERS", 30))
+    batch = int(os.environ.get("MAXMQ_BENCH_BATCH", 65536))
+    iters = int(os.environ.get("MAXMQ_BENCH_ITERS", 8))
+    depth = int(os.environ.get("MAXMQ_BENCH_DEPTH", 2))
+    which = os.environ.get("MAXMQ_BENCH_ENGINE", "sig")
 
     import jax
 
-    from maxmq_tpu.matching.dense import DenseEngine
     from maxmq_tpu.matching.trie import TopicIndex
     from maxmq_tpu.protocol.packets import Subscription
 
@@ -71,20 +103,26 @@ def main() -> None:
     for i, filt in enumerate(filters):
         index.subscribe(f"cl-{i}", Subscription(filter=filt, qos=i % 3))
 
-    engine = DenseEngine(index, max_levels=10, auto_refresh=False)
-
     batches = [topic_gen(batch, seed2=100 + i) for i in range(iters)]
 
-    # warmup: trigger compile at the exact pipeline shape
-    _, _, overflow, _ = engine.match_raw_many(batches)
-    n_over = int(overflow.sum())
-    # timed region = host tokenization + ONE pipelined device dispatch
-    # (lax.scan over the stacked micro-batches) + host fetch of the sparse
-    # match words — the production fan-out path end to end.
-    t0 = time.perf_counter()
-    word_idx, word_val, overflow, _ = engine.match_raw_many(batches)
-    word_idx.sum()
-    dt = time.perf_counter() - t0
+    if which == "dense":
+        from maxmq_tpu.matching.dense import DenseEngine
+        engine = DenseEngine(index, max_levels=10, auto_refresh=False)
+        engine.match_raw_many(batches)          # warm compile
+        t0 = time.perf_counter()
+        word_idx, _, overflow, _ = engine.match_raw_many(batches)
+        word_idx.sum()
+        dt = time.perf_counter() - t0
+        detail = {"overflow": int(overflow.sum())}
+    else:
+        from maxmq_tpu.matching.sig import SigEngine
+        engine = SigEngine(index, auto_refresh=False)
+        run_sig(engine, batches[:1], depth)     # warm compile
+        t0 = time.perf_counter()
+        matched, n_over = run_sig(engine, batches, depth)
+        dt = time.perf_counter() - t0
+        detail = {"matched_rows": matched, "overflow_topics": n_over,
+                  "pipeline_depth": depth}
 
     rate = batch * iters / dt
     result = {
@@ -94,9 +132,10 @@ def main() -> None:
         "vs_baseline": round(rate / GO_TRIE_BASELINE, 3),
         "detail": {
             "subs": n_subs, "batch": batch, "iters": iters,
-            "overflow_fallbacks_warmup": n_over,
+            "engine": which,
             "backend": jax.default_backend(),
             "devices": len(jax.devices()),
+            **detail,
         },
     }
     print(json.dumps(result))

@@ -75,6 +75,10 @@ def build_matcher(conf: Config, broker: Broker):
         from .matching.dense import DenseEngine
         engine = DenseEngine(broker.topics,
                              max_levels=conf.matcher_max_levels)
+    elif conf.matcher == "sig":
+        from .matching.sig import SigEngine
+        engine = SigEngine(broker.topics,
+                           max_levels=conf.matcher_max_levels)
     else:
         raise ValueError(f"unknown matcher {conf.matcher!r}")
     from .matching.batcher import MicroBatcher

@@ -221,6 +221,13 @@ def pack_and_extract(matched, lengths, n_rows: int, max_words: int):
     words = (bits << jnp.arange(32, dtype=jnp.uint32)[None, None, :]).sum(
         axis=2, dtype=jnp.uint32)                    # [B, W32]
 
+    return extract_nonzero_words(words, lengths, max_words)
+
+
+def extract_nonzero_words(words, lengths, max_words: int):
+    """Sparse tail shared by every packed-word matcher (dense walk, Pallas
+    kernel, signature matcher): pick the ≤max_words nonzero uint32 words of
+    ``words [B, W]`` in ascending word order."""
     nz = words != 0
     n_nz = nz.sum(axis=1, dtype=jnp.int32)
     overflow = (lengths < 0) | (n_nz > max_words)
@@ -228,10 +235,16 @@ def pack_and_extract(matched, lengths, n_rows: int, max_words: int):
     # ascending word index; returns their original indices.
     key = jnp.where(nz, jnp.int32(1 << 30) - jnp.arange(
         words.shape[1], dtype=jnp.int32)[None, :], jnp.int32(-1))
-    topv, topi = jax.lax.top_k(key, max_words)
+    k = min(max_words, words.shape[1])
+    topv, topi = jax.lax.top_k(key, k)
     word_idx = jnp.where(topv > 0, topi, -1)
     word_val = jnp.take_along_axis(words, topi, axis=1)
     word_val = jnp.where(topv > 0, word_val, jnp.uint32(0))
+    if k < max_words:        # tiny tables: pad out to the fixed contract
+        pad = max_words - k
+        word_idx = jnp.pad(word_idx, ((0, 0), (0, pad)),
+                           constant_values=-1)
+        word_val = jnp.pad(word_val, ((0, 0), (0, pad)))
     return word_idx, word_val, overflow
 
 

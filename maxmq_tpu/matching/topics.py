@@ -68,6 +68,29 @@ def is_dollar(topic: str) -> bool:
     return topic.startswith("$")
 
 
+def filter_matches_topic(flevels, topic_levels, dollar: bool) -> bool:
+    """Exact CPU check: does a (non-`$share`) filter match a topic?
+
+    Mirrors the trie walk semantics (vendor/github.com/mochi-co/mqtt/v2/
+    topics.go:484-555): '+' matches exactly one level [MQTT-4.7.1-3], a
+    trailing '#' matches the parent and anything deeper [MQTT-4.7.1.2],
+    and top-level wildcards never match '$'-topics [MQTT-4.7.2-1]. Used by
+    the signature matcher to verify device candidates (hash collisions are
+    a perf event, never a correctness event)."""
+    if not flevels:
+        return False
+    if dollar and flevels[0] in ("+", "#"):
+        return False
+    for i, fl in enumerate(flevels):
+        if fl == "#":
+            return True
+        if i >= len(topic_levels):
+            return False
+        if fl != "+" and fl != topic_levels[i]:
+            return False
+    return len(topic_levels) == len(flevels)
+
+
 UNK = 0  # token id reserved for levels never seen in any filter
 
 

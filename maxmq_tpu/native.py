@@ -75,6 +75,15 @@ def _try_load():
             np.ctypeslib.ndpointer(np.int64),
             ctypes.c_int64, ctypes.POINTER(ctypes.c_int64)]
         lib.mq_scan_frames.restype = ctypes.c_int64
+        lib.mq_tokenize_sig.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            np.ctypeslib.ndpointer(np.uint32),
+            np.ctypeslib.ndpointer(np.uint32),
+            np.ctypeslib.ndpointer(np.uint8), ctypes.c_int64,
+            ctypes.c_void_p,
+            np.ctypeslib.ndpointer(np.int8),
+            np.ctypeslib.ndpointer(np.uint32)]
         _lib = lib
         return _lib
 
@@ -117,6 +126,43 @@ class NativeVocab:
         self._lib.mq_tokenize_joined(self._handle, buf, len(buf), n,
                                      max_levels, toks, lengths, dollar)
         return toks, lengths, dollar.astype(bool)
+
+
+class ExactSigTable:
+    """Host-exact coefficient tables marshalled once per compiled-table
+    snapshot for mq_tokenize_sig (depth -> per-position multipliers)."""
+
+    def __init__(self, host_exact: dict) -> None:
+        max_d = max(host_exact.keys(), default=0)
+        self.max_d = max_d
+        self.coef = np.zeros((max_d + 1, max(max_d, 1)), dtype=np.uint32)
+        self.dc = np.zeros(max_d + 1, dtype=np.uint32)
+        self.present = np.zeros(max_d + 1, dtype=np.uint8)
+        for d, g in host_exact.items():
+            spec = g.spec
+            for c, pos in zip(spec.coef, spec.kept):
+                self.coef[d, pos] = c
+            self.dc[d] = spec.depth_coef
+            self.present[d] = 1
+
+
+def tokenize_sig(vocab: "NativeVocab", topics: list[str], window: int,
+                 tok_dtype, exact: ExactSigTable):
+    """One-pass compact tokenizer + host-exact signature (C++). Returns
+    (toks [n, window] of tok_dtype, lens_enc int8[n], esig uint32[n]) per
+    maxmq_tpu/matching/sig.py:tokenize_compact's encoding contract."""
+    lib = vocab._lib
+    n = len(topics)
+    buf = "\x00".join(topics).encode("utf-8")
+    toks = np.empty((n, window), dtype=tok_dtype)
+    lens = np.empty(n, dtype=np.int8)
+    esig = np.empty(n, dtype=np.uint32)
+    mode = {np.uint8: 1, np.uint16: 2, np.int32: 4}[tok_dtype]
+    lib.mq_tokenize_sig(vocab._handle, buf, len(buf), n, window, mode,
+                        exact.coef, exact.dc, exact.present,
+                        exact.coef.shape[1] if exact.max_d else 0,
+                        toks.ctypes.data_as(ctypes.c_void_p), lens, esig)
+    return toks, lens, esig
 
 
 class MalformedFrame(ValueError):

@@ -61,7 +61,7 @@ class Config:
                                         # = allow-all
 
     # -- TPU matcher runtime (no reference equivalent: the north-star path) --
-    matcher: str = "dense"              # trie | nfa | dense
+    matcher: str = "sig"                # trie | nfa | dense | sig
     matcher_batch_window_us: int = 200
     matcher_max_batch: int = 256
     matcher_max_levels: int = 16

@@ -132,6 +132,84 @@ void mq_tokenize_joined(void* v, const char* buf, int64_t buf_len,
   }
 }
 
+// One-pass compact tokenizer for the signature matcher
+// (maxmq_tpu/matching/sig.py:tokenize_compact semantics, which MUST stay
+// identical — parity-tested from tests/test_native.py):
+//   * topics arrive NUL-joined as in mq_tokenize_joined;
+//   * toks_out: narrow window tokens [n, window] — uint8 (pad 255),
+//     uint16 (pad 65535) or int32 (pad -1) per tok_mode in {1, 2, 4};
+//   * lens_out: int8 — sign carries the '$'-flag, |value| = TRUE depth
+//     (up to 63; deeper encodes ±127 = overflow);
+//   * esig_out: uint32 — the host-exact-group signature
+//     sum(coef[depth][pos] * tok[pos]) + dc[depth] * depth for topics
+//     whose depth has a full-exact group (exact_present[depth]); 0
+//     otherwise (callers mask by depth, 0 is not a sentinel).
+// exact_coef is row-major [max_exact_d + 1, max_exact_d].
+void mq_tokenize_sig(void* v, const char* buf, int64_t buf_len,
+                     int64_t n_topics, int64_t window, int32_t tok_mode,
+                     const uint32_t* exact_coef, const uint32_t* exact_dc,
+                     const uint8_t* exact_present, int64_t max_exact_d,
+                     void* toks_out, int8_t* lens_out, uint32_t* esig_out) {
+  const auto& map = static_cast<Vocab*>(v)->map;
+  constexpr int64_t kDepthCap = 63;
+  uint8_t* t8 = static_cast<uint8_t*>(toks_out);
+  uint16_t* t16 = static_cast<uint16_t*>(toks_out);
+  int32_t* t32 = static_cast<int32_t*>(toks_out);
+  int64_t topic_start = 0;
+  int64_t i = 0;
+  int32_t level_toks[kDepthCap];
+  for (int64_t end = 0; end <= buf_len && i < n_topics; ++end) {
+    if (end != buf_len && buf[end] != '\0') continue;
+    const char* start = buf + topic_start;
+    const int64_t tlen = end - topic_start;
+    const bool dollar = tlen > 0 && start[0] == '$';
+
+    int64_t n_levels = 0;
+    int64_t level_start = 0;
+    bool overflow = false;
+    for (int64_t p = 0; p <= tlen; ++p) {
+      if (p == tlen || start[p] == '/') {
+        if (n_levels >= kDepthCap) {
+          overflow = true;
+          break;
+        }
+        auto it = map.find(std::string(start + level_start, p - level_start));
+        level_toks[n_levels++] = (it == map.end()) ? 0 : it->second;
+        level_start = p + 1;
+      }
+    }
+
+    const int8_t depth8 =
+        overflow ? int8_t{127} : static_cast<int8_t>(n_levels);
+    lens_out[i] = dollar ? static_cast<int8_t>(-depth8) : depth8;
+
+    for (int64_t j = 0; j < window; ++j) {
+      const bool real = !overflow && j < n_levels;
+      const int32_t tok = real ? level_toks[j] : -1;
+      switch (tok_mode) {
+        case 1: t8[i * window + j] = real ? static_cast<uint8_t>(tok) : 255;
+                break;
+        case 2: t16[i * window + j] =
+                    real ? static_cast<uint16_t>(tok) : 65535;
+                break;
+        default: t32[i * window + j] = tok;
+      }
+    }
+
+    uint32_t esig = 0;
+    if (!overflow && n_levels <= max_exact_d && exact_present[n_levels]) {
+      const uint32_t* coef = exact_coef + n_levels * max_exact_d;
+      for (int64_t p = 0; p < n_levels; ++p)
+        esig += coef[p] * static_cast<uint32_t>(level_toks[p]);
+      esig += exact_dc[n_levels] * static_cast<uint32_t>(n_levels);
+    }
+    esig_out[i] = esig;
+
+    topic_start = end + 1;
+    ++i;
+  }
+}
+
 // Scan `buf` (len bytes) for complete MQTT control-packet frames.
 // For each complete frame i < max_frames: starts[i] = offset of the fixed
 // header byte, totals[i] = total frame size (header + varint + body).

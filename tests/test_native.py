@@ -149,3 +149,39 @@ class TestFrameScanner:
                     native.scan_frames_py(data)
                 continue
             assert got == native.scan_frames_py(data)
+
+
+def test_tokenize_sig_parity_with_python():
+    """mq_tokenize_sig must produce exactly tokenize_compact's encoding and
+    the same host-exact hits as the numpy path."""
+    import numpy as np
+    import pytest
+
+    from maxmq_tpu import native
+    from maxmq_tpu.matching import TopicIndex
+    from maxmq_tpu.matching.sig import (compile_sig, host_exact_rows,
+                                        host_exact_rows_from_sig,
+                                        prepare_batch, tokenize_compact)
+    from maxmq_tpu.protocol import Subscription
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="a/b/c"))
+    idx.subscribe("c2", Subscription(filter="a/b"))
+    idx.subscribe("c3", Subscription(filter="x/+/z"))
+    idx.subscribe("c4", Subscription(filter="deep/#"))
+    tables = compile_sig(idx)
+    topics = ["a/b/c", "a/b", "x/q/z", "$SYS/x", "unknown/levels/here",
+              "a//b", "", "deep", "t/" + "/".join(["v"] * 80)]
+
+    toks_py, lens_py, toks32, lengths = tokenize_compact(tables, topics)
+    hr_py = host_exact_rows(tables, toks32, lengths)
+
+    toks_n, lens_n, hr_n = prepare_batch(tables, topics)
+    assert toks_n.dtype == toks_py.dtype
+    assert np.array_equal(toks_n, toks_py)
+    assert np.array_equal(lens_n, lens_py)
+    for a, b in zip(hr_n, hr_py):
+        assert np.array_equal(a, b)

@@ -1,0 +1,230 @@
+"""Golden parity for the signature matcher (grouped hash-equality, the
+transfer-optimal TPU path): all three device output forms (match words,
+compact row stream, fixed slots) must agree exactly with the CPU reference
+trie on the corpora the NFA/dense matchers are held to."""
+
+import random
+
+import numpy as np
+import pytest
+
+from maxmq_tpu.matching import TopicIndex
+from maxmq_tpu.matching.sig import SigEngine, compile_sig, tokenize_compact
+from maxmq_tpu.protocol import Subscription
+
+from test_nfa_parity import normalize, rand_corpus
+
+PATHS = ["word", "compact", "fixed"]
+
+
+def run_path(engine, path, topics):
+    if path == "word":
+        return engine.subscribers_batch(topics)
+    if path == "compact":
+        return engine.subscribers_compact_batch(topics)
+    return engine.subscribers_fixed_batch(topics)
+
+
+def check_parity(index, topics, paths=PATHS, **engine_kw):
+    engine = SigEngine(index, **engine_kw)
+    for path in paths:
+        got = run_path(engine, path, topics)
+        for topic, result in zip(topics, got):
+            want = index.subscribers(topic)
+            assert normalize(result) == normalize(want), (
+                f"[{path}] mismatch on topic {topic!r}")
+    return engine
+
+
+def test_exact_and_wildcard_basics():
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="a/b/c", qos=1))
+    idx.subscribe("c2", Subscription(filter="a/+/c", qos=2))
+    idx.subscribe("c3", Subscription(filter="a/#"))
+    idx.subscribe("c4", Subscription(filter="#"))
+    idx.subscribe("c5", Subscription(filter="+"))
+    check_parity(idx, ["a/b/c", "a/x/c", "a", "a/b", "x", "x/y",
+                       "a/b/c/d", "$SYS/x", "$SYS"])
+
+
+def test_hash_parent_and_dollar_rules():
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="sport/tennis/#"))
+    idx.subscribe("c2", Subscription(filter="$SYS/#"))
+    idx.subscribe("c3", Subscription(filter="$SYS/+/x"))
+    idx.subscribe("c4", Subscription(filter="+/tennis/+"))
+    check_parity(idx, ["sport/tennis", "sport/tennis/p1", "sport",
+                       "$SYS/broker/x", "$SYS/broker", "$SYS",
+                       "a/tennis/b"])
+
+
+def test_empty_levels_and_unknown_tokens():
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="/"))
+    idx.subscribe("c2", Subscription(filter="//"))
+    idx.subscribe("c3", Subscription(filter="+/"))
+    idx.subscribe("c4", Subscription(filter="a//b"))
+    check_parity(idx, ["/", "//", "a//b", "never-seen-token/x", "a/b",
+                       "never/", "/"])
+
+
+def test_shared_subscriptions_parity():
+    idx = TopicIndex()
+    idx.subscribe("w1", Subscription(filter="$share/g1/t/+"))
+    idx.subscribe("w2", Subscription(filter="$share/g1/t/+"))
+    idx.subscribe("w3", Subscription(filter="$share/g2/t/a"))
+    idx.subscribe("n1", Subscription(filter="t/a", qos=1))
+    check_parity(idx, ["t/a", "t/b", "t", "x"])
+
+
+def test_overlap_merge_semantics():
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="m/+", qos=0, identifier=3))
+    idx.subscribe("c1", Subscription(filter="m/x", qos=2, identifier=9))
+    idx.subscribe("c1", Subscription(filter="m/#", qos=1, identifier=4))
+    check_parity(idx, ["m/x", "m/y", "m"])
+
+
+def test_exact_rows_match_on_host():
+    # full-exact filters never occupy device table width
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="a/b/c"))
+    idx.subscribe("c2", Subscription(filter="a/b/d"))
+    idx.subscribe("c3", Subscription(filter="a/+/c"))
+    engine = check_parity(idx, ["a/b/c", "a/b/d", "a/b", "a/b/c/d"])
+    t = engine.tables
+    assert sum(len(g.rows) for g in t.host_exact.values()) == 2
+    # device rows: only the '+' filter (one group, one padded word)
+    assert int(t.group_words.sum()) == 1
+
+
+def test_too_deep_topic_falls_back():
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="a/#"))
+    deep = "a/" + "/".join(str(i) for i in range(80))
+    engine = check_parity(idx, [deep], max_levels=8)
+    assert engine.fallbacks > 0
+
+
+def test_mid_depth_filter_matches_via_compact_window():
+    # deeper than the word path's max_levels but within the compact
+    # DEPTH_CAP: the compact/fixed paths match it on device, the word
+    # path falls back (its tokenizer flags the topic as overflow)
+    idx = TopicIndex()
+    mid_filter = "/".join(str(i) for i in range(20))
+    idx.subscribe("c1", Subscription(filter=mid_filter))
+    idx.subscribe("c2", Subscription(filter="a/b"))
+    check_parity(idx, [mid_filter, "a/b"], max_levels=8)
+
+
+def test_deep_filter_only_matches_overflow_topics():
+    # beyond DEPTH_CAP (63 levels): compiled out of the device tables,
+    # matched purely by the CPU fallback that overflow topics already take
+    idx = TopicIndex()
+    deep_filter = "/".join(str(i) for i in range(70))
+    idx.subscribe("c1", Subscription(filter=deep_filter))
+    idx.subscribe("c2", Subscription(filter="a/b"))
+    engine = check_parity(idx, [deep_filter, "a/b"], max_levels=8)
+    assert engine.tables.deep_rows
+
+
+def test_fixed_slot_overflow_falls_back():
+    idx = TopicIndex()
+    for i in range(24):
+        idx.subscribe(f"c{i}", Subscription(filter=f"x/{i}/+"))
+        idx.subscribe(f"d{i}", Subscription(filter=f"+/{i}/y"))
+    engine = SigEngine(idx)
+    # topic matching >7 rows must still be exact via the CPU fallback
+    idx2 = TopicIndex()
+    for i in range(12):
+        idx2.subscribe(f"c{i}", Subscription(filter=f"x/+/s{i}/#"))
+        idx2.subscribe(f"e{i}", Subscription(filter="x/y/+/#"))
+    engine2 = SigEngine(idx2)
+    got = engine2.subscribers_fixed_batch(["x/y/s0/t"])[0]
+    want = idx2.subscribers("x/y/s0/t")
+    assert normalize(got) == normalize(want)
+
+
+def test_incremental_refresh():
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="a/b"))
+    engine = SigEngine(idx)
+    assert normalize(engine.subscribers("a/b"))[0].keys() == {"c1"}
+    idx.subscribe("c2", Subscription(filter="a/+"))
+    got = engine.subscribers("a/b")
+    assert sorted(got.subscriptions) == ["c1", "c2"]
+    idx.unsubscribe("c1", "a/b")
+    got = engine.subscribers("a/b")
+    assert sorted(got.subscriptions) == ["c2"]
+
+
+def test_empty_index():
+    idx = TopicIndex()
+    engine = SigEngine(idx)
+    assert len(engine.subscribers("a/b")) == 0
+    assert len(engine.subscribers_fixed_batch(["a/b"])[0]) == 0
+
+
+def test_tokenize_compact_encoding():
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="a/b/c"))
+    tables = compile_sig(idx)
+    toks, lens, toks32, lengths = tokenize_compact(
+        tables, ["a/b", "$SYS/x", "a/" + "/".join(["d"] * 80)])
+    assert toks.dtype == np.uint8
+    assert lens[0] == 2 and lens[1] == -2          # sign carries '$'
+    assert abs(int(lens[2])) == 127                # too deep -> overflow
+    assert lengths[0] == 2
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4])
+def test_randomized_parity(seed):
+    rng = random.Random(seed)
+    idx = TopicIndex()
+    filters, topics = rand_corpus(rng, n_filters=120, n_clients=30)
+    from maxmq_tpu.matching.topics import valid_filter
+    for i, f in enumerate(filters):
+        if not valid_filter(f):
+            continue
+        idx.subscribe(f"c{i % 30}",
+                      Subscription(filter=f, qos=rng.randint(0, 2),
+                                   identifier=rng.randint(0, 5)))
+    check_parity(idx, topics)
+
+
+def test_filter_matches_topic_rules():
+    from maxmq_tpu.matching.topics import filter_matches_topic as fm
+    assert fm(("a", "#"), ("a",), False)          # parent rule 4.7.1.2
+    assert fm(("a", "#"), ("a", "b", "c"), False)
+    assert not fm(("a", "#"), ("b",), False)
+    assert fm(("+",), ("x",), False)
+    assert not fm(("+",), ("x", "y"), False)
+    assert not fm(("#",), ("$SYS",), True)        # [MQTT-4.7.2-1]
+    assert not fm(("+", "x"), ("$SYS", "x"), True)
+    assert fm(("$SYS", "#"), ("$SYS", "x"), True)
+    assert fm(("a", "+", "c"), ("a", "", "c"), False)  # '+' matches empty
+
+
+def test_pathological_group_count_falls_back_to_trie(monkeypatch):
+    # corpora with more wildcard shapes than MAX_GROUPS must keep serving
+    # exactly via the CPU trie — never raise on the publish hot path
+    import maxmq_tpu.matching.sig as sigmod
+    monkeypatch.setattr(sigmod, "MAX_GROUPS", 2)
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="a/+/c"))
+    idx.subscribe("c2", Subscription(filter="+/b/c"))
+    idx.subscribe("c3", Subscription(filter="a/b/+/d"))
+    idx.subscribe("c4", Subscription(filter="x/#"))
+    engine = SigEngine(idx)
+    for path in PATHS:
+        got = run_path(engine, path, ["a/b/c", "x/y"])
+        assert normalize(got[0]) == normalize(idx.subscribers("a/b/c"))
+        assert normalize(got[1]) == normalize(idx.subscribers("x/y"))
+    with pytest.raises(RuntimeError):
+        engine.match_fixed(["a/b/c"])
+    # corpus shrinks below the limit -> device path resumes
+    idx.unsubscribe("c3", "a/b/+/d")
+    idx.unsubscribe("c4", "x/#")
+    monkeypatch.setattr(sigmod, "MAX_GROUPS", 4096)
+    engine.refresh()
+    assert engine._state[2] is not None

@@ -126,7 +126,7 @@ class TestConfig:
         assert conf.metrics_address == ":8888"
         assert conf.log_level == "info"
         assert conf.mqtt_max_qos == 2
-        assert conf.matcher == "dense"
+        assert conf.matcher == "sig"
 
     def test_toml_file(self, tmp_path):
         p = tmp_path / "maxmq.conf"
@@ -160,7 +160,7 @@ class TestConfig:
 
     def test_as_dict_round_trip(self):
         d = config_as_dict(Config())
-        assert d["matcher"] == "dense"
+        assert d["matcher"] == "sig"
         assert "mqtt_max_topic_alias" in d
 
 
