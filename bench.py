@@ -116,7 +116,14 @@ def main() -> None:
         detail = {"overflow": int(overflow.sum())}
     else:
         from maxmq_tpu.matching.sig import SigEngine
-        engine = SigEngine(index, auto_refresh=False)
+        # larger corpora match more rows/topic (more fixed slots) and the
+        # [batch, words] matrix bounds the single-chip batch size
+        kw = {}
+        if n_subs > 300_000:
+            kw = {"fixed_sel_blocks": 14, "fixed_max_rows": 14}
+            batch = min(batch, 32768)
+            batches = [b[:batch] for b in batches]
+        engine = SigEngine(index, auto_refresh=False, **kw)
         run_sig(engine, batches[:1], depth)     # warm compile
         t0 = time.perf_counter()
         matched, n_over = run_sig(engine, batches, depth)

@@ -62,11 +62,18 @@ def build_matcher(conf: Config, broker: Broker):
     if conf.matcher in ("", "trie"):
         return None
     if conf.matcher_mesh:
-        from .parallel.sharded import ShardedNFAEngine, make_mesh
+        from .parallel.sharded import (ShardedNFAEngine, ShardedSigEngine,
+                                       make_mesh)
         rows, _, cols = conf.matcher_mesh.partition("x")
         mesh = make_mesh(shape=(int(rows), int(cols or 1)))
-        engine = ShardedNFAEngine(broker.topics, mesh=mesh,
-                                  max_levels=conf.matcher_max_levels)
+        if conf.matcher == "nfa":
+            engine = ShardedNFAEngine(broker.topics, mesh=mesh,
+                                      max_levels=conf.matcher_max_levels)
+        else:
+            # the sharded sig engine derives its depth window from the
+            # corpus (DEPTH_CAP-bounded); matcher_max_levels is a
+            # word-path/nfa/dense knob
+            engine = ShardedSigEngine(broker.topics, mesh=mesh)
     elif conf.matcher == "nfa":
         from .matching.engine import NFAEngine
         engine = NFAEngine(broker.topics,

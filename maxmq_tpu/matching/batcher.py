@@ -57,7 +57,14 @@ class MicroBatcher:
         return self.engine.subscribers(topic)
 
     def subscribers_batch(self, topics: list[str]) -> "list[SubscriberSet]":
-        return self.engine.subscribers_batch(topics)
+        return self._batch_fn(topics)
+
+    @property
+    def _batch_fn(self):
+        """Prefer the engine's fixed-slot path (fewest bytes/kernels per
+        micro-batch) when it has one (SigEngine)."""
+        return getattr(self.engine, "subscribers_fixed_batch",
+                       self.engine.subscribers_batch)
 
     def refresh(self, force: bool = False):
         return self.engine.refresh(force=force)
@@ -125,7 +132,7 @@ class MicroBatcher:
             try:
                 # worker thread: overlap device time with the event loop
                 results = await loop.run_in_executor(
-                    None, self.engine.subscribers_batch, topics)
+                    None, self._batch_fn, topics)
             except Exception as exc:  # engine failure → fail the callers
                 for _, fut in batch:
                     if not fut.done():

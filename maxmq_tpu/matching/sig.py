@@ -285,16 +285,25 @@ def compile_sig_subscriptions(subs, version: int = 0,
     return tables
 
 
+def exact_sigs(host_exact: dict, toks32: np.ndarray,
+               lengths: np.ndarray) -> np.ndarray:
+    """uint32[B] exact-group signature per topic (0 where the topic's
+    depth has no full-exact group — callers mask by depth, not by 0).
+    The numpy twin of the C++ tokenizer's esig output."""
+    sigs = np.zeros(len(lengths), dtype=np.uint32)
+    for d, g in (host_exact or {}).items():
+        sel = np.nonzero(lengths == d)[0]
+        if sel.size:
+            sigs[sel] = g.spec.signature(toks32[sel])
+    return sigs
+
+
 def host_exact_rows(tables: SigTables, toks32: np.ndarray,
                     lengths: np.ndarray) -> list[np.ndarray]:
     """Vectorized host half of the match: for each topic, the candidate
     rows among full-exact filters (one searchsorted per exact-depth group;
     collisions verified in decode like every other candidate)."""
-    sigs = np.zeros(len(lengths), dtype=np.uint32)
-    for d, g in (tables.host_exact or {}).items():
-        sel = np.nonzero(lengths == d)[0]
-        if sel.size:
-            sigs[sel] = g.spec.signature(toks32[sel])
+    sigs = exact_sigs(tables.host_exact, toks32, lengths)
     return host_exact_rows_from_sig(tables, sigs, lengths)
 
 
@@ -482,32 +491,12 @@ def _popc32(v):
             * jnp.uint32(0x01010101)) >> 24
 
 
-def sig_match_fixed_body(consts, planes, toks8, lens_enc,
-                         sel_blocks: int, max_rows: int):
-    """Fixed-slot match: the fewest-bytes, fewest-kernels device program.
-
-    Where sig_match_compact_body builds a variable-length stream (top_k +
-    global sort — the expensive XLA ops), this returns AT MOST ``max_rows``
-    row ids per topic in fixed slots, packed with the candidate count into
-    ONE uint32[B, 1 + ceil(max_rows/2)] output when rows fit uint16
-    (n_rows <= 65536), else int32[B, 1 + max_rows]. One device buffer each
-    way; topics with more candidates flag overflow (count 0xF) and fall
-    back to the CPU trie — sized so that's a percent-level event.
-
-    Pipeline (2 full passes over the [B, W] word matrix, everything else
-    is narrow):
-      words -> nonzero-summary bitmap [B, W/32] -> top_k of ``sel_blocks``
-      summary blocks -> gather their 32-word slices -> ``max_rows``
-      min-extract+clear iterations at bit level -> packed slots.
+def fixed_slots_from_words(words, too_deep, sel_blocks: int, max_rows: int,
+                           fmt16: bool):
+    """Shared tail of the fixed-slot matchers (single-device and sharded):
+    [B, W] match words -> packed fixed output (see sig_match_fixed_body).
     """
-    batch = toks8.shape[0]
-    dollar = lens_enc < 0
-    lengths = jnp.abs(lens_enc.astype(jnp.int32))
-    too_deep = lengths >= 127
-    toks = toks8.astype(jnp.int32)
-
-    sig_adj = adjusted_signatures(consts, toks, lengths, dollar)
-    words = match_words(consts, planes, sig_adj)         # [B, W]
+    batch = words.shape[0]
     n_words = words.shape[1]
     ws = (n_words + 31) // 32
     pad = ws * 32 - n_words
@@ -547,13 +536,13 @@ def sig_match_fixed_body(consts, planes, toks8, lens_enc,
     for _ in range(max_rows):
         enc = jnp.where(g != 0, (wordidx << 5) | _ctz32(g), inf)
         m = enc.min(axis=1)                              # [B]
-        rows.append(jnp.where(m == inf, jnp.uint32(0xFFFF_FFFF), m))
+        rows.append(m)
         hit = enc == m[:, None]
         g = jnp.where(hit, g & (g - jnp.uint32(1)), g)   # clear lowest bit
 
     cnt = jnp.where(overflow, jnp.uint32(0xF),
                     jnp.minimum(counts, max_rows).astype(jnp.uint32))
-    if n_words * 32 <= 65536:
+    if fmt16:
         # pack: word0 = count<<28 | row0; then rows 2-at-a-time per word
         row16 = [jnp.where(r == inf, jnp.uint32(0xFFFF), r & 0xFFFF)
                  for r in rows]
@@ -564,6 +553,54 @@ def sig_match_fixed_body(consts, planes, toks8, lens_enc,
         return jnp.stack(out, axis=1)                    # uint32[B, 1+k/2]
     return jnp.concatenate(
         [cnt[:, None]] + [r[:, None] for r in rows], axis=1)
+
+
+def sig_match_words_gather(consts, planes, grp_of_word, toks, lengths,
+                           dollar):
+    """[B, W] match words with a gather-based group expansion.
+
+    The concat-of-broadcasts in match_words needs compile-time-static group
+    word counts — impossible under shard_map, where ONE program serves
+    every shard's tables. Here the word -> group map is a device array
+    (``grp_of_word`` int32[W]) and the expansion is a small gather from
+    [B, G]. Single-device engines keep the static concat (faster);
+    the sharded engine uses this form."""
+    sig_adj = adjusted_signatures(consts, toks, lengths, dollar)
+    sig_exp = jnp.take(sig_adj, grp_of_word, axis=1)     # [B, W]
+    acc = jnp.zeros_like(sig_exp)
+    for j in range(32):
+        acc = acc | ((sig_exp == planes[j][None, :]).astype(jnp.uint32)
+                     << jnp.uint32(j))
+    return acc
+
+
+def sig_match_fixed_body(consts, planes, toks8, lens_enc,
+                         sel_blocks: int, max_rows: int):
+    """Fixed-slot match: the fewest-bytes, fewest-kernels device program.
+
+    Where sig_match_compact_body builds a variable-length stream (top_k +
+    global sort — the expensive XLA ops), this returns AT MOST ``max_rows``
+    row ids per topic in fixed slots, packed with the candidate count into
+    ONE uint32[B, 1 + ceil(max_rows/2)] output when rows fit uint16
+    (n_rows <= 65536), else int32[B, 1 + max_rows]. One device buffer each
+    way; topics with more candidates flag overflow (count 0xF) and fall
+    back to the CPU trie — sized so that's a percent-level event.
+
+    Pipeline (2 full passes over the [B, W] word matrix, everything else
+    is narrow):
+      words -> nonzero-summary bitmap [B, W/32] -> top_k of ``sel_blocks``
+      summary blocks -> gather their 32-word slices -> ``max_rows``
+      min-extract+clear iterations at bit level -> packed slots.
+    """
+    dollar = lens_enc < 0
+    lengths = jnp.abs(lens_enc.astype(jnp.int32))
+    too_deep = lengths >= 127
+    toks = toks8.astype(jnp.int32)
+
+    sig_adj = adjusted_signatures(consts, toks, lengths, dollar)
+    words = match_words(consts, planes, sig_adj)         # [B, W]
+    return fixed_slots_from_words(words, too_deep, sel_blocks, max_rows,
+                                  fmt16=words.shape[1] * 32 <= 65536)
 
 
 def _compact_dtype(tables):
@@ -595,11 +632,20 @@ def tokenize_compact(tables, topics: list[str], window: int | None = None):
     return toks, lens_enc, toks32, lengths
 
 
-def prepare_batch(tables, topics: list[str]):
-    """Full host half for the compact/fixed paths: (toks, lens_enc,
-    hostrows). One C++ pass (tokens + exact signatures) when the native
-    runtime is built; the numpy/python fallback otherwise."""
-    window = max(tables.max_depth, 1)
+def prepare_batch_sig(tables, topics: list[str], window: int | None = None,
+                      host_exact: dict | None = None):
+    """Host half of the compact/fixed paths, signature form: (toks,
+    lens_enc, esig, lengths). One C++ pass (tokens + exact-group
+    signatures) when the native runtime is built; numpy otherwise.
+
+    ``window``/``host_exact`` override the tables' own (the sharded engine
+    passes the mesh-wide maxima/union — exact-group coefficients are
+    deterministic functions of the group shape, so one signature per depth
+    serves every shard)."""
+    if window is None:
+        window = max(tables.max_depth, 1)
+    if host_exact is None:
+        host_exact = tables.host_exact or {}
     ns = tables.__dict__.get("_native_sig", False)
     if ns is False:
         ns = None
@@ -612,19 +658,27 @@ def prepare_batch(tables, topics: list[str]):
                 nv = tables.__dict__.get("_native_vocab") or \
                     NativeVocab(tables.vocab)
                 tables.__dict__.setdefault("_native_vocab", nv)
-                ns = (nv, ExactSigTable(tables.host_exact or {}))
+                ns = (nv, ExactSigTable(host_exact))
         except Exception:
             ns = None
         tables.__dict__["_native_sig"] = ns
     if ns is None:
         toks, lens_enc, toks32, lengths = tokenize_compact(tables, topics,
                                                            window)
-        return toks, lens_enc, host_exact_rows(tables, toks32, lengths)
+        return toks, lens_enc, exact_sigs(host_exact, toks32, lengths), \
+            lengths
     from ..native import tokenize_sig
     dtype, _pad = _compact_dtype(tables)
     toks, lens_enc, esig = tokenize_sig(ns[0], topics, window, dtype, ns[1])
     lengths = np.abs(lens_enc.astype(np.int32))
     lengths[lengths >= 127] = -1
+    return toks, lens_enc, esig, lengths
+
+
+def prepare_batch(tables, topics: list[str]):
+    """Full host half for the compact/fixed paths: (toks, lens_enc,
+    hostrows)."""
+    toks, lens_enc, esig, lengths = prepare_batch_sig(tables, topics)
     return toks, lens_enc, host_exact_rows_from_sig(tables, esig, lengths)
 
 
@@ -640,7 +694,9 @@ class SigEngine:
                  max_words: int = 32, device=None,
                  auto_refresh: bool = True,
                  compact_word_slots: int = 8, compact_max_rows: int = 16,
-                 compact_cap_per_topic: int = 3) -> None:
+                 compact_cap_per_topic: int = 3,
+                 fixed_sel_blocks: int = 8,
+                 fixed_max_rows: int = 7) -> None:
         self.index = index
         self.max_levels = max_levels
         self.max_words = max_words
@@ -653,11 +709,16 @@ class SigEngine:
         self.compact_word_slots = compact_word_slots
         self.compact_max_rows = compact_max_rows
         self.compact_cap_per_topic = compact_cap_per_topic
-        # fixed-slot path shape knobs (see sig_match_fixed_body): 8 blocks
-        # / 7 rows put overflow->CPU-trie fallback at the ~1% level for
-        # IoT-shaped corpora while keeping the output at 16B/topic
-        self.fixed_sel_blocks = 8
-        self.fixed_max_rows = 7
+        # fixed-slot path shape knobs (see sig_match_fixed_body): the
+        # defaults (8 blocks / 7 rows) put overflow->CPU-trie fallback at
+        # the ~1% level for 100K-sub IoT corpora at 16B/topic; larger
+        # corpora match more rows per topic and want larger max_rows
+        # (<= 14 to keep the 4-bit count packing)
+        if not 1 <= fixed_max_rows <= 14:
+            # the 4-bit count packing reserves 0xF for overflow
+            raise ValueError("fixed_max_rows must be in [1, 14]")
+        self.fixed_sel_blocks = fixed_sel_blocks
+        self.fixed_max_rows = fixed_max_rows
         self._state = None
         self._refresh_lock = threading.Lock()
         self.fallbacks = 0

@@ -86,6 +86,201 @@ def _sharded_match(tables_dev, toks, lengths, dollar, *, width, table_mask,
     return rows[None], overflow[None]   # re-add the 'subs' axis
 
 
+def compile_sig_shards(subs, n_shards: int, version: int):
+    """Partition subscriptions round-robin and compile one signature table
+    per shard with a shared token-intern pool (uniform token ids across the
+    mesh, so topics are tokenized once and replicated over 'subs')."""
+    from ..matching.sig import compile_sig_subscriptions
+
+    vocab: dict[str, int] = {}
+    return [compile_sig_subscriptions(subs[i::n_shards], version,
+                                      vocab=vocab)
+            for i in range(n_shards)]
+
+
+def _sharded_sig_match(tables_dev, toks, lens_enc, *, sel_blocks, max_rows):
+    """Runs INSIDE shard_map: this device's signature-table shard (leading
+    axis of length 1, squeezed) over the local batch slice."""
+    from ..matching.sig import (adjusted_signatures, fixed_slots_from_words,
+                                sig_match_words_gather)
+
+    topo_coef, depth_coef, min_depth, is_hash, wild_first, planes, grp = (
+        t[0] for t in tables_dev)
+    consts = {"topo_coef": topo_coef, "depth_coef": depth_coef,
+              "min_depth": min_depth, "is_hash": is_hash,
+              "wild_first": wild_first}
+    dollar = lens_enc < 0
+    lengths = jnp.abs(lens_enc.astype(jnp.int32))
+    too_deep = lengths >= 127
+    words = sig_match_words_gather(consts, planes, grp,
+                                   toks.astype(jnp.int32), lengths, dollar)
+    out = fixed_slots_from_words(words, too_deep, sel_blocks, max_rows,
+                                 fmt16=False)
+    return out[None]                      # re-add the 'subs' axis
+
+
+class ShardedSigEngine:
+    """Signature matcher sharded over a ('data', 'subs') mesh — cluster
+    mode of the production `sig` path.
+
+    Subscriptions partition round-robin over 'subs': each device holds one
+    shard's group constants + row-signature planes and matches the full
+    topic batch slice against them; per-shard fixed match slots come back
+    over the ICI and the host unions shard-local decodes (the reference's
+    Route-Table-lookup-plus-forward collapsed into one sharded compare +
+    gather, docs/system-design.md:201-231).
+    """
+
+    def __init__(self, index: TopicIndex, mesh: Mesh | None = None,
+                 sel_blocks: int = 8, max_rows: int = 7) -> None:
+        if not 1 <= max_rows <= 14:
+            # the 4-bit count packing reserves 0xF for overflow
+            raise ValueError("max_rows must be in [1, 14]")
+        self.index = index
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.sel_blocks = sel_blocks
+        self.max_rows = max_rows
+        self.dp = self.mesh.shape["data"]
+        self.sp = self.mesh.shape["subs"]
+        self._state = None
+        self._refresh_lock = threading.Lock()
+        self.matches = 0
+        self.fallbacks = 0
+        self.refresh(force=True)
+
+    # ------------------------------------------------------------------
+
+    def refresh(self, force: bool = False) -> bool:
+        """Re-partition + recompile + re-shard if the index changed."""
+        with self._refresh_lock:
+            state = self._state
+            if (not force and state is not None
+                    and state[0] == self.index.version):
+                return False
+            version = self.index.version
+            shards = compile_sig_shards(self.index.all_subscriptions(),
+                                        self.sp, version)
+            from ..matching.sig import MAX_GROUPS
+            if any(len(t.groups) > MAX_GROUPS for t in shards):
+                # pathological corpus: serve exactly via the CPU trie
+                # (same discipline as SigEngine.refresh)
+                self._state = (version, shards, None, None, 0, {})
+                return True
+
+            # pad per-shard tables to common shapes and stack on 'subs'
+            g_max = max(max(len(t.groups), 1) for t in shards)
+            d_max = max(max(t.max_depth, 1) for t in shards)
+            w_max = max(max(int(t.group_words.sum()), 1) for t in shards)
+
+            topo = np.zeros((self.sp, g_max, d_max), dtype=np.uint32)
+            dc = np.zeros((self.sp, g_max), dtype=np.uint32)
+            mind = np.zeros((self.sp, g_max), dtype=np.int32)
+            ish = np.zeros((self.sp, g_max), dtype=bool)
+            wild = np.zeros((self.sp, g_max), dtype=bool)
+            planes = np.full((self.sp, 32, w_max), 0xFFFFFFFF,
+                             dtype=np.uint32)
+            grp = np.zeros((self.sp, w_max), dtype=np.int32)
+            for s, t in enumerate(shards):
+                g = len(t.groups)
+                if g:
+                    topo[s, :g, :t.topo_coef.shape[1]] = t.topo_coef
+                    dc[s, :g] = t.depth_coef
+                    mind[s, :g] = t.min_depth
+                    ish[s, :g] = t.is_hash
+                    wild[s, :g] = t.wild_first
+                w = int(t.group_words.sum())
+                if w:
+                    planes[s, :, :w] = t.row_sig.reshape(w, 32).T
+                    grp[s, :w] = np.repeat(
+                        np.arange(g, dtype=np.int32), t.group_words)
+
+            mesh = self.mesh
+            by_shard = NamedSharding(mesh, P("subs"))
+            dev = tuple(jax.device_put(a, by_shard)
+                        for a in (topo, dc, mind, ish, wild, planes, grp))
+
+            fn = jax.jit(jax.shard_map(
+                partial(_sharded_sig_match, sel_blocks=self.sel_blocks,
+                        max_rows=self.max_rows),
+                mesh=mesh,
+                in_specs=(tuple(P("subs") for _ in range(7)),
+                          P("data"), P("data")),
+                out_specs=P("subs", "data", None),
+            ))
+            # exact-group coefficients are deterministic by shape, so the
+            # union over shards gives ONE esig per topic valid everywhere
+            union_exact = {}
+            for t in shards:
+                union_exact.update(t.host_exact or {})
+            self._state = (version, shards, dev, fn, d_max, union_exact)
+            return True
+
+    # ------------------------------------------------------------------
+
+    def match_raw(self, topics: list[str]):
+        """Sharded device match. Returns (out uint32[sp, B, 1+max_rows],
+        hostrows list[sp][B], shards), batch-trimmed."""
+        from ..matching.sig import (host_exact_rows_from_sig,
+                                    prepare_batch_sig)
+
+        self.refresh()
+        _version, shards, dev, fn, d_max, union_exact = self._state
+        if fn is None:
+            raise RuntimeError(
+                "device matching disabled for this corpus (> MAX_GROUPS "
+                "wildcard shapes in a shard); use subscribers_*, which "
+                "fall back to the CPU trie")
+        batch = len(topics)
+        padded = -(-batch // self.dp) * self.dp
+        padded_topics = topics + ["\x01pad"] * (padded - batch)
+        # shared intern pool => identical tokens for every shard; one host
+        # tokenize pass serves every shard's exact probe
+        toks, lens_enc, esig, lengths = prepare_batch_sig(
+            shards[0], padded_topics, window=max(d_max, 1),
+            host_exact=union_exact)
+        out = fn(dev, jnp.asarray(toks), jnp.asarray(lens_enc))
+        hostrows = [host_exact_rows_from_sig(t, esig, lengths)
+                    for t in shards]
+        return np.asarray(out)[:, :batch], \
+            [h[:batch] for h in hostrows], shards
+
+    def subscribers_batch(self, topics: list[str]) -> list[SubscriberSet]:
+        from ..matching.sig import SigEngine
+
+        self.refresh()
+        if self._state[3] is None:      # pathological corpus: CPU trie
+            self.matches += len(topics)
+            self.fallbacks += len(topics)
+            return [self.index.subscribers(t) for t in topics]
+        out, hostrows, shards = self.match_raw(topics)
+        results = []
+        for i, topic in enumerate(topics):
+            self.matches += 1
+            cnt = out[:, i, 0]
+            if (cnt == 0xF).any():
+                self.fallbacks += 1
+                results.append(self.index.subscribers(topic))
+                continue
+            result = SubscriberSet()
+            for s, tables in enumerate(shards):
+                SigEngine.decode_rows(topic, out[s, i, 1:1 + int(cnt[s])],
+                                      tables, into=result)
+                SigEngine.decode_rows(topic, hostrows[s][i], tables,
+                                      into=result)
+            results.append(result)
+        return results
+
+    def subscribers(self, topic: str) -> SubscriberSet:
+        return self.subscribers_batch([topic])[0]
+
+    async def subscribers_async(self, topic: str) -> SubscriberSet:
+        """Event-loop-friendly match (worker thread, like NFAEngine's)."""
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, self.subscribers, topic)
+
+
 class ShardedNFAEngine:
     """NFA matcher sharded over a ('data', 'subs') mesh.
 

@@ -91,3 +91,42 @@ def test_graft_entry_multichip():
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)
+
+
+# ----------------------------------------------------- sharded sig engine
+
+from maxmq_tpu.parallel.sharded import ShardedSigEngine
+
+
+@pytest.mark.parametrize("shape", [(1, 8), (2, 4), (4, 2)])
+def test_sharded_sig_parity_vs_trie(shape):
+    filters, topics = random_corpus(300, 64, seed=shape[0] * 17 + shape[1])
+    index = build_index(filters)
+    mesh = make_mesh(shape=shape)
+    engine = ShardedSigEngine(index, mesh=mesh)
+    got = engine.subscribers_batch(topics)
+    for topic, g in zip(topics, got):
+        assert_same(g, index.subscribers(topic), topic)
+
+
+def test_sharded_sig_refresh_and_fallback():
+    filters, topics = random_corpus(100, 16, seed=9)
+    index = build_index(filters)
+    engine = ShardedSigEngine(index, mesh=make_mesh(shape=(2, 4)))
+    index.subscribe("late", Subscription(filter="alpha/#", qos=1))
+    got = engine.subscribers("alpha/beta")
+    assert "late" in got.subscriptions
+    # deep topic -> CPU fallback, still exact
+    deep = "/".join(["alpha"] * 80)
+    index.subscribe("deepc", Subscription(filter="/".join(["alpha"] * 80)))
+    got = engine.subscribers(deep)
+    assert_same(got, index.subscribers(deep), deep)
+
+
+def test_sharded_sig_uneven_and_empty_shards():
+    # fewer filters than shards: some shards compile empty
+    index = build_index(["alpha/beta", "alpha/+", "gamma/#"])
+    engine = ShardedSigEngine(index, mesh=make_mesh(shape=(1, 8)))
+    for topic in ["alpha/beta", "gamma/x/y", "delta", "alpha"]:
+        assert_same(engine.subscribers(topic), index.subscribers(topic),
+                    topic)
