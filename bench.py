@@ -88,8 +88,11 @@ def run_sig(engine, batches, depth: int):
 
 def main() -> None:
     n_subs = int(os.environ.get("MAXMQ_BENCH_SUBS", 100_000))
-    batch = int(os.environ.get("MAXMQ_BENCH_BATCH", 65536))
-    iters = int(os.environ.get("MAXMQ_BENCH_ITERS", 8))
+    # per-dispatch fixed costs on the host<->device link are large, so the
+    # steady-state rate needs big chunks (the [batch, words] matrix still
+    # fits HBM with room at 100K subs)
+    batch = int(os.environ.get("MAXMQ_BENCH_BATCH", 524288))
+    iters = int(os.environ.get("MAXMQ_BENCH_ITERS", 3))
     depth = int(os.environ.get("MAXMQ_BENCH_DEPTH", 2))
     which = os.environ.get("MAXMQ_BENCH_ENGINE", "sig")
 

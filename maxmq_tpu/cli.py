@@ -54,7 +54,15 @@ def cmd_start(args: argparse.Namespace) -> int:
         logger.with_prefix("bootstrap").fatal("server failed",
                                               error=str(exc))
         return 1
-    return 0
+    # Graceful cleanup is done (broker/metrics stopped, profiles written).
+    # Skip interpreter finalization: an accelerator-runtime thread caught
+    # mid-compile by teardown aborts the process from C++ ("exception not
+    # rethrown"); a server binary has nothing left to finalize anyway.
+    # Library callers use run_server directly and are unaffected.
+    sys.stdout.flush()
+    sys.stderr.flush()
+    import os
+    os._exit(0)
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -110,6 +110,11 @@ class MicroBatcher:
             if not fut.done():
                 fut.cancel()
         self._pending.clear()
+        # wait out any in-flight background table recompile: tearing the
+        # process down mid-compile aborts inside the runtime library
+        close_fn = getattr(self.engine, "close", None)
+        if close_fn is not None:
+            await asyncio.get_running_loop().run_in_executor(None, close_fn)
 
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()

@@ -87,7 +87,8 @@ def compile_dense(index, version: int | None = None,
                   vocab: dict[str, int] | None = None) -> DenseTables:
     """Compile a TopicIndex (or anything with ``all_subscriptions()``)."""
     if version is None:
-        version = getattr(index, "version", 0)
+        from .trie import subs_version
+        version = subs_version(index)
     return compile_dense_subscriptions(index.all_subscriptions(), version,
                                        vocab=vocab)
 
@@ -287,8 +288,9 @@ class DenseEngine:
         self._state once, and refresh replaces it in one assignment."""
         with self._refresh_lock:
             state = self._state
+            from .trie import subs_version
             if (not force and state is not None
-                    and state[0].version == self.index.version):
+                    and state[0].version == subs_version(self.index)):
                 return False
             tables = compile_dense(self.index)
             if self.use_pallas:

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from .nfa import MAX_PROBES, NFATables, compile_trie, hash32
-from .trie import SubscriberSet, TopicIndex
+from .trie import SubscriberSet, TopicIndex, subs_version
 
 _I32_MAX = np.int32(np.iinfo(np.int32).max)
 
@@ -168,7 +168,7 @@ class NFAEngine:
     def refresh(self, force: bool = False) -> bool:
         """Recompile + upload if the index changed. Cheap no-op otherwise."""
         if (not force and self._tables is not None
-                and self._tables.version == self.index.version):
+                and self._tables.version == subs_version(self.index)):
             return False
         tables = compile_trie(self.index)
         arrays = (tables.hash_node, tables.hash_tok, tables.hash_val,

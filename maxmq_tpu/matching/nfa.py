@@ -159,7 +159,8 @@ def compile_trie(index, version: int | None = None) -> NFATables:
     # then stamps the tables older than the index, forcing one extra (safe)
     # recompile rather than silently freezing stale tables.
     if version is None:
-        version = getattr(index, "version", 0)
+        from .trie import subs_version
+        version = subs_version(index)
     return compile_subscriptions(index.all_subscriptions(), version)
 
 

@@ -137,7 +137,8 @@ def compile_sig(index, version: int | None = None,
                 vocab: dict[str, int] | None = None,
                 max_levels: int = 16) -> SigTables:
     if version is None:
-        version = getattr(index, "version", 0)
+        from .trie import subs_version
+        version = subs_version(index)
     return compile_sig_subscriptions(index.all_subscriptions(), version,
                                      vocab=vocab, max_levels=max_levels)
 
@@ -682,7 +683,113 @@ def prepare_batch(tables, topics: list[str]):
     return toks, lens_enc, host_exact_rows_from_sig(tables, esig, lengths)
 
 
-class SigEngine:
+class Overlay:
+    """Host-side view of subscription mutations newer than the compiled
+    tables, replayed from the TopicIndex journal.
+
+    Matching never waits on a table recompile: adds live in a small delta
+    TopicIndex (matched per topic with the CPU trie and unioned in),
+    removes/replaces live in a (client_id, filter) set consulted during
+    decode. A recompile runs in the background; once it swaps in, the
+    overlay for the old tables is dropped."""
+
+    def __init__(self, base_version: int) -> None:
+        self.version = base_version     # last applied sub_version
+        self.delta = TopicIndex()
+        self.removed: set[tuple[str, str]] = set()
+
+    def apply(self, entries) -> None:
+        for ver, op, client_id, filt, sub, _group, _path in entries:
+            if ver <= self.version:
+                continue
+            self.version = ver
+            # '+' doubles as replace: the stale tables may hold an older
+            # subscription (different QoS/options) for the same pair
+            self.removed.add((client_id, filt))
+            if op == "+":
+                self.delta.subscribe(client_id, sub)
+            else:
+                self.delta.unsubscribe(client_id, filt)
+
+    @property
+    def empty(self) -> bool:
+        return not self.removed
+
+
+class OverlayedEngine:
+    """Staleness machinery shared by SigEngine and ShardedSigEngine:
+    background recompile + journal overlay. Subclasses provide
+    ``index``, ``refresh()`` and a ``_refresh_lock``."""
+
+    def _init_overlay(self) -> None:
+        self._overlay: Overlay | None = None
+        self._overlay_lock = threading.Lock()
+        self._bg_thread: threading.Thread | None = None
+        self.bg_refresh_errors = 0
+
+    def refresh_soon(self) -> None:
+        """Kick a background recompile if the tables are stale and none is
+        already running. Never blocks the caller."""
+        if not self._stale():
+            return
+        with self._overlay_lock:
+            if self._bg_thread is not None and self._bg_thread.is_alive():
+                return
+            t = threading.Thread(target=self._bg_refresh, daemon=True,
+                                 name="sig-refresh")
+            self._bg_thread = t
+            t.start()
+
+    def _stale(self) -> bool:
+        state = self._state
+        return state is None or self._state_version(state) != \
+            self.index.sub_version
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Wait for an in-flight background recompile. Killing the
+        interpreter while a compile runs inside the runtime library
+        aborts the process; joining here keeps shutdown clean."""
+        t = self._bg_thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+
+    def _bg_refresh(self) -> None:
+        try:
+            self.refresh()
+        except Exception:
+            self.bg_refresh_errors += 1
+        finally:
+            with self._overlay_lock:
+                ov = self._overlay
+                if ov is not None and ov.version <= self._state_version(
+                        self._state):
+                    self._overlay = None
+
+    def overlay_for(self, tables_version: int):
+        """The overlay bringing ``tables_version`` up to the live index,
+        or None when up to date, or the string "resync" when the journal
+        no longer reaches back (serve the batch via the CPU trie)."""
+        if self.index.sub_version == tables_version:
+            return None
+        if getattr(self, "auto_refresh", True):
+            self.refresh_soon()
+        with self._overlay_lock:
+            ov = self._overlay
+            if ov is None or ov.version < tables_version:
+                ov = Overlay(tables_version)
+            entries = self.index.journal_since(ov.version)
+            if entries is None:
+                return "resync"
+            ov.apply(entries)
+            self._overlay = ov
+            return None if ov.empty else ov
+
+    @staticmethod
+    def _state_version(state) -> int:
+        raise NotImplementedError
+
+
+class SigEngine(OverlayedEngine):
     """Device-resident signature matcher bound to a TopicIndex.
 
     Same contract as DenseEngine/NFAEngine (subscribers / subscribers_batch
@@ -696,7 +803,8 @@ class SigEngine:
                  compact_word_slots: int = 8, compact_max_rows: int = 16,
                  compact_cap_per_topic: int = 3,
                  fixed_sel_blocks: int = 8,
-                 fixed_max_rows: int = 7) -> None:
+                 fixed_max_rows: int = 7,
+                 use_pallas: bool | str = "auto") -> None:
         self.index = index
         self.max_levels = max_levels
         self.max_words = max_words
@@ -719,11 +827,21 @@ class SigEngine:
             raise ValueError("fixed_max_rows must be in [1, 14]")
         self.fixed_sel_blocks = fixed_sel_blocks
         self.fixed_max_rows = fixed_max_rows
+        # fixed path device program: True = fused Pallas kernel (error if
+        # the tables exceed its VMEM plan), "auto" = kernel when it fits,
+        # False = XLA body
+        self.use_pallas = use_pallas
+        self.pallas_active = False
         self._state = None
         self._refresh_lock = threading.Lock()
         self.fallbacks = 0
         self.matches = 0
+        self._init_overlay()
         self.refresh(force=True)
+
+    @staticmethod
+    def _state_version(state) -> int:
+        return state[0].version
 
     # ------------------------------------------------------------------
 
@@ -733,7 +851,7 @@ class SigEngine:
         with self._refresh_lock:
             state = self._state
             if (not force and state is not None
-                    and state[0].version == self.index.version):
+                    and state[0].version == self.index.sub_version):
                 return False
             tables = compile_sig(self.index, max_levels=self.max_levels)
             if len(tables.groups) > MAX_GROUPS:
@@ -794,13 +912,28 @@ class SigEngine:
                 return out
 
             sb, kr = self.fixed_sel_blocks, self.fixed_max_rows
-
-            @jax.jit
-            def fn_fixed(toks8, lens_enc):
-                return sig_match_fixed_body(consts, planes, toks8, lens_enc,
-                                            sel_blocks=sb, max_rows=kr)
-
             fmt16 = n_words * 32 <= 65536
+
+            fn_fixed = None
+            self.pallas_active = False
+            if self.use_pallas:
+                from . import sig_pallas
+                kplan = sig_pallas.plan(tables)
+                if kplan is not None:
+                    fn_fixed = sig_pallas.build_fixed_fn(
+                        tables, consts, kplan, max_rows=kr, fmt16=fmt16)
+                    self.pallas_active = True
+                elif self.use_pallas is True:
+                    raise ValueError(
+                        "use_pallas=True but tables exceed the kernel's "
+                        "VMEM plan (use 'auto' to fall back to XLA)")
+            if fn_fixed is None:
+                @jax.jit
+                def fn_fixed(toks8, lens_enc):
+                    return sig_match_fixed_body(consts, planes, toks8,
+                                                lens_enc, sel_blocks=sb,
+                                                max_rows=kr)
+
             self._state = (tables, consts, fn, fn_many,
                            fn_compact, fn_compact_many, fn_fixed, fmt16)
             return True
@@ -816,7 +949,7 @@ class SigEngine:
         rows. Returns (word_idx int32[B, K], word_val uint32[B, K],
         overflow bool[B], hostrows list[np.ndarray], tables)."""
         if self.auto_refresh:
-            self.refresh()
+            self.refresh_soon()
         state = self._state
         if state[2] is None:
             raise RuntimeError(
@@ -835,7 +968,7 @@ class SigEngine:
         """Match a stack of equal-sized topic batches in one device
         dispatch (lax.scan pipeline, as DenseEngine.match_raw_many)."""
         if self.auto_refresh:
-            self.refresh()
+            self.refresh_soon()
         state = self._state
         if state[2] is None:
             raise RuntimeError(
@@ -861,7 +994,7 @@ class SigEngine:
         (counts uint8[B], stream uint32[cap], total int, hostrows,
         tables)."""
         if self.auto_refresh:
-            self.refresh()
+            self.refresh_soon()
         state = self._state
         if state[2] is None:
             raise RuntimeError(
@@ -883,7 +1016,7 @@ class SigEngine:
         The host-exact searchsorted probe runs while the device chews on
         the wildcard rows (async dispatch overlaps them naturally)."""
         if self.auto_refresh:
-            self.refresh()
+            self.refresh_soon()
         state = self._state
         if state[2] is None:
             raise RuntimeError(
@@ -934,7 +1067,7 @@ class SigEngine:
         overlap this batch's device work with the previous batch's fetch).
         """
         if self.auto_refresh:
-            self.refresh()
+            self.refresh_soon()
         state = self._state
         if state[2] is None:
             raise RuntimeError(
@@ -950,7 +1083,7 @@ class SigEngine:
         """CPU-trie fallback for corpora the compiler declined
         (> MAX_GROUPS wildcard shapes); None when the device is active."""
         if self.auto_refresh:
-            self.refresh()
+            self.refresh_soon()
         if self._state[2] is not None:
             return None
         self.matches += len(topics)
@@ -963,7 +1096,14 @@ class SigEngine:
         cpu = self._trie_batch(topics)
         if cpu is not None:
             return cpu
-        cnt, rows, hostrows, tables = self.match_fixed(topics)
+        try:
+            cnt, rows, hostrows, tables = self.match_fixed(topics)
+        except RuntimeError:     # state swapped to trie-only mid-call
+            return self._resync_batch(topics)
+        overlay = self.overlay_for(tables.version)
+        if overlay == "resync":
+            return self._resync_batch(topics)
+        removed = overlay.removed if overlay else None
         out = []
         for i, topic in enumerate(topics):
             self.matches += 1
@@ -971,10 +1111,20 @@ class SigEngine:
                 self.fallbacks += 1
                 out.append(self.index.subscribers(topic))
                 continue
-            result = self.decode_rows(topic, rows[i, :cnt[i]], tables)
-            out.append(self.decode_rows(topic, hostrows[i], tables,
-                                        into=result))
+            result = self.decode_rows(topic, rows[i, :cnt[i]], tables,
+                                      removed=removed)
+            self.decode_rows(topic, hostrows[i], tables, into=result,
+                             removed=removed)
+            out.append(self.merge_delta(topic, result, overlay))
         return out
+
+    def _resync_batch(self, topics: list[str]) -> list[SubscriberSet]:
+        """The journal no longer reaches the compiled tables (mutation
+        storm): serve this batch exactly from the CPU trie while the
+        background recompile catches up."""
+        self.matches += len(topics)
+        self.fallbacks += len(topics)
+        return [self.index.subscribers(t) for t in topics]
 
     def subscribers_compact_batch(self, topics: list[str]
                                   ) -> list[SubscriberSet]:
@@ -983,7 +1133,14 @@ class SigEngine:
         cpu = self._trie_batch(topics)
         if cpu is not None:
             return cpu
-        counts, stream, total, hostrows, tables = self.match_compact(topics)
+        try:
+            counts, stream, total, hostrows, tables = self.match_compact(topics)
+        except RuntimeError:     # state swapped to trie-only mid-call
+            return self._resync_batch(topics)
+        overlay = self.overlay_for(tables.version)
+        if overlay == "resync":
+            return self._resync_batch(topics)
+        removed = overlay.removed if overlay else None
         out = []
         if total > stream.shape[0]:      # stream overflow: whole batch back
             self.matches += len(topics)
@@ -997,9 +1154,11 @@ class SigEngine:
                 self.fallbacks += 1
                 out.append(self.index.subscribers(topic))
                 continue
-            result = self.decode_rows(topic, stream[off:off + c], tables)
-            out.append(self.decode_rows(topic, hostrows[i], tables,
-                                        into=result))
+            result = self.decode_rows(topic, stream[off:off + c], tables,
+                                      removed=removed)
+            self.decode_rows(topic, hostrows[i], tables, into=result,
+                             removed=removed)
+            out.append(self.merge_delta(topic, result, overlay))
             off += c
         return out
 
@@ -1011,8 +1170,15 @@ class SigEngine:
         cpu = self._trie_batch(topics)
         if cpu is not None:
             return cpu
-        word_idx, word_val, overflow, hostrows, tables = \
-            self.match_raw(topics)
+        try:
+            word_idx, word_val, overflow, hostrows, tables = \
+                self.match_raw(topics)
+        except RuntimeError:     # state swapped to trie-only mid-call
+            return self._resync_batch(topics)
+        overlay = self.overlay_for(tables.version)
+        if overlay == "resync":
+            return self._resync_batch(topics)
+        removed = overlay.removed if overlay else None
         out = []
         for i, topic in enumerate(topics):
             self.matches += 1
@@ -1021,9 +1187,10 @@ class SigEngine:
                 out.append(self.index.subscribers(topic))
             else:
                 result = self.decode(topic, word_idx[i], word_val[i],
-                                     tables)
-                out.append(self.decode_rows(topic, hostrows[i], tables,
-                                            into=result))
+                                     tables, removed=removed)
+                self.decode_rows(topic, hostrows[i], tables, into=result,
+                                 removed=removed)
+                out.append(self.merge_delta(topic, result, overlay))
         return out
 
     def subscribers(self, topic: str) -> SubscriberSet:
@@ -1037,9 +1204,10 @@ class SigEngine:
 
     @staticmethod
     def _add_row(result: SubscriberSet, row: int, tables: SigTables,
-                 tlevels, dollar: bool) -> None:
+                 tlevels, dollar: bool, removed=None) -> None:
         """Verify one candidate row against the topic and union its
-        entries (padding bits and hash collisions are dropped here)."""
+        entries (padding bits and hash collisions are dropped here;
+        ``removed`` drops pairs the overlay has unsubscribed/replaced)."""
         flevels = tables.row_levels[row]
         if flevels is None or not filter_matches_topic(flevels, tlevels,
                                                        dollar):
@@ -1049,15 +1217,19 @@ class SigEngine:
             entry = entries[b]
             if entry.shared:
                 for cid, sub in entry.candidates.items():
+                    if removed and (cid, sub.filter) in removed:
+                        continue
                     result.add_shared(entry.group, sub.filter, cid, sub)
             else:
                 sub = entry.subscription
+                if removed and (entry.client_id, sub.filter) in removed:
+                    continue
                 result.add(entry.client_id, sub, sub.filter)
 
     @staticmethod
     def decode(topic: str, word_idx: np.ndarray, word_val: np.ndarray,
-               tables: SigTables,
-               into: SubscriberSet | None = None) -> SubscriberSet:
+               tables: SigTables, into: SubscriberSet | None = None,
+               removed=None) -> SubscriberSet:
         """Union matched words' rows into a SubscriberSet, re-verifying
         each row's filter against the topic (collision guard)."""
         result = SubscriberSet() if into is None else into
@@ -1071,17 +1243,32 @@ class SigEngine:
             while bits:
                 low = bits & -bits
                 SigEngine._add_row(result, base + low.bit_length() - 1,
-                                   tables, tlevels, dollar)
+                                   tables, tlevels, dollar, removed)
                 bits ^= low
         return result
 
     @staticmethod
     def decode_rows(topic: str, rows: np.ndarray, tables: SigTables,
-                    into: SubscriberSet | None = None) -> SubscriberSet:
+                    into: SubscriberSet | None = None,
+                    removed=None) -> SubscriberSet:
         """Union a compact row-id slice into a SubscriberSet (verified)."""
         result = SubscriberSet() if into is None else into
         tlevels = split_levels(topic)
         dollar = topic.startswith("$")
         for row in rows:
-            SigEngine._add_row(result, int(row), tables, tlevels, dollar)
+            SigEngine._add_row(result, int(row), tables, tlevels, dollar,
+                               removed)
+        return result
+
+    @staticmethod
+    def merge_delta(topic: str, result: SubscriberSet,
+                    overlay: Overlay | None) -> SubscriberSet:
+        """Union the overlay's delta-trie matches for ``topic``."""
+        if overlay is not None:
+            extra = overlay.delta.subscribers(topic)
+            for cid, sub in extra.subscriptions.items():
+                result.add(cid, sub, sub.filter)
+            for (g, f), members in extra.shared.items():
+                for cid, sub in members.items():
+                    result.add_shared(g, f, cid, sub)
         return result

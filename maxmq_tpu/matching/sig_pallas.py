@@ -1,0 +1,193 @@
+"""Fused Pallas kernel for the signature matcher's fixed-slot path.
+
+The XLA formulation (sig.py:sig_match_fixed_body) materializes the [B, W]
+match-word matrix in HBM and re-reads it for extraction — ~2 full HBM
+passes plus separate kernels for the summary/top_k/gather chain. This
+kernel fuses the whole per-tile pipeline in VMEM:
+
+    one-hot MXU expansion of group signatures to words
+      -> 32 bit-plane compares -> packed words       (never leave VMEM)
+      -> popcount totals -> max_rows min-extract+clear iterations
+      -> packed fixed slots
+
+HBM traffic collapses to the tiny inputs ([B, G] split signatures) and the
+16-byte-per-topic output; there is no [B, W] buffer at all, which also
+removes the single-chip batch-size wall at 1M subscriptions (the XLA path
+needs ~11 GB for the word matrix at batch 256K).
+
+Exactness notes:
+  * the expansion rides the MXU in f32, so the uint32 signature is split
+    into 16-bit halves (both exact in f32) and recombined in-kernel;
+  * padding words have an all-zero one-hot column (sig_exp == 0) and
+    poison planes (0xFFFFFFFF), so they never match;
+  * output format and semantics are identical to sig_match_fixed_body
+    with ``sel_blocks`` unconstrained (the kernel min-extracts over the
+    full width, so "matches spread over too many blocks" cannot overflow).
+
+Parity surface: tests/test_sig_parity.py runs every corpus through this
+kernel against the CPU trie.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .sig import SigTables, _ctz32, _popc32, adjusted_signatures
+
+LANE = 128
+VMEM_BUDGET = 10 * 1024 * 1024   # soft per-tile budget (VMEM ~16MB/core)
+
+
+TILE_CELL_BUDGET = 256 * 1408   # empirical tb*w_pad ceiling: fits the
+                                # 16MB scoped-VMEM limit with the unrolled
+                                # compare + min-extract live set
+
+
+def plan(tables: SigTables) -> dict | None:
+    """Kernel shape plan for a compiled table set, or None when the tables
+    don't fit the kernel's VMEM budget (the engine then uses the XLA
+    body — correctness is identical either way)."""
+    n_words = max(int(tables.group_words.sum()), 1)
+    n_groups = max(len(tables.groups), 1)
+    w_pad = -(-n_words // LANE) * LANE
+    g_pad = -(-n_groups // 8) * 8
+    const_bytes = w_pad * (32 * 4 + g_pad * 4)   # planes + one-hot
+    if const_bytes > VMEM_BUDGET:
+        return None
+    tile_rows = TILE_CELL_BUDGET // w_pad
+    tb = 8
+    while tb * 2 <= min(tile_rows, 256):
+        tb *= 2
+    if tb < 32:
+        return None
+    return {"n_words": n_words, "w_pad": w_pad, "g_pad": g_pad, "tb": tb}
+
+
+def _kernel(lo_ref, hi_ref, flag_ref, onehot_ref, planes_ref, out_ref,
+            *, max_rows: int, fmt16: bool):
+    lo = lo_ref[:]                                      # [TB, Gp] f32
+    hi = hi_ref[:]
+    # HIGHEST precision: default MXU f32 runs bf16 passes whose 8-bit
+    # mantissa would round the 16-bit signature halves
+    exp_lo = jnp.dot(lo, onehot_ref[:], precision=jax.lax.Precision.HIGHEST,
+                     preferred_element_type=jnp.float32)  # [TB, Wp]
+    exp_hi = jnp.dot(hi, onehot_ref[:], precision=jax.lax.Precision.HIGHEST,
+                     preferred_element_type=jnp.float32)
+    # Mosaic has no f32->u32 cast; the values are < 2^16 so the i32 hop
+    # is exact and the u32 reinterpret free
+    exp_lo32 = exp_lo.astype(jnp.int32).astype(jnp.uint32)
+    exp_hi32 = exp_hi.astype(jnp.int32).astype(jnp.uint32)
+    sig_exp = (exp_hi32 << 16) | exp_lo32
+
+    acc = jnp.zeros_like(sig_exp)
+    for j in range(32):
+        acc = acc | ((sig_exp == planes_ref[j][None, :]).astype(jnp.uint32)
+                     << jnp.uint32(j))
+
+    # Mosaic reductions only exist for signed ints: counts and the
+    # min-extract run in int32 (row encodings are < 2^22, INF = INT32_MAX)
+    counts = _popc32(acc).astype(jnp.int32).sum(axis=1)  # [TB]
+    too_deep = flag_ref[:, 0] != 0
+    overflow = too_deep | (counts > max_rows)
+
+    tb, w_pad = acc.shape
+    wordidx = jax.lax.broadcasted_iota(jnp.int32, (tb, w_pad), 1)
+    inf = jnp.int32(0x7FFFFFFF)
+    g = acc
+    rows = []
+    for _ in range(max_rows):
+        enc = jnp.where(g != 0,
+                        (wordidx << 5) | _ctz32(g).astype(jnp.int32), inf)
+        m = enc.min(axis=1)
+        rows.append(m)
+        hit = enc == m[:, None]
+        g = jnp.where(hit, g & (g - jnp.uint32(1)), g)
+
+    cnt = jnp.where(overflow, jnp.uint32(0xF),
+                    jnp.minimum(counts, max_rows).astype(jnp.uint32))
+    if fmt16:
+        row16 = [jnp.where(r == inf, jnp.uint32(0xFFFF),
+                           r.astype(jnp.uint32) & 0xFFFF)
+                 for r in rows]
+        out = [cnt << 28 | row16[0]]
+        for i in range(1, max_rows, 2):
+            hi16 = row16[i + 1] if i + 1 < max_rows else jnp.uint32(0xFFFF)
+            out.append(hi16 << 16 | row16[i])
+    else:
+        out = [cnt] + [r.astype(jnp.uint32) for r in rows]
+    out_ref[:] = jnp.stack(out, axis=1)
+
+
+def build_fixed_fn(tables: SigTables, consts: dict, kplan: dict,
+                   max_rows: int, fmt16: bool):
+    """jit(toks8, lens_enc) -> packed fixed slots, via the fused kernel.
+
+    ``consts`` are the engine's device constants (for the [B, G] signature
+    prologue, which stays in XLA — it is tiny). The expansion one-hot and
+    bit-plane tables are baked as kernel operands."""
+    w_pad, g_pad, tb = kplan["w_pad"], kplan["g_pad"], kplan["tb"]
+    n_words = kplan["n_words"]
+
+    onehot = np.zeros((g_pad, w_pad), dtype=np.float32)
+    grp_sizes = [int(w) for w in tables.group_words]
+    w0 = 0
+    for g, w in enumerate(grp_sizes):
+        onehot[g, w0:w0 + w] = 1.0
+        w0 += w
+    planes = np.full((32, w_pad), 0xFFFFFFFF, dtype=np.uint32)
+    if tables.n_rows:
+        planes[:, :n_words] = tables.row_sig.reshape(n_words, 32).T
+    onehot_d = jax.device_put(jnp.asarray(onehot))
+    planes_d = jax.device_put(jnp.asarray(planes))
+
+    # fmt16: row0 shares the count word, rows 1.. pack two per word
+    out_w = 1 + (max_rows - 1 + 1) // 2 if fmt16 else 1 + max_rows
+    kern = functools.partial(_kernel, max_rows=max_rows, fmt16=fmt16)
+    # CPU backend (tests) runs the kernel in the Pallas interpreter
+    interpret = jax.default_backend() != "tpu"
+
+    @jax.jit
+    def fn(toks8, lens_enc):
+        batch = toks8.shape[0]
+        dollar = lens_enc < 0
+        lengths = jnp.abs(lens_enc.astype(jnp.int32))
+        sig_adj = adjusted_signatures(consts, toks8.astype(jnp.int32),
+                                      lengths, dollar)      # [B, G]
+        pad_g = g_pad - sig_adj.shape[1]
+        if pad_g:
+            sig_adj = jnp.pad(sig_adj, ((0, 0), (0, pad_g)))
+        lo = (sig_adj & jnp.uint32(0xFFFF)).astype(jnp.float32)
+        hi = (sig_adj >> jnp.uint32(16)).astype(jnp.float32)
+        flag = (lengths >= 127).astype(jnp.int32)[:, None]
+
+        pad_b = (-batch) % tb
+        if pad_b:
+            lo = jnp.pad(lo, ((0, pad_b), (0, 0)))
+            hi = jnp.pad(hi, ((0, pad_b), (0, 0)))
+            flag = jnp.pad(flag, ((0, pad_b), (0, 0)))
+        nb = lo.shape[0] // tb
+
+        out = pl.pallas_call(
+            kern,
+            grid=(nb,),
+            in_specs=[
+                pl.BlockSpec((tb, g_pad), lambda i: (i, 0)),
+                pl.BlockSpec((tb, g_pad), lambda i: (i, 0)),
+                pl.BlockSpec((tb, 1), lambda i: (i, 0)),
+                pl.BlockSpec((g_pad, w_pad), lambda i: (0, 0)),
+                pl.BlockSpec((32, w_pad), lambda i: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((tb, out_w), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((nb * tb, out_w), jnp.uint32),
+            interpret=interpret,
+        )(lo, hi, flag, onehot_d, planes_d)
+        return out[:batch]
+
+    return fn

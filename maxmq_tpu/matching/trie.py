@@ -11,10 +11,22 @@ in the same trie, shared-group selection uses a round-robin cursor.
 from __future__ import annotations
 
 import threading
+from collections import deque
 from dataclasses import dataclass, field
 
 from ..protocol.packets import Packet, Subscription
 from .topics import is_dollar, parse_share, split_levels
+
+JOURNAL_CAP = 4096   # mutations kept for overlay replay; beyond this a
+                     # matcher serves staleness via the CPU trie instead
+
+
+def subs_version(index) -> int:
+    """The subscription-only version of an index (falls back to the full
+    version for index-likes without one): what device matchers key their
+    staleness on, so retained-message churn never forces a recompile."""
+    v = getattr(index, "sub_version", None)
+    return v if v is not None else getattr(index, "version", 0)
 
 
 def merge_subscription(base: Subscription | None, new: Subscription,
@@ -88,6 +100,15 @@ class TopicIndex:
         self.retained_count = 0
         # bumped on every mutation; lets the NFA engine detect staleness
         self.version = 0
+        # bumped on SUBSCRIPTION mutations only — device matchers key
+        # their staleness off this so retained-message churn never forces
+        # a table recompile
+        self.sub_version = 0
+        # journal of recent subscription mutations, so matchers can serve
+        # adds/removes as a host-side overlay while a recompile runs in
+        # the background: (sub_version, op '+'|'-', client_id, filter,
+        # sub-or-None, group, trie_path)
+        self._journal: deque = deque(maxlen=JOURNAL_CAP)
 
     # ------------------------------------------------------------------
     # Mutation
@@ -112,6 +133,10 @@ class TopicIndex:
             if is_new:
                 self.subscription_count += 1
             self.version += 1
+            self.sub_version += 1
+            self._journal.append((self.sub_version, "+", client_id,
+                                  sub.filter, sub, group,
+                                  "/".join(levels)))
             return is_new
 
     def unsubscribe(self, client_id: str, filter_: str) -> bool:
@@ -142,6 +167,9 @@ class TopicIndex:
             self.subscription_count -= 1
             self._trim(path, node)
             self.version += 1
+            self.sub_version += 1
+            self._journal.append((self.sub_version, "-", client_id,
+                                  filter_, None, group, "/".join(levels)))
             return True
 
     def _trim(self, path: list[tuple[_Node, str]], node: _Node) -> None:
@@ -151,6 +179,26 @@ class TopicIndex:
                 node = parent
             else:
                 return
+
+    def journal_since(self, version: int):
+        """Subscription mutations after ``version`` in order, or None when
+        the journal no longer reaches back that far (the caller must do a
+        full resync). Entries: (sub_version, op, client_id, filter, sub,
+        group, trie_path)."""
+        with self._lock:
+            if version >= self.sub_version:
+                return []
+            # versions are consecutive: scan from the newest end and stop
+            # at the first already-applied entry (O(new), not O(cap))
+            entries = []
+            for e in reversed(self._journal):
+                if e[0] <= version:
+                    break
+                entries.append(e)
+            entries.reverse()
+            if not entries or entries[0][0] != version + 1:
+                return None
+            return entries
 
     # ------------------------------------------------------------------
     # Matching

@@ -37,7 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..matching.engine import NFAEngine, match_batch_body
 from ..matching.nfa import NFATables, TableFull, compile_subscriptions
-from ..matching.trie import SubscriberSet, TopicIndex
+from ..matching.trie import SubscriberSet, TopicIndex, subs_version
 
 
 def make_mesh(shape: tuple[int, int] = None, devices=None) -> Mesh:
@@ -119,7 +119,10 @@ def _sharded_sig_match(tables_dev, toks, lens_enc, *, sel_blocks, max_rows):
     return out[None]                      # re-add the 'subs' axis
 
 
-class ShardedSigEngine:
+from ..matching.sig import OverlayedEngine
+
+
+class ShardedSigEngine(OverlayedEngine):
     """Signature matcher sharded over a ('data', 'subs') mesh — cluster
     mode of the production `sig` path.
 
@@ -146,7 +149,12 @@ class ShardedSigEngine:
         self._refresh_lock = threading.Lock()
         self.matches = 0
         self.fallbacks = 0
+        self._init_overlay()
         self.refresh(force=True)
+
+    @staticmethod
+    def _state_version(state) -> int:
+        return state[0]
 
     # ------------------------------------------------------------------
 
@@ -155,9 +163,9 @@ class ShardedSigEngine:
         with self._refresh_lock:
             state = self._state
             if (not force and state is not None
-                    and state[0] == self.index.version):
+                    and state[0] == subs_version(self.index)):
                 return False
-            version = self.index.version
+            version = subs_version(self.index)
             shards = compile_sig_shards(self.index.all_subscriptions(),
                                         self.sp, version)
             from ..matching.sig import MAX_GROUPS
@@ -223,7 +231,7 @@ class ShardedSigEngine:
         from ..matching.sig import (host_exact_rows_from_sig,
                                     prepare_batch_sig)
 
-        self.refresh()
+        self.refresh_soon()
         _version, shards, dev, fn, d_max, union_exact = self._state
         if fn is None:
             raise RuntimeError(
@@ -247,12 +255,23 @@ class ShardedSigEngine:
     def subscribers_batch(self, topics: list[str]) -> list[SubscriberSet]:
         from ..matching.sig import SigEngine
 
-        self.refresh()
+        self.refresh_soon()
         if self._state[3] is None:      # pathological corpus: CPU trie
             self.matches += len(topics)
             self.fallbacks += len(topics)
             return [self.index.subscribers(t) for t in topics]
-        out, hostrows, shards = self.match_raw(topics)
+        try:
+            out, hostrows, shards = self.match_raw(topics)
+        except RuntimeError:            # state swapped to disabled mid-call
+            self.matches += len(topics)
+            self.fallbacks += len(topics)
+            return [self.index.subscribers(t) for t in topics]
+        overlay = self.overlay_for(shards[0].version)
+        if overlay == "resync":
+            self.matches += len(topics)
+            self.fallbacks += len(topics)
+            return [self.index.subscribers(t) for t in topics]
+        removed = overlay.removed if overlay else None
         results = []
         for i, topic in enumerate(topics):
             self.matches += 1
@@ -264,10 +283,10 @@ class ShardedSigEngine:
             result = SubscriberSet()
             for s, tables in enumerate(shards):
                 SigEngine.decode_rows(topic, out[s, i, 1:1 + int(cnt[s])],
-                                      tables, into=result)
+                                      tables, into=result, removed=removed)
                 SigEngine.decode_rows(topic, hostrows[s][i], tables,
-                                      into=result)
-            results.append(result)
+                                      into=result, removed=removed)
+            results.append(SigEngine.merge_delta(topic, result, overlay))
         return results
 
     def subscribers(self, topic: str) -> SubscriberSet:
@@ -314,9 +333,9 @@ class ShardedNFAEngine:
         with self._refresh_lock:
             state = self._state
             if (not force and state is not None
-                    and state[0] == self.index.version):
+                    and state[0] == subs_version(self.index)):
                 return False
-            version = self.index.version
+            version = subs_version(self.index)
             shards = compile_shards(self.index.all_subscriptions(), self.sp,
                                     version)
 

@@ -26,13 +26,17 @@ def run_path(engine, path, topics):
 
 
 def check_parity(index, topics, paths=PATHS, **engine_kw):
-    engine = SigEngine(index, **engine_kw)
-    for path in paths:
-        got = run_path(engine, path, topics)
-        for topic, result in zip(topics, got):
-            want = index.subscribers(topic)
-            assert normalize(result) == normalize(want), (
-                f"[{path}] mismatch on topic {topic!r}")
+    # both fixed-path device programs: fused Pallas kernel (auto) and the
+    # XLA body (False)
+    for use_pallas in ("auto", False):
+        engine = SigEngine(index, use_pallas=use_pallas, **engine_kw)
+        for path in paths:
+            got = run_path(engine, path, topics)
+            for topic, result in zip(topics, got):
+                want = index.subscribers(topic)
+                assert normalize(result) == normalize(want), (
+                    f"[{path}/pallas={use_pallas}] mismatch on "
+                    f"topic {topic!r}")
     return engine
 
 
@@ -228,3 +232,88 @@ def test_pathological_group_count_falls_back_to_trie(monkeypatch):
     monkeypatch.setattr(sigmod, "MAX_GROUPS", 4096)
     engine.refresh()
     assert engine._state[2] is not None
+
+
+def test_pallas_plan_bounds():
+    from maxmq_tpu.matching import sig_pallas
+    idx = TopicIndex()
+    for i in range(50):
+        idx.subscribe(f"c{i}", Subscription(filter=f"a/{i}/+"))
+    tables = compile_sig(idx)
+    kplan = sig_pallas.plan(tables)
+    assert kplan is not None and kplan["tb"] >= 32
+    assert kplan["w_pad"] % 128 == 0
+    # a table set wider than the tile-cell budget must decline
+    import numpy as np
+    big = compile_sig(idx)
+    big.group_words = np.asarray([sig_pallas.TILE_CELL_BUDGET // 16],
+                                 dtype=np.int32)
+    assert sig_pallas.plan(big) is None
+
+
+# ------------------------------------------------- staleness overlay
+
+def _frozen_engine(idx, **kw):
+    """Engine whose background recompile never runs: matches MUST be
+    served exactly via the journal overlay."""
+    engine = SigEngine(idx, **kw)
+    engine.refresh_soon = lambda: None
+    return engine
+
+
+def test_overlay_serves_mutations_without_recompile():
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="a/+", qos=1))
+    idx.subscribe("c2", Subscription(filter="a/b"))
+    engine = _frozen_engine(idx)
+    base_version = engine.tables.version
+
+    idx.subscribe("c3", Subscription(filter="a/#", qos=2))          # add
+    idx.unsubscribe("c2", "a/b")                                    # remove
+    idx.subscribe("c1", Subscription(filter="a/+", qos=0))          # replace
+    idx.subscribe("s1", Subscription(filter="$share/g/a/+"))        # shared
+
+    for path in PATHS:
+        got = run_path(engine, path, ["a/b", "a", "x"])
+        for topic, s in zip(["a/b", "a", "x"], got):
+            want = idx.subscribers(topic)
+            assert normalize(s) == normalize(want), (path, topic)
+    # tables never recompiled: served purely by the overlay
+    assert engine.tables.version == base_version
+    assert engine._overlay is not None and not engine._overlay.empty
+
+    # a real refresh drops the overlay
+    engine.refresh()
+    assert engine.tables.version == idx.sub_version
+    got = engine.subscribers_fixed_batch(["a/b"])[0]
+    assert normalize(got) == normalize(idx.subscribers("a/b"))
+
+
+def test_overlay_journal_gap_resyncs_via_trie(monkeypatch):
+    import maxmq_tpu.matching.trie as triemod
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="a/b"))
+    engine = _frozen_engine(idx)
+    # overflow the journal far past its capacity
+    idx._journal = type(idx._journal)(maxlen=4)
+    for i in range(50):
+        idx.subscribe(f"g{i}", Subscription(filter=f"q/{i}"))
+    got = engine.subscribers_fixed_batch(["q/7", "a/b"])
+    assert normalize(got[0]) == normalize(idx.subscribers("q/7"))
+    assert normalize(got[1]) == normalize(idx.subscribers("a/b"))
+    assert engine.fallbacks >= 2
+
+
+def test_retained_churn_never_recompiles():
+    from maxmq_tpu.protocol.codec import PacketType as PT
+    from maxmq_tpu.protocol.packets import FixedHeader, Packet
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="a/+"))
+    engine = SigEngine(idx)
+    v = engine.tables.version
+    for i in range(5):
+        idx.retain(Packet(fixed=FixedHeader(type=PT.PUBLISH),
+                          topic=f"a/r{i}", payload=b"x"))
+    assert idx.sub_version == v          # retained does not bump
+    engine.refresh()
+    assert engine.tables.version == v    # and never forces a recompile
