@@ -1076,7 +1076,8 @@ class SigEngine(OverlayedEngine):
                 "APIs, which fall back to the CPU trie")
         tables, fn_fixed, fmt16 = state[0], state[6], state[7]
         toks8, lens_enc, hostrows = prepare_batch(tables, topics)
-        out = fn_fixed(jnp.asarray(toks8), jnp.asarray(lens_enc))
+        # both fixed-path programs are jitted and device_put numpy inputs
+        out = fn_fixed(toks8, lens_enc)
         return out, hostrows, tables, fmt16
 
     def _trie_batch(self, topics: list[str]) -> list[SubscriberSet] | None:

@@ -1,28 +1,49 @@
-"""Fused Pallas kernel for the signature matcher's fixed-slot path.
+"""Fused Pallas kernels for the signature matcher's fixed-slot path.
 
 The XLA formulation (sig.py:sig_match_fixed_body) materializes the [B, W]
 match-word matrix in HBM and re-reads it for extraction — ~2 full HBM
-passes plus separate kernels for the summary/top_k/gather chain. This
-kernel fuses the whole per-tile pipeline in VMEM:
+passes plus separate kernels for the summary/top_k/gather chain. The
+kernels here fuse the per-tile pipeline in VMEM:
 
     one-hot MXU expansion of group signatures to words
       -> 32 bit-plane compares -> packed words       (never leave VMEM)
-      -> popcount totals -> max_rows min-extract+clear iterations
-      -> packed fixed slots
+      -> single-bit word encodings -> max_rows min-extract iterations
+      -> per-chunk fixed candidate slots
 
-HBM traffic collapses to the tiny inputs ([B, G] split signatures) and the
-16-byte-per-topic output; there is no [B, W] buffer at all, which also
-removes the single-chip batch-size wall at 1M subscriptions (the XLA path
-needs ~11 GB for the word matrix at batch 256K).
+HBM traffic collapses to the tiny inputs ([B, G] split signatures) and
+the few-bytes-per-topic outputs; there is no [B, W] buffer at all, which
+also removes the single-chip batch-size wall at 1M subscriptions (the
+XLA path needs ~11 GB for the word matrix at batch 256K).
+
+Scaling (vs the round-1 kernel, which kept the whole [G, W] one-hot and
+a [TB, W] working set resident and therefore declined beyond ~100K
+subscriptions): the word axis is split into chunks of at most
+``CHUNK_WORDS`` columns, one pallas_call per chunk — all inside a SINGLE
+jit (one device dispatch per batch: dispatch round-trips dominate when
+the chip sits behind a network tunnel). Every chunk's constants (one-hot
+slice + plane slice) and working set fit VMEM regardless of corpus size;
+a final XLA merge sorts the per-chunk candidates into the packed
+fixed-slot output. Chunk count grows linearly with the corpus; nothing
+else does.
+
+Extraction rides a structural fact of the grouping: one word holds 32
+rows of a SINGLE group, and within a group a topic can match at most one
+row (two same-shape filters matching the same topic would be the same
+filter), so >1 bit in a match word can only be a hash collision. The
+kernel flags those topics as overflow (count 0xF -> exact CPU-trie
+fallback, a ~2^-32 event), which lets the candidate bit index come from
+one count-leading-zeros op instead of a popcount chain.
 
 Exactness notes:
   * the expansion rides the MXU in f32, so the uint32 signature is split
     into 16-bit halves (both exact in f32) and recombined in-kernel;
   * padding words have an all-zero one-hot column (sig_exp == 0) and
     poison planes (0xFFFFFFFF), so they never match;
-  * output format and semantics are identical to sig_match_fixed_body
-    with ``sel_blocks`` unconstrained (the kernel min-extracts over the
-    full width, so "matches spread over too many blocks" cannot overflow).
+  * output format and semantics match sig_match_fixed_body with
+    ``sel_blocks`` unconstrained (the kernels min-extract over the full
+    width, so "matches spread over too many blocks" cannot overflow);
+    the only extra overflow source is the collision case above, which
+    the CPU fallback serves exactly.
 
 Parity surface: tests/test_sig_parity.py runs every corpus through this
 kernel against the CPU trie.
@@ -37,47 +58,59 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
-from .sig import SigTables, _ctz32, _popc32, adjusted_signatures
+from .sig import SigTables, adjusted_signatures
 
 LANE = 128
-VMEM_BUDGET = 10 * 1024 * 1024   # soft per-tile budget (VMEM ~16MB/core)
-
-
-TILE_CELL_BUDGET = 256 * 1408   # empirical tb*w_pad ceiling: fits the
-                                # 16MB scoped-VMEM limit with the unrolled
-                                # compare + min-extract live set
+CHUNK_WORDS = 2048               # word columns per chunk kernel
+VMEM_BUDGET = 10 * 1024 * 1024   # soft per-call budget (VMEM ~16MB/core)
+WORK_BUFS = 8                    # live [tb, chunk] buffers at peak
 
 
 def plan(tables: SigTables) -> dict | None:
-    """Kernel shape plan for a compiled table set, or None when the tables
-    don't fit the kernel's VMEM budget (the engine then uses the XLA
-    body — correctness is identical either way)."""
+    """Kernel shape plan for a compiled table set, or None when no batch
+    tile fits the VMEM budget (the engine then uses the XLA body —
+    correctness is identical either way). The plan always succeeds for
+    realistic corpora: chunk width is fixed, so per-chunk VMEM use is
+    independent of the corpus size."""
     n_words = max(int(tables.group_words.sum()), 1)
     n_groups = max(len(tables.groups), 1)
     w_pad = -(-n_words // LANE) * LANE
     g_pad = -(-n_groups // 8) * 8
-    const_bytes = w_pad * (32 * 4 + g_pad * 4)   # planes + one-hot
-    if const_bytes > VMEM_BUDGET:
-        return None
-    tile_rows = TILE_CELL_BUDGET // w_pad
+    chunk = min(w_pad, CHUNK_WORDS)
+
+    def const_bytes(c):
+        # double-buffered constants (one-hot f32 + planes u32) per call
+        return 2 * c * 4 * (32 + g_pad)
+
+    # group-heavy corpora (g_pad up to MAX_GROUPS) shrink the chunk so
+    # the per-call constants still fit, instead of declining
+    while chunk > LANE and const_bytes(chunk) + 8 * WORK_BUFS * chunk * 4 \
+            > VMEM_BUDGET:
+        chunk //= 2
+    n_chunks = -(-w_pad // chunk)
+    per_row = WORK_BUFS * chunk * 4
     tb = 8
-    while tb * 2 <= min(tile_rows, 256):
+    while tb * 2 <= 128 and const_bytes(chunk) + tb * 2 * per_row \
+            <= VMEM_BUDGET:
         tb *= 2
-    if tb < 32:
+    if const_bytes(chunk) + tb * per_row > VMEM_BUDGET:
         return None
-    return {"n_words": n_words, "w_pad": w_pad, "g_pad": g_pad, "tb": tb}
+    return {"n_words": n_words, "w_pad": w_pad, "g_pad": g_pad,
+            "chunk": chunk, "n_chunks": n_chunks, "tb": tb}
 
 
-def _kernel(lo_ref, hi_ref, flag_ref, onehot_ref, planes_ref, out_ref,
-            *, max_rows: int, fmt16: bool):
+def _chunk_kernel(lo_ref, hi_ref, flag_ref, onehot_ref, planes_ref, out_ref,
+                  *, max_rows: int, word_base: int):
+    """One word-chunk: [TB, Gp] signatures -> [TB, 1 + max_rows] candidate
+    slots (col 0 = count, 0xF = overflow; cols 1.. = GLOBAL row encodings
+    ascending, 0xFFFFFFFF-filled)."""
     lo = lo_ref[:]                                      # [TB, Gp] f32
     hi = hi_ref[:]
     # HIGHEST precision: default MXU f32 runs bf16 passes whose 8-bit
     # mantissa would round the 16-bit signature halves
     exp_lo = jnp.dot(lo, onehot_ref[:], precision=jax.lax.Precision.HIGHEST,
-                     preferred_element_type=jnp.float32)  # [TB, Wp]
+                     preferred_element_type=jnp.float32)  # [TB, C]
     exp_hi = jnp.dot(hi, onehot_ref[:], precision=jax.lax.Precision.HIGHEST,
                      preferred_element_type=jnp.float32)
     # Mosaic has no f32->u32 cast; the values are < 2^16 so the i32 hop
@@ -91,67 +124,92 @@ def _kernel(lo_ref, hi_ref, flag_ref, onehot_ref, planes_ref, out_ref,
         acc = acc | ((sig_exp == planes_ref[j][None, :]).astype(jnp.uint32)
                      << jnp.uint32(j))
 
-    # Mosaic reductions only exist for signed ints: counts and the
-    # min-extract run in int32 (row encodings are < 2^22, INF = INT32_MAX)
-    counts = _popc32(acc).astype(jnp.int32).sum(axis=1)  # [TB]
+    # one word = 32 rows of one group; a real topic matches <=1 row per
+    # group, so multi-bit words are hash collisions -> overflow (exact
+    # CPU fallback). That makes the bit index one clz op — the garbage
+    # value on a multi-bit word never escapes (its topic overflows).
+    nz = acc != 0
+    multi = (acc & (acc - jnp.uint32(1))) != 0
+    counts = nz.astype(jnp.int32).sum(axis=1)            # [TB]
+    collided = multi.astype(jnp.int32).sum(axis=1) > 0
     too_deep = flag_ref[:, 0] != 0
-    overflow = too_deep | (counts > max_rows)
+    overflow = too_deep | collided | (counts > max_rows)
 
-    tb, w_pad = acc.shape
-    wordidx = jax.lax.broadcasted_iota(jnp.int32, (tb, w_pad), 1)
+    bit = jnp.int32(31) - jax.lax.clz(acc.astype(jnp.int32))
+    tb, chunk = acc.shape
+    wordidx = jax.lax.broadcasted_iota(jnp.int32, (tb, chunk), 1) + word_base
     inf = jnp.int32(0x7FFFFFFF)
-    g = acc
+    # Mosaic reductions only exist for signed ints: the min-extract runs
+    # in int32 (row encodings are < 2^27, INF = INT32_MAX)
+    enc = jnp.where(nz, (wordidx << 5) | bit, inf)
     rows = []
     for _ in range(max_rows):
-        enc = jnp.where(g != 0,
-                        (wordidx << 5) | _ctz32(g).astype(jnp.int32), inf)
-        m = enc.min(axis=1)
+        m = enc.min(axis=1)                              # [TB]
         rows.append(m)
-        hit = enc == m[:, None]
-        g = jnp.where(hit, g & (g - jnp.uint32(1)), g)
+        enc = jnp.where(enc == m[:, None], inf, enc)
 
     cnt = jnp.where(overflow, jnp.uint32(0xF),
                     jnp.minimum(counts, max_rows).astype(jnp.uint32))
-    if fmt16:
-        row16 = [jnp.where(r == inf, jnp.uint32(0xFFFF),
-                           r.astype(jnp.uint32) & 0xFFFF)
-                 for r in rows]
-        out = [cnt << 28 | row16[0]]
-        for i in range(1, max_rows, 2):
-            hi16 = row16[i + 1] if i + 1 < max_rows else jnp.uint32(0xFFFF)
-            out.append(hi16 << 16 | row16[i])
-    else:
-        out = [cnt] + [r.astype(jnp.uint32) for r in rows]
+    out = [cnt] + [jnp.where(r == inf, jnp.uint32(0xFFFFFFFF),
+                             r.astype(jnp.uint32)) for r in rows]
     out_ref[:] = jnp.stack(out, axis=1)
+
+
+def _run_chunk(kern, lo, hi, flag, onehot_c, planes_c, tb, g_pad, chunk,
+               max_rows, interpret):
+    nb = lo.shape[0] // tb
+    return pl.pallas_call(
+        kern,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((tb, g_pad), lambda i: (i, 0)),
+            pl.BlockSpec((tb, g_pad), lambda i: (i, 0)),
+            pl.BlockSpec((tb, 1), lambda i: (i, 0)),
+            pl.BlockSpec((g_pad, chunk), lambda i: (0, 0)),
+            pl.BlockSpec((32, chunk), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((tb, 1 + max_rows), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((nb * tb, 1 + max_rows), jnp.uint32),
+        interpret=interpret,
+    )(lo, hi, flag, onehot_c, planes_c)
 
 
 def build_fixed_fn(tables: SigTables, consts: dict, kplan: dict,
                    max_rows: int, fmt16: bool):
-    """jit(toks8, lens_enc) -> packed fixed slots, via the fused kernel.
+    """jit(toks8, lens_enc) -> packed fixed slots, via the fused chunk
+    kernels + XLA merge — one device dispatch per batch.
 
     ``consts`` are the engine's device constants (for the [B, G] signature
     prologue, which stays in XLA — it is tiny). The expansion one-hot and
-    bit-plane tables are baked as kernel operands."""
+    bit-plane tables are sliced per chunk and baked as kernel operands.
+    Output format is identical to sig_match_fixed_body's."""
     w_pad, g_pad, tb = kplan["w_pad"], kplan["g_pad"], kplan["tb"]
+    chunk, n_chunks = kplan["chunk"], kplan["n_chunks"]
     n_words = kplan["n_words"]
 
-    onehot = np.zeros((g_pad, w_pad), dtype=np.float32)
+    # constants padded to the full chunk grid (n_chunks * chunk >= w_pad):
+    # every BlockSpec-visible column must carry the poison scheme (zero
+    # one-hot => sig_exp 0, plane 0xFFFFFFFF => never equal), so the last
+    # chunk's padding can never produce phantom match bits
+    w_full = n_chunks * chunk
+    onehot = np.zeros((g_pad, w_full), dtype=np.float32)
     grp_sizes = [int(w) for w in tables.group_words]
     w0 = 0
     for g, w in enumerate(grp_sizes):
         onehot[g, w0:w0 + w] = 1.0
         w0 += w
-    planes = np.full((32, w_pad), 0xFFFFFFFF, dtype=np.uint32)
+    planes = np.full((32, w_full), 0xFFFFFFFF, dtype=np.uint32)
     if tables.n_rows:
         planes[:, :n_words] = tables.row_sig.reshape(n_words, 32).T
-    onehot_d = jax.device_put(jnp.asarray(onehot))
-    planes_d = jax.device_put(jnp.asarray(planes))
+    onehot_c = [jax.device_put(jnp.asarray(
+        onehot[:, c * chunk:(c + 1) * chunk])) for c in range(n_chunks)]
+    planes_c = [jax.device_put(jnp.asarray(
+        planes[:, c * chunk:(c + 1) * chunk])) for c in range(n_chunks)]
 
-    # fmt16: row0 shares the count word, rows 1.. pack two per word
-    out_w = 1 + (max_rows - 1 + 1) // 2 if fmt16 else 1 + max_rows
-    kern = functools.partial(_kernel, max_rows=max_rows, fmt16=fmt16)
     # CPU backend (tests) runs the kernel in the Pallas interpreter
     interpret = jax.default_backend() != "tpu"
+    kerns = [functools.partial(_chunk_kernel, max_rows=max_rows,
+                               word_base=c * chunk) for c in range(n_chunks)]
 
     @jax.jit
     def fn(toks8, lens_enc):
@@ -172,22 +230,40 @@ def build_fixed_fn(tables: SigTables, consts: dict, kplan: dict,
             lo = jnp.pad(lo, ((0, pad_b), (0, 0)))
             hi = jnp.pad(hi, ((0, pad_b), (0, 0)))
             flag = jnp.pad(flag, ((0, pad_b), (0, 0)))
-        nb = lo.shape[0] // tb
 
-        out = pl.pallas_call(
-            kern,
-            grid=(nb,),
-            in_specs=[
-                pl.BlockSpec((tb, g_pad), lambda i: (i, 0)),
-                pl.BlockSpec((tb, g_pad), lambda i: (i, 0)),
-                pl.BlockSpec((tb, 1), lambda i: (i, 0)),
-                pl.BlockSpec((g_pad, w_pad), lambda i: (0, 0)),
-                pl.BlockSpec((32, w_pad), lambda i: (0, 0)),
-            ],
-            out_specs=pl.BlockSpec((tb, out_w), lambda i: (i, 0)),
-            out_shape=jax.ShapeDtypeStruct((nb * tb, out_w), jnp.uint32),
-            interpret=interpret,
-        )(lo, hi, flag, onehot_d, planes_d)
-        return out[:batch]
+        outs = [_run_chunk(kerns[c], lo, hi, flag, onehot_c[c], planes_c[c],
+                           tb, g_pad, chunk, max_rows, interpret)
+                for c in range(n_chunks)]
+
+        if n_chunks == 1:
+            cnt0 = outs[0][:, 0]
+            rows_sorted = outs[0][:, 1:]
+            overflow = cnt0 == 0xF
+            counts = jnp.where(overflow, 0, cnt0).astype(jnp.int32)
+        else:
+            cnts = jnp.stack([o[:, 0] for o in outs], axis=1)  # [B, NC]
+            overflow = (cnts == 0xF).any(axis=1)
+            counts = jnp.where(cnts == 0xF, 0,
+                               cnts.astype(jnp.int32)).sum(axis=1)
+            overflow = overflow | (counts > max_rows)
+            cand = jnp.concatenate([o[:, 1:] for o in outs], axis=1)
+            rows_sorted = jnp.sort(cand, axis=1)[:, :max_rows]
+
+        cnt = jnp.where(overflow, jnp.uint32(0xF),
+                        jnp.minimum(counts, max_rows).astype(jnp.uint32))
+        inf32 = jnp.uint32(0xFFFFFFFF)
+        rows = [rows_sorted[:, k] for k in range(max_rows)]
+        if fmt16:
+            row16 = [jnp.where(r == inf32, jnp.uint32(0xFFFF), r & 0xFFFF)
+                     for r in rows]
+            out = [cnt << 28 | row16[0]]
+            for i in range(1, max_rows, 2):
+                hi16 = row16[i + 1] if i + 1 < max_rows else jnp.uint32(
+                    0xFFFF)
+                out.append(hi16 << 16 | row16[i])
+        else:
+            out = [cnt] + rows
+        packed = jnp.stack(out, axis=1)
+        return packed[:batch]
 
     return fn

@@ -234,6 +234,39 @@ def test_pathological_group_count_falls_back_to_trie(monkeypatch):
     assert engine._state[2] is not None
 
 
+def test_pallas_multi_chunk_parity(monkeypatch):
+    """Exercise the n_chunks > 1 branch of build_fixed_fn (cross-chunk
+    candidate merge + short last chunk) by shrinking the chunk width —
+    production corpora hit it at ~65K+ device rows."""
+    from maxmq_tpu.matching import sig_pallas
+    monkeypatch.setattr(sig_pallas, "CHUNK_WORDS", 128)
+    rng = random.Random(5)
+    idx = TopicIndex()
+    segs = [f"s{i}" for i in range(40)]
+    for i in range(12_000):
+        depth = rng.randint(2, 6)
+        levels = [rng.choice(segs) for _ in range(depth)]
+        r = rng.random()
+        if r < 0.4:
+            levels[rng.randrange(depth)] = "+"
+        elif r < 0.6:
+            levels = levels[:rng.randint(1, depth)] + ["#"]
+        idx.subscribe(f"c{i}", Subscription(filter="/".join(levels),
+                                            qos=i % 3))
+    tables = compile_sig(idx)
+    kplan = sig_pallas.plan(tables)
+    assert kplan is not None and kplan["n_chunks"] > 1, kplan
+    assert kplan["n_chunks"] * kplan["chunk"] >= kplan["w_pad"]
+    topics = ["/".join(rng.choice(segs)
+                       for _ in range(rng.randint(1, 7)))
+              for _ in range(64)]
+    engine = SigEngine(idx, use_pallas=True, fixed_max_rows=14)
+    assert engine.pallas_active
+    got = engine.subscribers_fixed_batch(topics)
+    for topic, result in zip(topics, got):
+        assert normalize(result) == normalize(idx.subscribers(topic)), topic
+
+
 def test_pallas_plan_bounds():
     from maxmq_tpu.matching import sig_pallas
     idx = TopicIndex()
@@ -243,12 +276,21 @@ def test_pallas_plan_bounds():
     kplan = sig_pallas.plan(tables)
     assert kplan is not None and kplan["tb"] >= 32
     assert kplan["w_pad"] % 128 == 0
-    # a table set wider than the tile-cell budget must decline
+    # a 1M-sub-scale table set (tens of thousands of words) must still
+    # plan — the batch tile shrinks instead of the kernel declining
     import numpy as np
     big = compile_sig(idx)
-    big.group_words = np.asarray([sig_pallas.TILE_CELL_BUDGET // 16],
-                                 dtype=np.int32)
-    assert sig_pallas.plan(big) is None
+    big.group_words = np.asarray([12_000], dtype=np.int32)
+    bplan = sig_pallas.plan(big)
+    assert bplan is not None and bplan["tb"] >= 8
+    # chunking keeps per-call VMEM bounded: even a 3M-word (96M-row)
+    # table set plans, with chunk width capped and chunks covering w_pad
+    huge = compile_sig(idx)
+    huge.group_words = np.asarray([3_000_000], dtype=np.int32)
+    hplan = sig_pallas.plan(huge)
+    assert hplan is not None
+    assert hplan["chunk"] <= sig_pallas.CHUNK_WORDS
+    assert hplan["chunk"] * hplan["n_chunks"] >= hplan["w_pad"]
 
 
 # ------------------------------------------------- staleness overlay
