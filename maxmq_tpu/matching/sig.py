@@ -107,6 +107,25 @@ class HostExactGroup:
 
 
 @dataclass
+class HostPlusProbe:
+    """All '+'-shape groups of one exact depth, vectorized for the host
+    probe. A '+' filter (no trailing '#') is still an *exact-equality*
+    match — fixed depth, fixed literal positions — so each group costs
+    one hashed signature + one binary search per topic, the host's
+    natural strength. The device keeps only the '#'-prefix groups, whose
+    per-topic candidate count is genuinely combinatorial; this split cuts
+    device compare work ~4x on IoT corpora and is the transfer-optimal
+    boundary (candidates per topic, not rows, cross the link)."""
+
+    depth: int
+    coef: np.ndarray       # uint32[K, depth] multipliers (0 at '+' slots)
+    dc: np.ndarray         # uint32[K] depth-term addends (dc * depth)
+    wildf: np.ndarray      # bool[K] level-0 is '+': '$'-topic exclusion
+    sigs: list             # K SORTED uint32 signature arrays
+    rows: list             # K int32 row-id arrays aligned with sigs
+
+
+@dataclass
 class SigTables:
     """Compiled signature matcher + host-side decode tables."""
 
@@ -124,10 +143,13 @@ class SigTables:
     entries: list[Entry]
     vocab: dict[str, int]
     n_rows: int               # padded DEVICE row count (== 32 * words);
-                              # host-exact rows use ids >= n_rows
+                              # host-probed rows use ids >= n_rows
     max_depth: int            # deepest literal position device groups read
     host_exact: dict[int, HostExactGroup] = None   # depth -> group
     version: int = -1
+    host_plus: dict = None    # depth -> HostPlusProbe ('+'-shape groups)
+    probe_depth: int = 0      # deepest literal position ANY group reads
+                              # (device or host_plus) = tokenizer window
 
     def tokenize(self, topics: list[str], max_levels: int):
         return tokenize_cached(self, topics, max_levels)
@@ -198,13 +220,18 @@ def compile_sig_subscriptions(subs, version: int = 0,
             group_rows[key] = []
         group_rows[key].append(r)
 
-    # full-exact groups (no wildcard anywhere) leave the device: a topic of
-    # depth d can only hit the one exact group of depth d, matched on host
-    # with one vectorized searchsorted (see HostExactGroup)
+    # exact-shape groups (no trailing '#') leave the device: every one is
+    # an equality probe — full-literal groups via the per-depth esig
+    # searchsorted (HostExactGroup, one group can exist per depth), '+'
+    # groups via the per-(depth, shape) probe (HostPlusProbe). The device
+    # keeps only '#'-prefix groups, the combinatorial wildcard dimension.
     exact_keys = [k for k, g in group_map.items()
                   if not g.is_hash and len(g.kept) == g.depth]
     host_specs = {k: group_map.pop(k) for k in exact_keys}
     host_rows = {k: group_rows.pop(k) for k in exact_keys}
+    plus_keys = [k for k, g in group_map.items() if not g.is_hash]
+    plus_specs = {k: group_map.pop(k) for k in plus_keys}
+    plus_rows = {k: group_rows.pop(k) for k in plus_keys}
 
     groups = list(group_map.values())
     g_rows = [group_rows[k] for k in group_map]
@@ -272,6 +299,38 @@ def compile_sig_subscriptions(subs, version: int = 0,
         host_exact[d] = HostExactGroup(depth=d, spec=spec,
                                        sigs=s[order], rows=ids[order])
 
+    by_depth: dict[int, list] = {}
+    for key, spec in plus_specs.items():
+        by_depth.setdefault(spec.depth, []).append((spec, plus_rows[key]))
+    host_plus: dict[int, HostPlusProbe] = {}
+    for d, entries_d in by_depth.items():
+        k_n = len(entries_d)
+        coef = np.zeros((k_n, max(d, 1)), dtype=np.uint32)
+        dc = np.zeros(k_n, dtype=np.uint32)
+        wildf = np.zeros(k_n, dtype=bool)
+        sig_arrs, row_arrs = [], []
+        for k, (spec, rows) in enumerate(entries_d):
+            for c, pos in zip(spec.coef, spec.kept):
+                coef[k, pos] = c
+            with np.errstate(over="ignore"):
+                dc[k] = np.uint32(spec.depth_coef) * np.uint32(d)
+            wildf[k] = spec.wild_first
+            toks = np.zeros((len(rows), max(d, 1)), dtype=np.int32)
+            ids = np.empty(len(rows), dtype=np.int32)
+            for j, r in enumerate(rows):
+                levels = row_filt[r]
+                for pos in spec.kept:
+                    toks[j, pos] = vocab[levels[pos]]
+                ids[j] = len(row_entries)
+                row_entries.append(tuple(row_bits[r]))
+                row_levels.append(levels)
+            s = spec.signature(toks)
+            order = np.argsort(s, kind="stable")
+            sig_arrs.append(s[order])
+            row_arrs.append(ids[order])
+        host_plus[d] = HostPlusProbe(depth=d, coef=coef, dc=dc, wildf=wildf,
+                                     sigs=sig_arrs, rows=row_arrs)
+
     # deep filters (beyond max_levels) only match topics the tokenizer
     # flags as overflow; they live in rows past the device region too so
     # decode can still resolve them after a CPU fallback
@@ -281,7 +340,13 @@ def compile_sig_subscriptions(subs, version: int = 0,
         row_sig=row_sig, group_words=group_words,
         row_entries=row_entries, row_levels=row_levels,
         entries=builder.entries, vocab=vocab, n_rows=n_device_rows,
-        max_depth=max_depth, host_exact=host_exact, version=version)
+        max_depth=max_depth, host_exact=host_exact, version=version,
+        host_plus=host_plus,
+        # the tokenizer window must cover every literal position any
+        # probe reads: device '#' prefixes, '+' shapes AND full-exact
+        # depths (the unified native probe reads the narrow window)
+        probe_depth=max([max_depth] + [d for d in host_plus]
+                        + [d for d in host_exact]))
     tables.deep_rows = deep_rows
     return tables
 
@@ -308,11 +373,33 @@ def host_exact_rows(tables: SigTables, toks32: np.ndarray,
     return host_exact_rows_from_sig(tables, sigs, lengths)
 
 
+def _scatter_hits(out: list, ti_parts: list, row_parts: list) -> list:
+    """Distribute (topic-id, row-id) hit pairs into the per-topic list
+    with O(#hit-topics) python work: one argsort + np.split views instead
+    of a per-hit loop (the probes produce ~1 hit/topic at IoT scale, so
+    per-hit python would dominate the whole match)."""
+    if not ti_parts:
+        return out
+    ti = np.concatenate(ti_parts)
+    rw = np.concatenate(row_parts)
+    order = np.argsort(ti, kind="stable")
+    ti = ti[order]
+    rw = rw[order]
+    cuts = np.flatnonzero(ti[1:] != ti[:-1]) + 1
+    pieces = np.split(rw, cuts)
+    for t, piece in zip(ti[np.concatenate([[0], cuts])], pieces):
+        prev = out[t]
+        out[t] = piece if not len(prev) else np.concatenate([prev, piece])
+    return out
+
+
 def host_exact_rows_from_sig(tables: SigTables, esig: np.ndarray,
                              lengths: np.ndarray) -> list[np.ndarray]:
     """host_exact_rows when per-topic exact signatures are already computed
     (the C++ tokenizer emits them in its single pass)."""
     out: list[np.ndarray] = [_EMPTY_ROWS] * len(lengths)
+    ti_parts: list[np.ndarray] = []
+    row_parts: list[np.ndarray] = []
     for d, g in (tables.host_exact or {}).items():
         sel = np.nonzero(lengths == d)[0]
         if not sel.size:
@@ -326,12 +413,64 @@ def host_exact_rows_from_sig(tables: SigTables, esig: np.ndarray,
         if not hits.size:
             continue
         hi = np.searchsorted(g.sigs, sig[hits], side="right")
-        for j, h in zip(hits, hi):
-            out[sel[j]] = g.rows[lo[j]:h]
-    return out
+        lo = lo[hits]
+        single = hi - lo == 1                  # collided filters are rare
+        ti_parts.append(sel[hits[single]])
+        row_parts.append(g.rows[lo[single]])
+        for j, l0, h in zip(hits[~single], lo[~single], hi[~single]):
+            ti_parts.append(np.full(h - l0, sel[j], dtype=np.int64))
+            row_parts.append(g.rows[l0:h])
+    return _scatter_hits(out, ti_parts, row_parts)
 
 
 _EMPTY_ROWS = np.zeros(0, dtype=np.int32)
+
+
+def host_plus_rows(tables: SigTables, toks: np.ndarray, lengths: np.ndarray,
+                   dollar: np.ndarray,
+                   into: list | None = None) -> list:
+    """Vectorized '+'-shape probe: for each topic, candidate rows among
+    the host-resident '+' groups of its depth (per group: one uint32
+    signature + one searchsorted; collisions verified in decode like
+    every other candidate). ``toks`` may be any integer dtype — unknown
+    -token padding just yields a non-matching signature, exactly as on
+    device. Appends into ``into`` (per-topic arrays) when given."""
+    out: list = [_EMPTY_ROWS] * len(lengths) if into is None else into
+    width = toks.shape[1]
+    ti_parts: list[np.ndarray] = []
+    row_parts: list[np.ndarray] = []
+    for d, p in (tables.host_plus or {}).items():
+        if d > width:
+            # deeper '+' groups only match topics the tokenizer flagged
+            # as overflow -> served by the CPU fallback
+            continue
+        sel = np.nonzero(lengths == d)[0]
+        if not sel.size:
+            continue
+        t = toks[sel, :max(d, 1)].astype(np.uint32)
+        with np.errstate(over="ignore"):
+            sig_all = t @ p.coef.T + p.dc[None, :]       # [n, K] wrapping
+        dol = dollar[sel]
+        for k in range(len(p.sigs)):
+            sigs_k, rows_k = p.sigs[k], p.rows[k]
+            sig = sig_all[:, k]
+            lo = np.searchsorted(sigs_k, sig, side="left")
+            ok = (lo < len(sigs_k)) & (sigs_k[
+                np.minimum(lo, len(sigs_k) - 1)] == sig)
+            if p.wildf[k]:
+                ok &= ~dol                # [MQTT-4.7.1-1] '$' exclusion
+            hits = np.nonzero(ok)[0]
+            if not hits.size:
+                continue
+            hi = np.searchsorted(sigs_k, sig[hits], side="right")
+            lo = lo[hits]
+            single = hi - lo == 1          # collided filters are rare
+            ti_parts.append(sel[hits[single]])
+            row_parts.append(rows_k[lo[single]])
+            for j, l0, h in zip(hits[~single], lo[~single], hi[~single]):
+                ti_parts.append(np.full(h - l0, sel[j], dtype=np.int64))
+                row_parts.append(rows_k[l0:h])
+    return _scatter_hits(out, ti_parts, row_parts)
 
 
 def topic_signatures(consts, toks, lengths):
@@ -622,7 +761,7 @@ def tokenize_compact(tables, topics: list[str], window: int | None = None):
     numpy path; prepare_batch uses the one-pass C++ tokenizer when built.
     """
     if window is None:
-        window = max(tables.max_depth, 1)
+        window = max(tables.probe_depth, 1)
     toks32, lengths, dollar = tokenize_topics(tables.vocab, topics,
                                               DEPTH_CAP)
     dtype, pad = _compact_dtype(tables)
@@ -644,7 +783,7 @@ def prepare_batch_sig(tables, topics: list[str], window: int | None = None,
     deterministic functions of the group shape, so one signature per depth
     serves every shard)."""
     if window is None:
-        window = max(tables.max_depth, 1)
+        window = max(tables.probe_depth, 1)
     if host_exact is None:
         host_exact = tables.host_exact or {}
     ns = tables.__dict__.get("_native_sig", False)
@@ -676,11 +815,65 @@ def prepare_batch_sig(tables, topics: list[str], window: int | None = None,
     return toks, lens_enc, esig, lengths
 
 
+class HostRows:
+    """CSR view of the host probe's per-topic candidate rows: O(1) python
+    work per batch instead of one list entry per topic. Supports the same
+    consumer surface as a list of per-topic arrays (index, iterate, and
+    the `[:batch]` trim the sharded engine uses)."""
+
+    __slots__ = ("offsets", "rows")
+
+    def __init__(self, offsets: np.ndarray, rows: np.ndarray) -> None:
+        self.offsets = offsets        # int64[n + 1]
+        self.rows = rows              # int32[total hits]
+
+    @classmethod
+    def from_hits(cls, n: int, ti: np.ndarray, rows: np.ndarray
+                  ) -> "HostRows":
+        counts = np.bincount(ti, minlength=n)
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(counts, out=offsets[1:])
+        return cls(offsets, rows)
+
+    def __len__(self) -> int:
+        return len(self.offsets) - 1
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            assert i.start is None and i.step is None
+            k = min(i.stop if i.stop is not None else len(self), len(self))
+            return HostRows(self.offsets[:k + 1],
+                            self.rows[:self.offsets[k]])
+        return self.rows[self.offsets[i]:self.offsets[i + 1]]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self.rows[self.offsets[i]:self.offsets[i + 1]]
+
+
 def prepare_batch(tables, topics: list[str]):
     """Full host half for the compact/fixed paths: (toks, lens_enc,
-    hostrows)."""
+    hostrows). hostrows unions the full-exact esig probe and the
+    '+'-shape probe — everything the device no longer carries. The C++
+    threaded probe serves both when built; numpy otherwise."""
     toks, lens_enc, esig, lengths = prepare_batch_sig(tables, topics)
-    return toks, lens_enc, host_exact_rows_from_sig(tables, esig, lengths)
+    np_probe = tables.__dict__.get("_native_probe", False)
+    if np_probe is False:
+        np_probe = None
+        try:
+            from ..native import NativeProbe, available
+            if available():
+                np_probe = NativeProbe(tables.host_exact or {},
+                                       tables.host_plus or {})
+        except Exception:
+            np_probe = None
+        tables.__dict__["_native_probe"] = np_probe
+    if np_probe is not None:
+        ti, rw = np_probe.run(np.ascontiguousarray(toks), lens_enc)
+        return toks, lens_enc, HostRows.from_hits(len(topics), ti, rw)
+    hostrows = host_exact_rows_from_sig(tables, esig, lengths)
+    host_plus_rows(tables, toks, lengths, lens_enc < 0, into=hostrows)
+    return toks, lens_enc, hostrows
 
 
 class Overlay:
@@ -961,6 +1154,8 @@ class SigEngine(OverlayedEngine):
         word_idx, word_val, overflow = fn(
             jnp.asarray(toks), jnp.asarray(lengths), jnp.asarray(dollar))
         hostrows = host_exact_rows(tables, toks, lengths)
+        host_plus_rows(tables, toks, lengths, np.asarray(dollar),
+                       into=hostrows)
         return (np.asarray(word_idx), np.asarray(word_val),
                 np.asarray(overflow), hostrows, tables)
 
@@ -982,7 +1177,9 @@ class SigEngine(OverlayedEngine):
             toks.append(t)
             lengths.append(ln)
             dollar.append(d)
-            hostrows.append(host_exact_rows(tables, t, ln))
+            hr = host_exact_rows(tables, t, ln)
+            host_plus_rows(tables, t, ln, np.asarray(d), into=hr)
+            hostrows.append(hr)
         word_idx, word_val, overflow = fn_many(
             jnp.asarray(np.stack(toks)), jnp.asarray(np.stack(lengths)),
             jnp.asarray(np.stack(dollar)))

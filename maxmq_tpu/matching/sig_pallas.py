@@ -62,7 +62,9 @@ from jax.experimental import pallas as pl
 from .sig import SigTables, adjusted_signatures
 
 LANE = 128
-CHUNK_WORDS = 2048               # word columns per chunk kernel
+CHUNK_WORDS = 2048               # word columns per chunk kernel (2048 at
+                                 # tb=128 empirically beats wider chunks
+                                 # at smaller tb on v5e)
 VMEM_BUDGET = 10 * 1024 * 1024   # soft per-call budget (VMEM ~16MB/core)
 WORK_BUFS = 8                    # live [tb, chunk] buffers at peak
 
@@ -100,11 +102,13 @@ def plan(tables: SigTables) -> dict | None:
             "chunk": chunk, "n_chunks": n_chunks, "tb": tb}
 
 
-def _chunk_kernel(lo_ref, hi_ref, flag_ref, onehot_ref, planes_ref, out_ref,
-                  *, max_rows: int, word_base: int):
-    """One word-chunk: [TB, Gp] signatures -> [TB, 1 + max_rows] candidate
-    slots (col 0 = count, 0xF = overflow; cols 1.. = GLOBAL row encodings
-    ascending, 0xFFFFFFFF-filled)."""
+SELECT_EXPAND_MAX = 40   # group count below which the select expansion
+                         # beats the one-hot MXU matmul (K = G keeps the
+                         # systolic array almost idle at small G)
+
+
+def _expand_mxu(lo_ref, hi_ref, onehot_ref):
+    """[TB, Gp] split signatures -> [TB, C] expanded via one-hot matmul."""
     lo = lo_ref[:]                                      # [TB, Gp] f32
     hi = hi_ref[:]
     # HIGHEST precision: default MXU f32 runs bf16 passes whose 8-bit
@@ -117,8 +121,27 @@ def _chunk_kernel(lo_ref, hi_ref, flag_ref, onehot_ref, planes_ref, out_ref,
     # is exact and the u32 reinterpret free
     exp_lo32 = exp_lo.astype(jnp.int32).astype(jnp.uint32)
     exp_hi32 = exp_hi.astype(jnp.int32).astype(jnp.uint32)
-    sig_exp = (exp_hi32 << 16) | exp_lo32
+    return (exp_hi32 << 16) | exp_lo32
 
+
+def _expand_select(sig_ref, grp_ref, n_groups: int):
+    """[TB, Gp] signatures -> [TB, C] via per-group masked selects.
+
+    With the '+'-shapes probed on host the device typically holds only a
+    handful of '#'-prefix groups, so G compare+selects on the VPU are far
+    cheaper than an almost-empty MXU pass ([TB, G] x [G, C] at G ~ 8 uses
+    a few percent of the systolic array)."""
+    sig = sig_ref[:]                                     # [TB, Gp] u32
+    grp = grp_ref[0][None, :]                            # [1, C] int32
+    sig_exp = jnp.zeros((sig.shape[0], grp.shape[1]), dtype=jnp.uint32)
+    for g in range(n_groups):
+        sig_exp = jnp.where(grp == g, sig[:, g][:, None], sig_exp)
+    return sig_exp
+
+
+def _match_tail(sig_exp, flag_ref, planes_ref, out_ref, max_rows: int,
+                word_base: int):
+    """Shared compare + extract tail of both chunk kernels."""
     acc = jnp.zeros_like(sig_exp)
     for j in range(32):
         acc = acc | ((sig_exp == planes_ref[j][None, :]).astype(jnp.uint32)
@@ -155,8 +178,22 @@ def _chunk_kernel(lo_ref, hi_ref, flag_ref, onehot_ref, planes_ref, out_ref,
     out_ref[:] = jnp.stack(out, axis=1)
 
 
-def _run_chunk(kern, lo, hi, flag, onehot_c, planes_c, tb, g_pad, chunk,
-               max_rows, interpret):
+def _chunk_kernel_mxu(lo_ref, hi_ref, flag_ref, onehot_ref, planes_ref,
+                      out_ref, *, max_rows: int, word_base: int):
+    """One word-chunk via the one-hot MXU expansion (large group counts)."""
+    _match_tail(_expand_mxu(lo_ref, hi_ref, onehot_ref), flag_ref,
+                planes_ref, out_ref, max_rows, word_base)
+
+
+def _chunk_kernel_select(sig_ref, flag_ref, grp_ref, planes_ref, out_ref,
+                         *, max_rows: int, word_base: int, n_groups: int):
+    """One word-chunk via masked-select expansion (small group counts)."""
+    _match_tail(_expand_select(sig_ref, grp_ref, n_groups), flag_ref,
+                planes_ref, out_ref, max_rows, word_base)
+
+
+def _run_chunk_mxu(kern, lo, hi, flag, onehot_c, planes_c, tb, g_pad, chunk,
+                   max_rows, interpret):
     nb = lo.shape[0] // tb
     return pl.pallas_call(
         kern,
@@ -174,6 +211,24 @@ def _run_chunk(kern, lo, hi, flag, onehot_c, planes_c, tb, g_pad, chunk,
     )(lo, hi, flag, onehot_c, planes_c)
 
 
+def _run_chunk_select(kern, sig, flag, grp_c, planes_c, tb, g_pad, chunk,
+                      max_rows, interpret):
+    nb = sig.shape[0] // tb
+    return pl.pallas_call(
+        kern,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((tb, g_pad), lambda i: (i, 0)),
+            pl.BlockSpec((tb, 1), lambda i: (i, 0)),
+            pl.BlockSpec((1, chunk), lambda i: (0, 0)),
+            pl.BlockSpec((32, chunk), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((tb, 1 + max_rows), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((nb * tb, 1 + max_rows), jnp.uint32),
+        interpret=interpret,
+    )(sig, flag, grp_c, planes_c)
+
+
 def build_fixed_fn(tables: SigTables, consts: dict, kplan: dict,
                    max_rows: int, fmt16: bool):
     """jit(toks8, lens_enc) -> packed fixed slots, via the fused chunk
@@ -188,28 +243,43 @@ def build_fixed_fn(tables: SigTables, consts: dict, kplan: dict,
     n_words = kplan["n_words"]
 
     # constants padded to the full chunk grid (n_chunks * chunk >= w_pad):
-    # every BlockSpec-visible column must carry the poison scheme (zero
-    # one-hot => sig_exp 0, plane 0xFFFFFFFF => never equal), so the last
-    # chunk's padding can never produce phantom match bits
+    # every BlockSpec-visible column must carry the poison scheme (no
+    # group / zero one-hot => sig_exp 0, plane 0xFFFFFFFF => never
+    # equal), so the last chunk's padding can never produce phantom bits
     w_full = n_chunks * chunk
-    onehot = np.zeros((g_pad, w_full), dtype=np.float32)
+    n_groups = len(tables.groups)
+    select_expand = n_groups <= SELECT_EXPAND_MAX
     grp_sizes = [int(w) for w in tables.group_words]
+    onehot = np.zeros((g_pad, w_full), dtype=np.float32)
+    grp_of_word = np.full((1, w_full), -1, dtype=np.int32)
     w0 = 0
     for g, w in enumerate(grp_sizes):
         onehot[g, w0:w0 + w] = 1.0
+        grp_of_word[0, w0:w0 + w] = g
         w0 += w
     planes = np.full((32, w_full), 0xFFFFFFFF, dtype=np.uint32)
     if tables.n_rows:
         planes[:, :n_words] = tables.row_sig.reshape(n_words, 32).T
-    onehot_c = [jax.device_put(jnp.asarray(
-        onehot[:, c * chunk:(c + 1) * chunk])) for c in range(n_chunks)]
+    if select_expand:
+        expand_c = [jax.device_put(jnp.asarray(
+            grp_of_word[:, c * chunk:(c + 1) * chunk]))
+            for c in range(n_chunks)]
+    else:
+        expand_c = [jax.device_put(jnp.asarray(
+            onehot[:, c * chunk:(c + 1) * chunk])) for c in range(n_chunks)]
     planes_c = [jax.device_put(jnp.asarray(
         planes[:, c * chunk:(c + 1) * chunk])) for c in range(n_chunks)]
 
     # CPU backend (tests) runs the kernel in the Pallas interpreter
     interpret = jax.default_backend() != "tpu"
-    kerns = [functools.partial(_chunk_kernel, max_rows=max_rows,
-                               word_base=c * chunk) for c in range(n_chunks)]
+    if select_expand:
+        kerns = [functools.partial(_chunk_kernel_select, max_rows=max_rows,
+                                   word_base=c * chunk, n_groups=n_groups)
+                 for c in range(n_chunks)]
+    else:
+        kerns = [functools.partial(_chunk_kernel_mxu, max_rows=max_rows,
+                                   word_base=c * chunk)
+                 for c in range(n_chunks)]
 
     @jax.jit
     def fn(toks8, lens_enc):
@@ -221,19 +291,25 @@ def build_fixed_fn(tables: SigTables, consts: dict, kplan: dict,
         pad_g = g_pad - sig_adj.shape[1]
         if pad_g:
             sig_adj = jnp.pad(sig_adj, ((0, 0), (0, pad_g)))
-        lo = (sig_adj & jnp.uint32(0xFFFF)).astype(jnp.float32)
-        hi = (sig_adj >> jnp.uint32(16)).astype(jnp.float32)
         flag = (lengths >= 127).astype(jnp.int32)[:, None]
 
         pad_b = (-batch) % tb
         if pad_b:
-            lo = jnp.pad(lo, ((0, pad_b), (0, 0)))
-            hi = jnp.pad(hi, ((0, pad_b), (0, 0)))
+            sig_adj = jnp.pad(sig_adj, ((0, pad_b), (0, 0)))
             flag = jnp.pad(flag, ((0, pad_b), (0, 0)))
 
-        outs = [_run_chunk(kerns[c], lo, hi, flag, onehot_c[c], planes_c[c],
-                           tb, g_pad, chunk, max_rows, interpret)
-                for c in range(n_chunks)]
+        if select_expand:
+            outs = [_run_chunk_select(kerns[c], sig_adj, flag, expand_c[c],
+                                      planes_c[c], tb, g_pad, chunk,
+                                      max_rows, interpret)
+                    for c in range(n_chunks)]
+        else:
+            lo = (sig_adj & jnp.uint32(0xFFFF)).astype(jnp.float32)
+            hi = (sig_adj >> jnp.uint32(16)).astype(jnp.float32)
+            outs = [_run_chunk_mxu(kerns[c], lo, hi, flag, expand_c[c],
+                                   planes_c[c], tb, g_pad, chunk, max_rows,
+                                   interpret)
+                    for c in range(n_chunks)]
 
         if n_chunks == 1:
             cnt0 = outs[0][:, 0]
@@ -247,7 +323,16 @@ def build_fixed_fn(tables: SigTables, consts: dict, kplan: dict,
                                cnts.astype(jnp.int32)).sum(axis=1)
             overflow = overflow | (counts > max_rows)
             cand = jnp.concatenate([o[:, 1:] for o in outs], axis=1)
-            rows_sorted = jnp.sort(cand, axis=1)[:, :max_rows]
+            # merge-by-min-extract: per-chunk slots are already sorted
+            # and the concat is narrow (NC * max_rows), so max_rows
+            # min+mask passes beat a full XLA sort
+            merged = []
+            for _ in range(max_rows):
+                m = cand.min(axis=1)
+                merged.append(m)
+                cand = jnp.where(cand == m[:, None],
+                                 jnp.uint32(0xFFFFFFFF), cand)
+            rows_sorted = jnp.stack(merged, axis=1)
 
         cnt = jnp.where(overflow, jnp.uint32(0xF),
                         jnp.minimum(counts, max_rows).astype(jnp.uint32))

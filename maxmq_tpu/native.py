@@ -84,6 +84,22 @@ def _try_load():
             ctypes.c_void_p,
             np.ctypeslib.ndpointer(np.int8),
             np.ctypeslib.ndpointer(np.uint32)]
+        lib.mq_probe_new.restype = ctypes.c_void_p
+        lib.mq_probe_free.argtypes = [ctypes.c_void_p]
+        lib.mq_probe_add_group.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_uint8,
+            ctypes.c_uint32,
+            np.ctypeslib.ndpointer(np.uint32),
+            np.ctypeslib.ndpointer(np.uint32),
+            np.ctypeslib.ndpointer(np.int32), ctypes.c_int64]
+        lib.mq_probe_run.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+            np.ctypeslib.ndpointer(np.int8), ctypes.c_int64,
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.int64),
+            np.ctypeslib.ndpointer(np.int32), ctypes.c_int64,
+            ctypes.c_int32]
+        lib.mq_probe_run.restype = ctypes.c_int64
         _lib = lib
         return _lib
 
@@ -163,6 +179,61 @@ def tokenize_sig(vocab: "NativeVocab", topics: list[str], window: int,
                         exact.coef.shape[1] if exact.max_d else 0,
                         toks.ctypes.data_as(ctypes.c_void_p), lens, esig)
     return toks, lens, esig
+
+
+class NativeProbe:
+    """C++ host probe over every exact-shape group (full-literal +
+    '+'-shape): one hashed signature + binary search per (topic, group
+    of the topic's depth), threaded over topic ranges. Built once per
+    compiled-table snapshot from tables.host_exact / tables.host_plus."""
+
+    def __init__(self, host_exact: dict, host_plus: dict) -> None:
+        lib = _try_load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._handle = ctypes.c_void_p(lib.mq_probe_new())
+        for d, g in (host_exact or {}).items():
+            coef = np.zeros(max(d, 1), dtype=np.uint32)
+            for c, pos in zip(g.spec.coef, g.spec.kept):
+                coef[pos] = c
+            with np.errstate(over="ignore"):
+                dc = int(np.uint32(g.spec.depth_coef) * np.uint32(d))
+            lib.mq_probe_add_group(
+                self._handle, d, 0, dc, coef,
+                np.ascontiguousarray(g.sigs, dtype=np.uint32),
+                np.ascontiguousarray(g.rows, dtype=np.int32), len(g.sigs))
+        for d, p in (host_plus or {}).items():
+            for k in range(len(p.sigs)):
+                lib.mq_probe_add_group(
+                    self._handle, d, int(bool(p.wildf[k])), int(p.dc[k]),
+                    np.ascontiguousarray(p.coef[k], dtype=np.uint32),
+                    np.ascontiguousarray(p.sigs[k], dtype=np.uint32),
+                    np.ascontiguousarray(p.rows[k], dtype=np.int32),
+                    len(p.sigs[k]))
+
+    def __del__(self):
+        handle, self._handle = getattr(self, "_handle", None), None
+        if handle and getattr(self, "_lib", None) is not None:
+            self._lib.mq_probe_free(handle)
+
+    def run(self, toks: np.ndarray, lens_enc: np.ndarray
+            ) -> tuple[np.ndarray, np.ndarray]:
+        """(topic ids int64[M], row ids int32[M]) hit pairs, topic-sorted.
+        ``toks`` is the narrow [n, window] token matrix of any of the
+        compact dtypes."""
+        n, window = toks.shape
+        mode = {1: 1, 2: 2, 4: 4}[toks.dtype.itemsize]
+        cap = max(4 * n, 1024)
+        while True:
+            ti = np.empty(cap, dtype=np.int64)
+            rw = np.empty(cap, dtype=np.int32)
+            total = self._lib.mq_probe_run(
+                self._handle, toks.ctypes.data_as(ctypes.c_void_p), mode,
+                lens_enc, n, window, ti, rw, cap, 0)
+            if total <= cap:
+                return ti[:total], rw[:total]
+            cap = int(total)
 
 
 class MalformedFrame(ValueError):

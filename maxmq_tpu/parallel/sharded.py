@@ -177,7 +177,7 @@ class ShardedSigEngine(OverlayedEngine):
 
             # pad per-shard tables to common shapes and stack on 'subs'
             g_max = max(max(len(t.groups), 1) for t in shards)
-            d_max = max(max(t.max_depth, 1) for t in shards)
+            d_max = max(max(t.probe_depth, 1) for t in shards)
             w_max = max(max(int(t.group_words.sum()), 1) for t in shards)
 
             topo = np.zeros((self.sp, g_max, d_max), dtype=np.uint32)
@@ -229,7 +229,7 @@ class ShardedSigEngine(OverlayedEngine):
         """Sharded device match. Returns (out uint32[sp, B, 1+max_rows],
         hostrows list[sp][B], shards), batch-trimmed."""
         from ..matching.sig import (host_exact_rows_from_sig,
-                                    prepare_batch_sig)
+                                    host_plus_rows, prepare_batch_sig)
 
         self.refresh_soon()
         _version, shards, dev, fn, d_max, union_exact = self._state
@@ -242,13 +242,17 @@ class ShardedSigEngine(OverlayedEngine):
         padded = -(-batch // self.dp) * self.dp
         padded_topics = topics + ["\x01pad"] * (padded - batch)
         # shared intern pool => identical tokens for every shard; one host
-        # tokenize pass serves every shard's exact probe
+        # tokenize pass serves every shard's exact + '+'-shape probes
         toks, lens_enc, esig, lengths = prepare_batch_sig(
             shards[0], padded_topics, window=max(d_max, 1),
             host_exact=union_exact)
         out = fn(dev, jnp.asarray(toks), jnp.asarray(lens_enc))
-        hostrows = [host_exact_rows_from_sig(t, esig, lengths)
-                    for t in shards]
+        dollar = lens_enc < 0
+        hostrows = []
+        for t in shards:
+            hr = host_exact_rows_from_sig(t, esig, lengths)
+            host_plus_rows(t, toks, lengths, dollar, into=hr)
+            hostrows.append(hr)
         return np.asarray(out)[:, :batch], \
             [h[:batch] for h in hostrows], shards
 

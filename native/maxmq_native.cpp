@@ -18,17 +18,47 @@
 // Go); these are the TPU build's native equivalents for its zero-alloc hot
 // paths (vendor/github.com/mochi-co/mqtt/v2/packets/codec.go:15-19).
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <string>
 #include <string_view>
+#include <thread>
 #include <unordered_map>
+#include <vector>
 
 namespace {
 
 struct Vocab {
   std::unordered_map<std::string, int32_t> map;
 };
+
+// One exact-shape signature group for the host probe: topics of exactly
+// `depth` levels match a row iff the hashed signature over the group's
+// literal positions equals the row's (collisions are re-verified in the
+// Python decode, mirroring maxmq_tpu/matching/sig.py:HostPlusProbe).
+struct ProbeGroup {
+  int32_t depth;
+  bool wildf;                   // level 0 is '+': excluded for '$'-topics
+  uint32_t dc;                  // depth-term addend (depth_coef * depth)
+  std::vector<uint32_t> coef;   // [depth] multipliers, 0 at '+' positions
+  std::vector<uint32_t> sigs;   // SORTED row signatures
+  std::vector<int32_t> rows;    // row ids aligned with sigs
+};
+
+struct ProbeSet {
+  std::vector<ProbeGroup> groups;
+  std::vector<std::vector<int32_t>> by_depth;  // depth -> group indices
+};
+
+inline uint32_t tok_at(const void* toks, int32_t mode, int64_t idx) {
+  switch (mode) {
+    case 1: return static_cast<const uint8_t*>(toks)[idx];
+    case 2: return static_cast<const uint16_t*>(toks)[idx];
+    default:
+      return static_cast<uint32_t>(static_cast<const int32_t*>(toks)[idx]);
+  }
+}
 
 }  // namespace
 
@@ -208,6 +238,101 @@ void mq_tokenize_sig(void* v, const char* buf, int64_t buf_len,
     topic_start = end + 1;
     ++i;
   }
+}
+
+// ---------------------------------------------------------------------
+// Host probe: every exact-shape filter group (full-literal and '+') as a
+// hashed-equality binary search. The device keeps only '#'-prefix groups;
+// this is the host half of the transfer-optimal split
+// (maxmq_tpu/matching/sig.py:host_plus_rows is the numpy twin).
+
+void* mq_probe_new() { return new ProbeSet(); }
+
+void mq_probe_free(void* h) { delete static_cast<ProbeSet*>(h); }
+
+void mq_probe_add_group(void* h, int32_t depth, uint8_t wildf, uint32_t dc,
+                        const uint32_t* coef, const uint32_t* sigs,
+                        const int32_t* rows, int64_t n) {
+  auto* set = static_cast<ProbeSet*>(h);
+  ProbeGroup g;
+  g.depth = depth;
+  g.wildf = wildf != 0;
+  g.dc = dc;
+  g.coef.assign(coef, coef + depth);
+  g.sigs.assign(sigs, sigs + n);
+  g.rows.assign(rows, rows + n);
+  if (static_cast<size_t>(depth) >= set->by_depth.size())
+    set->by_depth.resize(depth + 1);
+  set->by_depth[depth].push_back(static_cast<int32_t>(set->groups.size()));
+  set->groups.push_back(std::move(g));
+}
+
+// Probe n topics (narrow tokens as in mq_tokenize_sig: tok_mode 1/2/4,
+// row-major [n, window]; lens_enc int8 sign='$' |v|=depth, 127=overflow).
+// Emits (topic id, row id) hit pairs in topic order. Returns the total
+// hit count; pairs beyond `cap` are not written (the caller re-invokes
+// with a larger buffer — hits average ~1/topic, so this is rare).
+int64_t mq_probe_run(void* h, const void* toks, int32_t tok_mode,
+                     const int8_t* lens_enc, int64_t n, int64_t window,
+                     int64_t* out_ti, int32_t* out_row, int64_t cap,
+                     int32_t n_threads) {
+  const auto* set = static_cast<ProbeSet*>(h);
+  if (n_threads <= 0) {
+    n_threads = static_cast<int32_t>(std::thread::hardware_concurrency());
+    if (n_threads <= 0) n_threads = 1;
+    if (n_threads > 8) n_threads = 8;
+  }
+  if (n < 4096) n_threads = 1;
+
+  std::vector<std::vector<int64_t>> ti(n_threads);
+  std::vector<std::vector<int32_t>> rw(n_threads);
+  auto worker = [&](int32_t t) {
+    const int64_t lo = n * t / n_threads;
+    const int64_t hi = n * (t + 1) / n_threads;
+    auto& ti_t = ti[t];
+    auto& rw_t = rw[t];
+    for (int64_t i = lo; i < hi; ++i) {
+      const int8_t le = lens_enc[i];
+      const bool dollar = le < 0;
+      const int32_t depth = le < 0 ? -le : le;
+      if (depth >= 127 ||
+          static_cast<size_t>(depth) >= set->by_depth.size())
+        continue;  // overflow topics go to the CPU-trie fallback
+      for (const int32_t gi : set->by_depth[depth]) {
+        const ProbeGroup& g = set->groups[gi];
+        if ((g.wildf && dollar) || g.depth > window) continue;
+        uint32_t sig = g.dc;
+        const int64_t base = i * window;
+        for (int32_t p = 0; p < g.depth; ++p)
+          sig += g.coef[p] * tok_at(toks, tok_mode, base + p);
+        auto it = std::lower_bound(g.sigs.begin(), g.sigs.end(), sig);
+        for (; it != g.sigs.end() && *it == sig; ++it) {
+          ti_t.push_back(i);
+          rw_t.push_back(g.rows[it - g.sigs.begin()]);
+        }
+      }
+    }
+  };
+  if (n_threads == 1) {
+    worker(0);
+  } else {
+    std::vector<std::thread> threads;
+    threads.reserve(n_threads);
+    for (int32_t t = 0; t < n_threads; ++t) threads.emplace_back(worker, t);
+    for (auto& th : threads) th.join();
+  }
+
+  int64_t total = 0;
+  for (const auto& v : ti) total += static_cast<int64_t>(v.size());
+  if (total <= cap) {
+    int64_t off = 0;
+    for (int32_t t = 0; t < n_threads; ++t) {
+      std::copy(ti[t].begin(), ti[t].end(), out_ti + off);
+      std::copy(rw[t].begin(), rw[t].end(), out_row + off);
+      off += static_cast<int64_t>(ti[t].size());
+    }
+  }
+  return total;
 }
 
 // Scan `buf` (len bytes) for complete MQTT control-packet frames.

@@ -178,6 +178,8 @@ def test_tokenize_sig_parity_with_python():
 
     toks_py, lens_py, toks32, lengths = tokenize_compact(tables, topics)
     hr_py = host_exact_rows(tables, toks32, lengths)
+    from maxmq_tpu.matching.sig import host_plus_rows
+    host_plus_rows(tables, toks_py, lengths, lens_py < 0, into=hr_py)
 
     toks_n, lens_n, hr_n = prepare_batch(tables, topics)
     assert toks_n.dtype == toks_py.dtype

@@ -90,15 +90,20 @@ def test_overlap_merge_semantics():
 
 
 def test_exact_rows_match_on_host():
-    # full-exact filters never occupy device table width
+    # exact-shape filters (full-literal AND '+') never occupy device
+    # table width: both are host equality probes; the device carries
+    # only the combinatorial '#'-prefix groups
     idx = TopicIndex()
     idx.subscribe("c1", Subscription(filter="a/b/c"))
     idx.subscribe("c2", Subscription(filter="a/b/d"))
     idx.subscribe("c3", Subscription(filter="a/+/c"))
+    idx.subscribe("c4", Subscription(filter="a/b/#"))
     engine = check_parity(idx, ["a/b/c", "a/b/d", "a/b", "a/b/c/d"])
     t = engine.tables
     assert sum(len(g.rows) for g in t.host_exact.values()) == 2
-    # device rows: only the '+' filter (one group, one padded word)
+    assert sum(len(r) for p in t.host_plus.values()
+               for r in p.rows) == 1
+    # device rows: only the '#' filter (one group, one padded word)
     assert int(t.group_words.sum()) == 1
 
 
@@ -215,9 +220,11 @@ def test_pathological_group_count_falls_back_to_trie(monkeypatch):
     import maxmq_tpu.matching.sig as sigmod
     monkeypatch.setattr(sigmod, "MAX_GROUPS", 2)
     idx = TopicIndex()
-    idx.subscribe("c1", Subscription(filter="a/+/c"))
-    idx.subscribe("c2", Subscription(filter="+/b/c"))
-    idx.subscribe("c3", Subscription(filter="a/b/+/d"))
+    # only '#'-prefix shapes occupy device groups now; three distinct
+    # ones exceed the patched limit
+    idx.subscribe("c1", Subscription(filter="a/+/#"))
+    idx.subscribe("c2", Subscription(filter="+/b/#"))
+    idx.subscribe("c3", Subscription(filter="a/b/c/#"))
     idx.subscribe("c4", Subscription(filter="x/#"))
     engine = SigEngine(idx)
     for path in PATHS:
@@ -227,7 +234,7 @@ def test_pathological_group_count_falls_back_to_trie(monkeypatch):
     with pytest.raises(RuntimeError):
         engine.match_fixed(["a/b/c"])
     # corpus shrinks below the limit -> device path resumes
-    idx.unsubscribe("c3", "a/b/+/d")
+    idx.unsubscribe("c3", "a/b/c/#")
     idx.unsubscribe("c4", "x/#")
     monkeypatch.setattr(sigmod, "MAX_GROUPS", 4096)
     engine.refresh()
@@ -247,9 +254,12 @@ def test_pallas_multi_chunk_parity(monkeypatch):
         depth = rng.randint(2, 6)
         levels = [rng.choice(segs) for _ in range(depth)]
         r = rng.random()
-        if r < 0.4:
+        if r < 0.15:
             levels[rng.randrange(depth)] = "+"
-        elif r < 0.6:
+        elif r < 0.8:
+            # mostly '#' shapes: only those occupy device words now
+            if rng.random() < 0.5:
+                levels[rng.randrange(depth)] = "+"
             levels = levels[:rng.randint(1, depth)] + ["#"]
         idx.subscribe(f"c{i}", Subscription(filter="/".join(levels),
                                             qos=i % 3))
