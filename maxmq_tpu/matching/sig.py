@@ -851,26 +851,42 @@ class HostRows:
             yield self.rows[self.offsets[i]:self.offsets[i + 1]]
 
 
+def _native_fused(tables):
+    """(NativeVocab, NativeProbe) pair for the fused single-pass host
+    half, or None. Cached per compiled-table snapshot."""
+    fused = tables.__dict__.get("_native_fused", False)
+    if fused is not False:
+        return fused
+    fused = None
+    try:
+        from ..native import NativeProbe, NativeVocab, available
+        if available():
+            nv = tables.__dict__.get("_native_vocab") or \
+                NativeVocab(tables.vocab)
+            tables.__dict__.setdefault("_native_vocab", nv)
+            fused = (nv, NativeProbe(tables.host_exact or {},
+                                     tables.host_plus or {}))
+    except Exception:
+        fused = None
+    tables.__dict__["_native_fused"] = fused
+    return fused
+
+
 def prepare_batch(tables, topics: list[str]):
     """Full host half for the compact/fixed paths: (toks, lens_enc,
     hostrows). hostrows unions the full-exact esig probe and the
-    '+'-shape probe — everything the device no longer carries. The C++
-    threaded probe serves both when built; numpy otherwise."""
-    toks, lens_enc, esig, lengths = prepare_batch_sig(tables, topics)
-    np_probe = tables.__dict__.get("_native_probe", False)
-    if np_probe is False:
-        np_probe = None
-        try:
-            from ..native import NativeProbe, available
-            if available():
-                np_probe = NativeProbe(tables.host_exact or {},
-                                       tables.host_plus or {})
-        except Exception:
-            np_probe = None
-        tables.__dict__["_native_probe"] = np_probe
-    if np_probe is not None:
-        ti, rw = np_probe.run(np.ascontiguousarray(toks), lens_enc)
+    '+'-shape probe — everything the device no longer carries. One fused
+    C++ pass (tokenize + probe with the level tokens in registers) when
+    the native runtime is built; numpy otherwise."""
+    fused = _native_fused(tables)
+    if fused is not None:
+        from ..native import tokenize_probe
+        dtype, _pad = _compact_dtype(tables)
+        window = max(tables.probe_depth, 1)
+        toks, lens_enc, ti, rw = tokenize_probe(fused[0], fused[1], topics,
+                                                window, dtype)
         return toks, lens_enc, HostRows.from_hits(len(topics), ti, rw)
+    toks, lens_enc, esig, lengths = prepare_batch_sig(tables, topics)
     hostrows = host_exact_rows_from_sig(tables, esig, lengths)
     host_plus_rows(tables, toks, lengths, lens_enc < 0, into=hostrows)
     return toks, lens_enc, hostrows
@@ -1106,6 +1122,7 @@ class SigEngine(OverlayedEngine):
 
             sb, kr = self.fixed_sel_blocks, self.fixed_max_rows
             fmt16 = n_words * 32 <= 65536
+            fmt = {"kind": "fmt16"} if fmt16 else {"kind": "fmt32"}
 
             fn_fixed = None
             self.pallas_active = False
@@ -1113,8 +1130,8 @@ class SigEngine(OverlayedEngine):
                 from . import sig_pallas
                 kplan = sig_pallas.plan(tables)
                 if kplan is not None:
-                    fn_fixed = sig_pallas.build_fixed_fn(
-                        tables, consts, kplan, max_rows=kr, fmt16=fmt16)
+                    fn_fixed, fmt = sig_pallas.build_fixed_fn(
+                        tables, consts, kplan, max_rows=kr)
                     self.pallas_active = True
                 elif self.use_pallas is True:
                     raise ValueError(
@@ -1128,7 +1145,7 @@ class SigEngine(OverlayedEngine):
                                                 max_rows=kr)
 
             self._state = (tables, consts, fn, fn_many,
-                           fn_compact, fn_compact_many, fn_fixed, fmt16)
+                           fn_compact, fn_compact_many, fn_fixed, fmt)
             return True
 
     @property
@@ -1244,9 +1261,24 @@ class SigEngine(OverlayedEngine):
             out = self.dispatch_fixed(topics)
         # unpack with the SAME snapshot the dispatch used — a concurrent
         # refresh() must never pair a new format with an old result
-        out, hostrows, tables, fmt16 = out
+        out, hostrows, tables, fmt = out
         o = np.asarray(out)
-        if fmt16:
+        kind = fmt["kind"]
+        if kind == "packed":
+            eb = fmt["enc_bits"]
+            kr = fmt["max_rows"]
+            cnt = (o[:, 0] & 0xF).astype(np.int32)
+            o64 = o.astype(np.uint64)
+            rows = np.empty((len(o), kr), dtype=np.uint32)
+            bitpos = 4
+            for k in range(kr):
+                lane, off = divmod(bitpos, 32)
+                v = o64[:, lane] >> np.uint64(off)
+                if off + eb > 32:
+                    v |= o64[:, lane + 1] << np.uint64(32 - off)
+                rows[:, k] = v.astype(np.uint32) & np.uint32((1 << eb) - 1)
+                bitpos += eb
+        elif kind == "fmt16":
             cnt = (o[:, 0] >> 28).astype(np.int32)
             row16 = [o[:, 0] & 0xFFFF]
             for c in range(1, o.shape[1]):

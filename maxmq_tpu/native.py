@@ -100,6 +100,14 @@ def _try_load():
             np.ctypeslib.ndpointer(np.int32), ctypes.c_int64,
             ctypes.c_int32]
         lib.mq_probe_run.restype = ctypes.c_int64
+        lib.mq_tokenize_probe.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_void_p,
+            np.ctypeslib.ndpointer(np.int8),
+            np.ctypeslib.ndpointer(np.int64),
+            np.ctypeslib.ndpointer(np.int32), ctypes.c_int64]
+        lib.mq_tokenize_probe.restype = ctypes.c_int64
         _lib = lib
         return _lib
 
@@ -234,6 +242,30 @@ class NativeProbe:
             if total <= cap:
                 return ti[:total], rw[:total]
             cap = int(total)
+
+
+def tokenize_probe(vocab: "NativeVocab", probe: "NativeProbe",
+                   topics: list[str], window: int, tok_dtype):
+    """Fused single-pass tokenize + host probe (C++): returns
+    (toks [n, window] of tok_dtype, lens_enc int8[n], ti int64[M],
+    rows int32[M]) — hit pairs topic-sorted. One pass over the topic
+    bytes with the level tokens still in registers at probe time."""
+    lib = vocab._lib
+    n = len(topics)
+    buf = "\x00".join(topics).encode("utf-8")
+    toks = np.empty((n, window), dtype=tok_dtype)
+    lens = np.empty(n, dtype=np.int8)
+    mode = {np.uint8: 1, np.uint16: 2, np.int32: 4}[tok_dtype]
+    cap = max(4 * n, 1024)
+    while True:
+        ti = np.empty(cap, dtype=np.int64)
+        rw = np.empty(cap, dtype=np.int32)
+        total = lib.mq_tokenize_probe(
+            vocab._handle, probe._handle, buf, len(buf), n, window, mode,
+            toks.ctypes.data_as(ctypes.c_void_p), lens, ti, rw, cap)
+        if total <= cap:
+            return toks, lens, ti[:total], rw[:total]
+        cap = int(total)
 
 
 class MalformedFrame(ValueError):

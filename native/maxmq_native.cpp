@@ -29,14 +29,79 @@
 
 namespace {
 
+inline uint64_t fnv1a(const char* s, size_t n) {
+  uint64_t h = 1469598103934665603ull;
+  for (size_t i = 0; i < n; ++i) {
+    h ^= static_cast<uint8_t>(s[i]);
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+// Open-addressing vocabulary (level bytes -> token id). The tokenizer
+// runs on the single-core publish hot path, so lookups must not
+// allocate (the previous unordered_map<string> find built a std::string
+// per level) and should cost a couple of cache lines.
 struct Vocab {
-  std::unordered_map<std::string, int32_t> map;
+  struct Entry {
+    uint64_t hash;
+    uint32_t off, len;
+    int32_t id;
+  };
+  std::string pool;             // concatenated key bytes
+  std::vector<Entry> entries;
+  std::vector<int32_t> slots;   // index into entries, -1 = empty
+  uint64_t mask = 0;
+  bool dirty = false;
+
+  void add(const char* s, int64_t len, int32_t id) {
+    entries.push_back({fnv1a(s, len), static_cast<uint32_t>(pool.size()),
+                       static_cast<uint32_t>(len), id});
+    pool.append(s, len);
+    dirty = true;
+  }
+
+  void build() {
+    size_t cap = 16;
+    while (cap < 2 * entries.size() + 1) cap <<= 1;
+    mask = cap - 1;
+    slots.assign(cap, -1);
+    for (size_t e = 0; e < entries.size(); ++e) {
+      uint64_t h = entries[e].hash & mask;
+      while (slots[h] != -1) {
+        const Entry& old = entries[slots[h]];
+        if (old.hash == entries[e].hash && old.len == entries[e].len &&
+            memcmp(pool.data() + old.off, pool.data() + entries[e].off,
+                   old.len) == 0)
+          break;  // duplicate key: first insertion wins (dict semantics)
+        h = (h + 1) & mask;
+      }
+      if (slots[h] == -1) slots[h] = static_cast<int32_t>(e);
+    }
+    dirty = false;
+  }
+
+  int32_t find(const char* s, size_t len) const {
+    if (entries.empty()) return 0;
+    const uint64_t hash = fnv1a(s, len);
+    uint64_t h = hash & mask;
+    while (slots[h] != -1) {
+      const Entry& e = entries[slots[h]];
+      if (e.hash == hash && e.len == len &&
+          memcmp(pool.data() + e.off, s, len) == 0)
+        return e.id;
+      h = (h + 1) & mask;
+    }
+    return 0;  // UNK
+  }
 };
 
 // One exact-shape signature group for the host probe: topics of exactly
 // `depth` levels match a row iff the hashed signature over the group's
 // literal positions equals the row's (collisions are re-verified in the
 // Python decode, mirroring maxmq_tpu/matching/sig.py:HostPlusProbe).
+// Probing is one open-addressing lookup (hkeys/hstart); equal-signature
+// runs (collided filters, rare) walk the sorted array.
 struct ProbeGroup {
   int32_t depth;
   bool wildf;                   // level 0 is '+': excluded for '$'-topics
@@ -44,6 +109,48 @@ struct ProbeGroup {
   std::vector<uint32_t> coef;   // [depth] multipliers, 0 at '+' positions
   std::vector<uint32_t> sigs;   // SORTED row signatures
   std::vector<int32_t> rows;    // row ids aligned with sigs
+  std::vector<uint32_t> hkeys;  // open-addressing: signature keys
+  std::vector<int32_t> hstart;  // -> first index in sigs, -1 = empty
+  uint32_t hmask = 0;
+  std::vector<uint64_t> bloom;  // 1-hash prefilter, ~8 bits/row: almost
+                                // every (topic, group) pair misses, and
+                                // the bloom bits stay cache-resident
+                                // where the full tables do not
+  uint32_t bshift = 0;
+
+  void build_table() {
+    size_t cap = 8;
+    while (cap < 2 * sigs.size() + 1) cap <<= 1;
+    hmask = static_cast<uint32_t>(cap - 1);
+    hkeys.assign(cap, 0);
+    hstart.assign(cap, -1);
+    size_t mbits = 64;
+    while (mbits < 8 * sigs.size()) mbits <<= 1;
+    int lg = 6;
+    while ((size_t{1} << lg) < mbits) ++lg;
+    bshift = 32 - lg;
+    bloom.assign(mbits / 64, 0);
+    for (size_t i = 0; i < sigs.size(); ++i) {
+      const uint32_t bb = (sigs[i] * 0xC2B2AE35u) >> bshift;
+      bloom[bb >> 6] |= uint64_t{1} << (bb & 63);
+      if (i > 0 && sigs[i] == sigs[i - 1]) continue;  // run: keep first
+      uint32_t h = (sigs[i] * 0x9E3779B1u) & hmask;
+      while (hstart[h] != -1) h = (h + 1) & hmask;
+      hkeys[h] = sigs[i];
+      hstart[h] = static_cast<int32_t>(i);
+    }
+  }
+
+  inline int32_t probe(uint32_t sig) const {
+    const uint32_t bb = (sig * 0xC2B2AE35u) >> bshift;
+    if (!(bloom[bb >> 6] & (uint64_t{1} << (bb & 63)))) return -1;
+    uint32_t h = (sig * 0x9E3779B1u) & hmask;
+    while (hstart[h] != -1) {
+      if (hkeys[h] == sig) return hstart[h];
+      h = (h + 1) & hmask;
+    }
+    return -1;
+  }
 };
 
 struct ProbeSet {
@@ -69,11 +176,11 @@ void* mq_vocab_new() { return new Vocab(); }
 void mq_vocab_free(void* v) { delete static_cast<Vocab*>(v); }
 
 void mq_vocab_add(void* v, const char* s, int64_t len, int32_t tok) {
-  static_cast<Vocab*>(v)->map.emplace(std::string(s, len), tok);
+  static_cast<Vocab*>(v)->add(s, len, tok);
 }
 
 int64_t mq_vocab_size(void* v) {
-  return static_cast<int64_t>(static_cast<Vocab*>(v)->map.size());
+  return static_cast<int64_t>(static_cast<Vocab*>(v)->entries.size());
 }
 
 // Tokenize n_topics topics stored concatenated in `buf` with boundaries
@@ -87,7 +194,9 @@ int64_t mq_vocab_size(void* v) {
 void mq_tokenize(void* v, const char* buf, const int64_t* offsets,
                  int64_t n_topics, int64_t max_levels, int32_t* toks,
                  int32_t* lengths, uint8_t* dollar) {
-  const auto& map = static_cast<Vocab*>(v)->map;
+  Vocab* vb = static_cast<Vocab*>(v);
+  if (vb->dirty) vb->build();
+  const Vocab& map = *vb;
   for (int64_t i = 0; i < n_topics; ++i) {
     const char* start = buf + offsets[i];
     const int64_t tlen = offsets[i + 1] - offsets[i];
@@ -104,9 +213,7 @@ void mq_tokenize(void* v, const char* buf, const int64_t* offsets,
           overflow = true;
           break;
         }
-        std::string level(start + level_start, p - level_start);
-        auto it = map.find(level);
-        row[n_levels] = (it == map.end()) ? 0 : it->second;
+        row[n_levels] = map.find(start + level_start, p - level_start);
         ++n_levels;
         level_start = p + 1;
       }
@@ -126,7 +233,9 @@ void mq_tokenize(void* v, const char* buf, const int64_t* offsets,
 void mq_tokenize_joined(void* v, const char* buf, int64_t buf_len,
                         int64_t n_topics, int64_t max_levels, int32_t* toks,
                         int32_t* lengths, uint8_t* dollar) {
-  const auto& map = static_cast<Vocab*>(v)->map;
+  Vocab* vb = static_cast<Vocab*>(v);
+  if (vb->dirty) vb->build();
+  const Vocab& map = *vb;
   int64_t topic_start = 0;
   int64_t i = 0;
   for (int64_t end = 0; end <= buf_len && i < n_topics; ++end) {
@@ -145,8 +254,7 @@ void mq_tokenize_joined(void* v, const char* buf, int64_t buf_len,
           overflow = true;
           break;
         }
-        auto it = map.find(std::string(start + level_start, p - level_start));
-        row[n_levels] = (it == map.end()) ? 0 : it->second;
+        row[n_levels] = map.find(start + level_start, p - level_start);
         ++n_levels;
         level_start = p + 1;
       }
@@ -180,7 +288,9 @@ void mq_tokenize_sig(void* v, const char* buf, int64_t buf_len,
                      const uint32_t* exact_coef, const uint32_t* exact_dc,
                      const uint8_t* exact_present, int64_t max_exact_d,
                      void* toks_out, int8_t* lens_out, uint32_t* esig_out) {
-  const auto& map = static_cast<Vocab*>(v)->map;
+  Vocab* vb = static_cast<Vocab*>(v);
+  if (vb->dirty) vb->build();
+  const Vocab& map = *vb;
   constexpr int64_t kDepthCap = 63;
   uint8_t* t8 = static_cast<uint8_t*>(toks_out);
   uint16_t* t16 = static_cast<uint16_t*>(toks_out);
@@ -203,8 +313,8 @@ void mq_tokenize_sig(void* v, const char* buf, int64_t buf_len,
           overflow = true;
           break;
         }
-        auto it = map.find(std::string(start + level_start, p - level_start));
-        level_toks[n_levels++] = (it == map.end()) ? 0 : it->second;
+        level_toks[n_levels++] =
+            map.find(start + level_start, p - level_start);
         level_start = p + 1;
       }
     }
@@ -261,6 +371,7 @@ void mq_probe_add_group(void* h, int32_t depth, uint8_t wildf, uint32_t dc,
   g.coef.assign(coef, coef + depth);
   g.sigs.assign(sigs, sigs + n);
   g.rows.assign(rows, rows + n);
+  g.build_table();
   if (static_cast<size_t>(depth) >= set->by_depth.size())
     set->by_depth.resize(depth + 1);
   set->by_depth[depth].push_back(static_cast<int32_t>(set->groups.size()));
@@ -305,10 +416,11 @@ int64_t mq_probe_run(void* h, const void* toks, int32_t tok_mode,
         const int64_t base = i * window;
         for (int32_t p = 0; p < g.depth; ++p)
           sig += g.coef[p] * tok_at(toks, tok_mode, base + p);
-        auto it = std::lower_bound(g.sigs.begin(), g.sigs.end(), sig);
-        for (; it != g.sigs.end() && *it == sig; ++it) {
+        int32_t j = g.probe(sig);
+        for (; j >= 0 && static_cast<size_t>(j) < g.sigs.size() &&
+               g.sigs[j] == sig; ++j) {
           ti_t.push_back(i);
-          rw_t.push_back(g.rows[it - g.sigs.begin()]);
+          rw_t.push_back(g.rows[j]);
         }
       }
     }
@@ -333,6 +445,94 @@ int64_t mq_probe_run(void* h, const void* toks, int32_t tok_mode,
     }
   }
   return total;
+}
+
+// Fused single-pass host half of the signature match: tokenize (narrow
+// window form, as mq_tokenize_sig) AND probe every exact-shape group of
+// the topic's depth while the level tokens are still in registers. This
+// is the publish-path entry on a single-core host — one pass over the
+// topic bytes, no intermediate arrays re-read.
+// Outputs: toks_out/lens_out as mq_tokenize_sig; (ti_out, row_out) hit
+// pairs in topic order (up to cap — returns the total regardless, the
+// caller re-invokes with a larger buffer when total > cap).
+int64_t mq_tokenize_probe(void* v, void* h, const char* buf, int64_t buf_len,
+                          int64_t n_topics, int64_t window, int32_t tok_mode,
+                          void* toks_out, int8_t* lens_out, int64_t* ti_out,
+                          int32_t* row_out, int64_t cap) {
+  Vocab* vb = static_cast<Vocab*>(v);
+  if (vb->dirty) vb->build();
+  const Vocab& map = *vb;
+  const ProbeSet* set = static_cast<ProbeSet*>(h);
+  constexpr int64_t kDepthCap = 63;
+  uint8_t* t8 = static_cast<uint8_t*>(toks_out);
+  uint16_t* t16 = static_cast<uint16_t*>(toks_out);
+  int32_t* t32 = static_cast<int32_t*>(toks_out);
+  int64_t topic_start = 0;
+  int64_t i = 0;
+  int64_t hits = 0;
+  int32_t level_toks[kDepthCap];
+  for (int64_t end = 0; end <= buf_len && i < n_topics; ++end) {
+    if (end != buf_len && buf[end] != '\0') continue;
+    const char* start = buf + topic_start;
+    const int64_t tlen = end - topic_start;
+    const bool dollar = tlen > 0 && start[0] == '$';
+
+    int64_t n_levels = 0;
+    int64_t level_start = 0;
+    bool overflow = false;
+    for (int64_t p = 0; p <= tlen; ++p) {
+      if (p == tlen || start[p] == '/') {
+        if (n_levels >= kDepthCap) {
+          overflow = true;
+          break;
+        }
+        level_toks[n_levels++] =
+            map.find(start + level_start, p - level_start);
+        level_start = p + 1;
+      }
+    }
+
+    const int8_t depth8 =
+        overflow ? int8_t{127} : static_cast<int8_t>(n_levels);
+    lens_out[i] = dollar ? static_cast<int8_t>(-depth8) : depth8;
+
+    for (int64_t j = 0; j < window; ++j) {
+      const bool real = !overflow && j < n_levels;
+      const int32_t tok = real ? level_toks[j] : -1;
+      switch (tok_mode) {
+        case 1: t8[i * window + j] = real ? static_cast<uint8_t>(tok) : 255;
+                break;
+        case 2: t16[i * window + j] =
+                    real ? static_cast<uint16_t>(tok) : 65535;
+                break;
+        default: t32[i * window + j] = tok;
+      }
+    }
+
+    if (!overflow &&
+        static_cast<size_t>(n_levels) < set->by_depth.size()) {
+      for (const int32_t gi : set->by_depth[n_levels]) {
+        const ProbeGroup& g = set->groups[gi];
+        if (g.wildf && dollar) continue;
+        uint32_t sig = g.dc;
+        for (int32_t p = 0; p < g.depth; ++p)
+          sig += g.coef[p] * static_cast<uint32_t>(level_toks[p]);
+        int32_t j = g.probe(sig);
+        for (; j >= 0 && static_cast<size_t>(j) < g.sigs.size() &&
+               g.sigs[j] == sig; ++j) {
+          if (hits < cap) {
+            ti_out[hits] = i;
+            row_out[hits] = g.rows[j];
+          }
+          ++hits;
+        }
+      }
+    }
+
+    topic_start = end + 1;
+    ++i;
+  }
+  return hits;
 }
 
 // Scan `buf` (len bytes) for complete MQTT control-packet frames.
