@@ -139,13 +139,24 @@ class Client:
             self._writer_task = asyncio.get_running_loop().create_task(
                 self._write_loop(), name=f"mq-write-{self.id or id(self)}")
 
-    async def read_loop(self, on_packet) -> None:
+    async def read_loop(self, on_packet, initial: bytearray | None = None
+                        ) -> None:
         """Frame the inbound byte stream and dispatch packets until EOF,
-        error, or stop. ``on_packet`` is the server's receive entry point."""
+        error, or stop. ``on_packet`` is the server's receive entry point.
+        ``initial`` seeds the buffer with bytes read past the CONNECT
+        packet (a client may pipeline SUBSCRIBE/PUBLISH in the same
+        segment)."""
         assert self.reader is not None
-        buf = bytearray()
+        buf = initial if initial is not None else bytearray()
         maxsize = self.server.capabilities.maximum_packet_size
         while not self.closed:
+            for fh, body in parse_stream(buf, maxsize):
+                self.server.info.packets_received += 1
+                packet = Packet.decode(fh, body,
+                                       self.properties.protocol_version)
+                await on_packet(self, packet)
+                if self.closed:
+                    return
             try:
                 chunk = await self.reader.read(65536)
             except (ConnectionError, asyncio.CancelledError, OSError):
@@ -155,13 +166,6 @@ class Client:
             self.server.info.bytes_received += len(chunk)
             self.last_received = time.monotonic()
             buf.extend(chunk)
-            for fh, body in parse_stream(buf, maxsize):
-                self.server.info.packets_received += 1
-                packet = Packet.decode(fh, body,
-                                       self.properties.protocol_version)
-                await on_packet(self, packet)
-                if self.closed:
-                    return
 
     async def _write_loop(self) -> None:
         assert self.writer is not None

@@ -11,6 +11,7 @@ topic matcher is pluggable so the TPU NFA engine can replace the CPU trie.
 from __future__ import annotations
 
 import asyncio
+import heapq
 import time
 from dataclasses import dataclass, field
 
@@ -76,12 +77,35 @@ class Broker:
         self._housekeeper: asyncio.Task | None = None
         self._sys_task: asyncio.Task | None = None
         self._will_delays: dict[str, tuple[float, Packet]] = {}
+        self._retained_expiry: list[tuple[float, str]] = []
         self._running = False
         self.loop: asyncio.AbstractEventLoop | None = None
 
     # ------------------------------------------------------------------
     # Lifecycle
     # ------------------------------------------------------------------
+
+    def _spawn(self, coro, what: str) -> asyncio.Task:
+        """Fire-and-forget task with failure logging: a lost will fan-out
+        or a failed forced disconnect must not vanish silently."""
+        task = self.loop.create_task(coro)
+
+        def _done(t: asyncio.Task) -> None:
+            if t.cancelled():
+                return
+            exc = t.exception()
+            if exc is None:
+                return
+            if self.log is not None:
+                self.log.with_prefix("broker").error(
+                    "background task failed", task=what, error=repr(exc))
+            else:
+                import logging
+                logging.getLogger("maxmq").error(
+                    "background task %s failed: %r", what, exc)
+
+        task.add_done_callback(_done)
+        return task
 
     def add_hook(self, hook: Hook, config=None) -> Hook:
         return self.hooks.add(hook, config)
@@ -133,7 +157,7 @@ class Broker:
             await client.stop()
 
     async def _attach_client(self, client: Client) -> None:
-        packet = await self._read_connect(client)
+        packet, leftover = await self._read_connect(client)
         client.parse_connect(packet)
         self._validate_connect(client, packet)
 
@@ -163,7 +187,7 @@ class Broker:
 
         err: ProtocolError | None = None
         try:
-            await client.read_loop(self._receive_packet)
+            await client.read_loop(self._receive_packet, initial=leftover)
         except ProtocolError as e:
             err = e
         except MalformedPacketError:
@@ -171,8 +195,11 @@ class Broker:
         finally:
             await self._detach_client(client, err)
 
-    async def _read_connect(self, client: Client) -> Packet:
-        """The first inbound packet must be CONNECT [MQTT-3.1.0-1]."""
+    async def _read_connect(self, client: Client
+                            ) -> tuple[Packet, bytearray]:
+        """The first inbound packet must be CONNECT [MQTT-3.1.0-1].
+        Returns (packet, leftover bytes read past it) — a client may
+        pipeline further packets in the same TCP segment."""
         from ..protocol.packets import parse_stream
 
         assert client.reader is not None
@@ -181,10 +208,11 @@ class Broker:
         while True:
             for fh, body in parse_stream(
                     buf, self.capabilities.maximum_packet_size):
+                self.info.packets_received += 1
                 if fh.type != PT.CONNECT:
                     raise ProtocolError(codes.ErrProtocolViolation,
                                         "first packet was not CONNECT")
-                return Packet.decode(fh, body)
+                return Packet.decode(fh, body), buf
             timeout = deadline - time.monotonic()
             if timeout <= 0:
                 raise ProtocolError(codes.ErrKeepAliveTimeout)
@@ -196,7 +224,6 @@ class Broker:
             if not chunk:
                 raise ConnectionError("eof before CONNECT")
             self.info.bytes_received += len(chunk)
-            self.info.packets_received += 1
             buf.extend(chunk)
 
     def _validate_connect(self, client: Client, packet: Packet) -> None:
@@ -229,9 +256,9 @@ class Broker:
         existing.taken_over = True
         if not existing.closed:
             self.disconnect_client(existing, codes.ErrSessionTakenOver)
-            task = self.loop.create_task(
-                existing.stop(ProtocolError(codes.ErrSessionTakenOver)))
-            task.add_done_callback(lambda t: t.exception())
+            self._spawn(
+                existing.stop(ProtocolError(codes.ErrSessionTakenOver)),
+                "takeover-stop")
         if client.properties.clean_start:
             self._purge_session(existing)
             return False
@@ -451,6 +478,7 @@ class Broker:
     def retain_message(self, client: Client, packet: Packet) -> None:
         stored = self.topics.retain(packet.copy())
         self.info.retained += stored
+        self._note_retained_expiry(packet)
         self.hooks.notify("on_retain_message", client, packet, stored)
 
     # ------------------------------------------------------------------
@@ -757,8 +785,8 @@ class Broker:
     def _fire_will(self, client: Client | None, packet: Packet) -> None:
         if packet.fixed.retain:
             self.topics.retain(packet.copy())
-        task = self.loop.create_task(self.publish_to_subscribers(packet))
-        task.add_done_callback(lambda t: t.exception())
+            self._note_retained_expiry(packet)
+        self._spawn(self.publish_to_subscribers(packet), "will-fanout")
         self.hooks.notify("on_will_sent", client, packet)
 
     # ------------------------------------------------------------------
@@ -779,6 +807,7 @@ class Broker:
             setattr(packet.properties, k, v)
         if retain:
             self.topics.retain(packet.copy())
+            self._note_retained_expiry(packet)
         await self.publish_to_subscribers(packet)
 
     async def inject(self, client: Client, packet: Packet) -> None:
@@ -831,9 +860,9 @@ class Broker:
                 continue
             if mono - client.last_received > client.keepalive * grace:
                 self.disconnect_client(client, codes.ErrKeepAliveTimeout)
-                task = self.loop.create_task(
-                    client.stop(ProtocolError(codes.ErrKeepAliveTimeout)))
-                task.add_done_callback(lambda t: t.exception())
+                self._spawn(
+                    client.stop(ProtocolError(codes.ErrKeepAliveTimeout)),
+                    "keepalive-stop")
 
     def _check_client_expiry(self, now: float) -> None:
         maximum = self.capabilities.maximum_session_expiry_interval
@@ -849,14 +878,35 @@ class Broker:
                 del self._will_delays[cid]
                 self._fire_will(self.clients.get(cid), packet)
 
+    def _note_retained_expiry(self, packet: Packet) -> None:
+        """Index a stored retained message for the expiry sweep: min-heap
+        of (due, topic) with lazy revalidation on pop, so each tick costs
+        O(due entries) instead of rescanning every retained message (the
+        reference sweeps its whole retained map each tick,
+        v2/server.go:1436-1476 — a per-second host stall at IoT scale).
+        $-topics are broker-owned and never expire (the old '#'-scan
+        skipped them the same way)."""
+        maximum = self.capabilities.maximum_message_expiry_interval
+        if not maximum or not packet.payload or packet.topic.startswith("$"):
+            return
+        expiry = packet.properties.message_expiry
+        if expiry is None:
+            expiry = maximum
+        if expiry <= 0:
+            return
+        heapq.heappush(self._retained_expiry,
+                       (packet.created + expiry, packet.topic))
+
     def _check_expired_retained(self, now: float) -> None:
         maximum = self.capabilities.maximum_message_expiry_interval
         if not maximum:
             return
-        # the '#' scan already skips $-prefixed (broker-owned) topics
-        expired = [p.topic for p in self.topics.retained_for("#")
-                   if self._message_expired(p, now, maximum)]
-        for topic in expired:
+        heap = self._retained_expiry
+        while heap and heap[0][0] <= now:
+            _due, topic = heapq.heappop(heap)
+            p = self.topics.retained_get(topic)
+            if p is None or not self._message_expired(p, now, maximum):
+                continue        # cleared or replaced since: stale entry
             clear = Packet(fixed=FixedHeader(type=PT.PUBLISH, retain=True),
                            topic=topic, payload=b"")
             self.topics.retain(clear)
@@ -918,8 +968,8 @@ class Broker:
                             origin="$SYS", created=time.time())
             self.topics.retain(packet.copy())
             if self.loop is not None:
-                task = self.loop.create_task(self.publish_to_subscribers(packet))
-                task.add_done_callback(lambda t: t.exception())
+                self._spawn(self.publish_to_subscribers(packet),
+                            "sys-fanout")
 
     # ------------------------------------------------------------------
     # Persistence restore (v2/server.go:1297-1434)
@@ -950,7 +1000,9 @@ class Broker:
             if client is not None:
                 client.subscriptions[rec.filter] = sub
         for rec in self.hooks.first_non_empty("stored_retained_messages"):
-            self.topics.retain(rec.to_packet())
+            packet = rec.to_packet()
+            self.topics.retain(packet)
+            self._note_retained_expiry(packet)
             self.info.retained += 1
         for rec in self.hooks.first_non_empty("stored_inflight_messages"):
             client = self.clients.get(rec.client_id)

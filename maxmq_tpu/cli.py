@@ -55,14 +55,19 @@ def cmd_start(args: argparse.Namespace) -> int:
                                               error=str(exc))
         return 1
     # Graceful cleanup is done (broker/metrics stopped, profiles written).
-    # Skip interpreter finalization: an accelerator-runtime thread caught
-    # mid-compile by teardown aborts the process from C++ ("exception not
-    # rethrown"); a server binary has nothing left to finalize anyway.
+    # If the accelerator runtime was initialized, skip interpreter
+    # finalization: a runtime thread caught mid-compile by teardown aborts
+    # the process from C++ ("exception not rethrown"). Scope the
+    # workaround to that case only — a CPU-only run returns normally so
+    # atexit handlers (log flushes, coverage hooks, storage plugins) fire.
     # Library callers use run_server directly and are unaffected.
-    sys.stdout.flush()
-    sys.stderr.flush()
-    import os
-    os._exit(0)
+    xla_bridge = sys.modules.get("jax._src.xla_bridge")
+    if xla_bridge is not None and getattr(xla_bridge, "_backends", None):
+        sys.stdout.flush()
+        sys.stderr.flush()
+        import os
+        os._exit(0)
+    return 0
 
 
 def main(argv: list[str] | None = None) -> int:

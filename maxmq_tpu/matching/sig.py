@@ -903,6 +903,7 @@ class Overlay:
     overlay for the old tables is dropped."""
 
     def __init__(self, base_version: int) -> None:
+        self.base = base_version        # construction base (tables version)
         self.version = base_version     # last applied sub_version
         self.delta = TopicIndex()
         self.removed: set[tuple[str, str]] = set()
@@ -984,7 +985,12 @@ class OverlayedEngine:
             self.refresh_soon()
         with self._overlay_lock:
             ov = self._overlay
-            if ov is None or ov.version < tables_version:
+            # Key reuse on the construction base, not the applied-through
+            # version: an overlay rebuilt against NEWER tables (base v10)
+            # must not serve a batch still holding OLD tables (v8) — the
+            # entries in (8,10] would be in neither. Reusing an
+            # older-based overlay is safe (replay is idempotent).
+            if ov is None or ov.base > tables_version:
                 ov = Overlay(tables_version)
             entries = self.index.journal_since(ov.version)
             if entries is None:
@@ -1023,6 +1029,10 @@ class SigEngine(OverlayedEngine):
         # with more than compact_word_slots nonzero words or
         # compact_max_rows matches overflow to the CPU trie; the stream
         # carries compact_cap_per_topic rows/topic on average
+        if not 1 <= compact_max_rows <= 254:
+            # counts_u8 reserves 255 for overflow; a larger cap would let
+            # the clamped count desynchronize host stream offsets
+            raise ValueError("compact_max_rows must be in [1, 254]")
         self.compact_word_slots = compact_word_slots
         self.compact_max_rows = compact_max_rows
         self.compact_cap_per_topic = compact_cap_per_topic
@@ -1438,6 +1448,8 @@ class SigEngine(OverlayedEngine):
         """Verify one candidate row against the topic and union its
         entries (padding bits and hash collisions are dropped here;
         ``removed`` drops pairs the overlay has unsubscribed/replaced)."""
+        if row >= len(tables.row_levels):
+            return                      # padding-word artifact, not a row
         flevels = tables.row_levels[row]
         if flevels is None or not filter_matches_topic(flevels, tlevels,
                                                        dollar):

@@ -304,6 +304,16 @@ class TopicIndex:
             self.version += 1
             return 0 if existed else 1
 
+    def retained_get(self, topic: str) -> Packet | None:
+        """Exact-topic retained lookup (no wildcard expansion)."""
+        with self._lock:
+            node = self._root
+            for level in split_levels(topic):
+                node = node.children.get(level)
+                if node is None:
+                    return None
+            return node.retained
+
     def retained_for(self, filter_: str) -> list[Packet]:
         """Retained messages matching a subscription filter (wildcard-aware;
         '#'/'+' at the first level skip '$' topics [MQTT-4.7.2-1])."""

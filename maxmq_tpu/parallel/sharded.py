@@ -175,8 +175,16 @@ class ShardedSigEngine(OverlayedEngine):
                 self._state = (version, shards, None, None, 0, {})
                 return True
 
-            # pad per-shard tables to common shapes and stack on 'subs'
-            g_max = max(max(len(t.groups), 1) for t in shards)
+            # pad per-shard tables to common shapes and stack on 'subs'.
+            # +1 group column: padding word slots must NOT alias a real
+            # group — a real group's adjusted signature can (adversarially,
+            # the hash seed is deterministic) equal the 0xFFFFFFFF poison
+            # plane, emitting row ids past the shard's row tables. The
+            # extra all-zero-coefficient group has signature 0 for every
+            # topic (never the poison), so padding words can never fire.
+            g_real = max(max(len(t.groups), 1) for t in shards)
+            g_max = g_real + 1
+            g_pad = g_real
             d_max = max(max(t.probe_depth, 1) for t in shards)
             w_max = max(max(int(t.group_words.sum()), 1) for t in shards)
 
@@ -187,7 +195,7 @@ class ShardedSigEngine(OverlayedEngine):
             wild = np.zeros((self.sp, g_max), dtype=bool)
             planes = np.full((self.sp, 32, w_max), 0xFFFFFFFF,
                              dtype=np.uint32)
-            grp = np.zeros((self.sp, w_max), dtype=np.int32)
+            grp = np.full((self.sp, w_max), g_pad, dtype=np.int32)
             for s, t in enumerate(shards):
                 g = len(t.groups)
                 if g:

@@ -1,0 +1,136 @@
+"""Regression tests for broker accounting/housekeeping fixes:
+
+- packets_received counts parsed packets, not TCP read chunks;
+- bytes pipelined after CONNECT in the same segment are processed;
+- retained-message expiry runs off a min-expiry heap with lazy
+  revalidation (no full-tree rescan per tick);
+- fire-and-forget broker tasks log their failures.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from maxmq_tpu.broker import Broker, BrokerOptions, Capabilities
+from maxmq_tpu.protocol.codec import FixedHeader, PacketType as PT
+from maxmq_tpu.protocol.packets import Packet
+
+from test_broker_system import running_broker
+
+
+def _connect_bytes(client_id: str) -> bytes:
+    return Packet(fixed=FixedHeader(type=PT.CONNECT), protocol_version=4,
+                  clean_start=True, client_id=client_id).encode()
+
+
+async def test_packets_received_counts_packets_not_chunks():
+    """A CONNECT fragmented into 1-byte segments is ONE received packet
+    (the reference counts per packet too, v2/system/system.go)."""
+    async with running_broker() as broker:
+        raw = _connect_bytes("frag")
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", broker.test_port)
+        for b in raw:
+            writer.write(bytes([b]))
+            await writer.drain()
+        await asyncio.wait_for(reader.readexactly(4), 5)   # CONNACK
+        assert broker.info.packets_received == 1
+        writer.close()
+
+
+async def test_pipelined_packets_after_connect_processed():
+    """A client may pipeline packets behind CONNECT in one TCP segment;
+    the leftover bytes must reach the read loop, not be discarded."""
+    async with running_broker() as broker:
+        ping = Packet(fixed=FixedHeader(type=PT.PINGREQ),
+                      protocol_version=4).encode()
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", broker.test_port)
+        writer.write(_connect_bytes("pipe") + ping)
+        await writer.drain()
+        data = await asyncio.wait_for(reader.readexactly(6), 5)
+        assert data[0] >> 4 == PT.CONNACK
+        assert data[4] >> 4 == PT.PINGRESP
+        assert broker.info.packets_received == 2
+        writer.close()
+
+
+def _retained(topic: str, payload: bytes, created: float,
+              expiry: int | None = None) -> Packet:
+    p = Packet(fixed=FixedHeader(type=PT.PUBLISH, retain=True),
+               topic=topic, payload=payload, created=created)
+    if expiry is not None:
+        p.properties.message_expiry = expiry
+    return p
+
+
+def test_retained_expiry_heap_expires_and_revalidates():
+    b = Broker(BrokerOptions(capabilities=Capabilities(
+        maximum_message_expiry_interval=60)))
+    now = time.time()
+
+    # an already-expired message is cleared on the next sweep
+    b.retain_message(None, _retained("room/a", b"v1", created=now - 120))
+    assert len(b._retained_expiry) == 1
+    b._check_expired_retained(now)
+    assert b.topics.retained_get("room/a") is None
+
+    # replacement invalidates the stale heap entry (lazy revalidation)
+    b.retain_message(None, _retained("room/b", b"v1", created=now - 120))
+    b.retain_message(None, _retained("room/b", b"v2", created=now))
+    b._check_expired_retained(now)
+    assert b.topics.retained_get("room/b").payload == b"v2"
+    # ... and the replacement's own entry fires when it is due
+    b._check_expired_retained(now + 120)
+    assert b.topics.retained_get("room/b") is None
+
+    # per-message expiry beats the capability maximum
+    b.retain_message(None, _retained("room/c", b"v1", created=now - 5,
+                                     expiry=2))
+    b._check_expired_retained(now)
+    assert b.topics.retained_get("room/c") is None
+
+
+def test_retained_expiry_skips_sys_and_disabled():
+    b = Broker(BrokerOptions(capabilities=Capabilities(
+        maximum_message_expiry_interval=60)))
+    sys_p = _retained("$SYS/broker/load", b"s", created=0.0)
+    b.topics.retain(sys_p)
+    b._note_retained_expiry(sys_p)
+    assert not b._retained_expiry          # broker-owned: never indexed
+
+    b2 = Broker(BrokerOptions(capabilities=Capabilities(
+        maximum_message_expiry_interval=0)))
+    b2.retain_message(None, _retained("x", b"v", created=0.0))
+    assert not b2._retained_expiry         # expiry disabled: no index
+    b2._check_expired_retained(time.time())
+    assert b2.topics.retained_get("x") is not None
+
+
+class _CapturingLogger:
+    def __init__(self):
+        self.errors = []
+
+    def with_prefix(self, prefix):
+        return self
+
+    def error(self, msg, **fields):
+        self.errors.append((msg, fields))
+
+
+async def test_spawn_logs_background_failures():
+    log = _CapturingLogger()
+    b = Broker(BrokerOptions(logger=log))
+    b.loop = asyncio.get_running_loop()
+
+    async def boom():
+        raise RuntimeError("kaput")
+
+    t = b._spawn(boom(), "test-task")
+    with pytest.raises(RuntimeError):
+        await t
+    await asyncio.sleep(0)
+    assert log.errors
+    assert log.errors[0][1]["task"] == "test-task"
+    assert "kaput" in log.errors[0][1]["error"]

@@ -123,6 +123,28 @@ def test_sharded_sig_refresh_and_fallback():
     assert_same(got, index.subscribers(deep), deep)
 
 
+def test_sharded_sig_padding_words_cannot_fire():
+    """Padding word slots must point at the all-zero-coefficient padding
+    group (signature deterministically 0, never the 0xFFFFFFFF poison
+    plane) — a real group's signature can adversarially equal the poison
+    and emit row ids past the shard's row tables."""
+    import numpy as np
+
+    filters, _topics = random_corpus(60, 0, seed=3)
+    index = build_index(filters)
+    engine = ShardedSigEngine(index, mesh=make_mesh(shape=(1, 8)))
+    _v, shards, dev, fn, _d, _ue = engine._state
+    assert fn is not None
+    topo = np.asarray(dev[0])           # [sp, G, D] coefficients
+    dc = np.asarray(dev[1])             # [sp, G] depth coefficients
+    grp = np.asarray(dev[6])            # [sp, W] word -> group
+    for s, t in enumerate(shards):
+        w = int(t.group_words.sum())
+        pad_groups = np.unique(grp[s, w:])
+        assert topo[s, pad_groups].sum() == 0, s
+        assert dc[s, pad_groups].sum() == 0, s
+
+
 def test_sharded_sig_uneven_and_empty_shards():
     # fewer filters than shards: some shards compile empty
     index = build_index(["alpha/beta", "alpha/+", "gamma/#"])

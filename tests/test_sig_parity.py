@@ -356,6 +356,56 @@ def test_overlay_journal_gap_resyncs_via_trie(monkeypatch):
     assert engine.fallbacks >= 2
 
 
+def test_overlay_rebuilds_for_older_tables_after_newer_base():
+    """Overlay reuse must key on the construction base, not the
+    applied-through version: an overlay rebuilt against newer tables must
+    not serve an in-flight batch still holding the old tables (the
+    entries between the two versions would be replayed by neither)."""
+    from maxmq_tpu.matching.sig import Overlay
+
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="a/b"))
+    engine = _frozen_engine(idx)
+    v_old = idx.sub_version
+
+    idx.subscribe("c2", Subscription(filter="a/+"))     # entry in (old, new]
+    v_new = idx.sub_version
+
+    # simulate the race: a caller that already swapped to v_new tables
+    # rebuilt the shared overlay with base v_new (it replays nothing)
+    engine._overlay = Overlay(v_new)
+
+    # an in-flight batch still holding v_old tables asks for its overlay:
+    # it must see the (v_old, v_new] subscription
+    ov = engine.overlay_for(v_old)
+    assert ov is not None and ov != "resync"
+    assert ("c2", "a/+") in ov.removed
+    assert "c2" in ov.delta.subscribers("a/x").subscriptions
+
+
+def test_add_row_out_of_range_is_dropped():
+    """Padding-word artifacts past the row tables must be dropped, not
+    raise IndexError on the publish hot path."""
+    from maxmq_tpu.matching.trie import SubscriberSet
+
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="a/b"))
+    engine = SigEngine(idx, auto_refresh=False)
+    t = engine.tables
+    res = SubscriberSet()
+    SigEngine._add_row(res, len(t.row_levels) + 5, t, ["a", "b"], False)
+    assert not res.subscriptions and not res.shared
+
+
+def test_compact_max_rows_validated():
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="a/b"))
+    with pytest.raises(ValueError):
+        SigEngine(idx, compact_max_rows=255)
+    with pytest.raises(ValueError):
+        SigEngine(idx, compact_max_rows=0)
+
+
 def test_retained_churn_never_recompiles():
     from maxmq_tpu.protocol.codec import PacketType as PT
     from maxmq_tpu.protocol.packets import FixedHeader, Packet
