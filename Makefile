@@ -1,0 +1,34 @@
+# Quality gates, mirroring the reference's Makefile:102-174 + ADR-002
+# (unit tests w/ race detector -> pytest; golangci-lint -> tools/qa.py
+# lint; gocyclo -over N -> tools/qa.py cyclo; coverage >= 80% ->
+# tools/qa.py coverage on sys.monitoring). No third-party QA tools are
+# baked into this image, so the gates are first-party (tools/qa.py).
+
+PY ?= python
+
+.PHONY: all check lint cyclo test coverage native bench clean
+
+all: check
+
+check: lint cyclo test
+
+lint:
+	$(PY) tools/qa.py lint
+
+cyclo:
+	$(PY) tools/qa.py cyclo --over 24
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+coverage:
+	$(PY) tools/qa.py coverage --fail-under 80
+
+native:
+	$(MAKE) -C native
+
+bench:
+	$(PY) bench.py
+
+clean:
+	rm -rf .qa_coverage.json $(shell find . -name __pycache__ -type d)

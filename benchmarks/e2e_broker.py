@@ -1,0 +1,173 @@
+"""End-to-end broker throughput: the mqtt-stresser scenario.
+
+Mirrors the reference engine's published benchmark setup
+(vendor/github.com/mochi-co/mqtt/v2/README.md:372-396, mqtt-stresser
+``-num-clients=N -num-messages=10000``): N clients; each subscribes to
+its own topic, publishes M QoS0 messages to it, and receives them all
+back. Reports aggregate + median per-client publish and receive rates —
+the same tool-relative score the reference's table shows (their warning
+applies here too: scores are for comparing brokers under this harness,
+not absolute message rates).
+
+Usage: python benchmarks/e2e_broker.py [--clients 2] [--messages 10000]
+The broker runs in-process (loopback TCP) like the reference's
+benchmark target; a separate-process broker can be pointed at with
+--host/--port.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import statistics
+import sys
+import time
+
+
+async def run_client(i: int, host: str, port: int, messages: int,
+                     payload: bytes, results: list):
+    from maxmq_tpu.mqtt_client import MQTTClient
+
+    c = MQTTClient(client_id=f"stress-{i}")
+    await c.connect(host, port)
+    topic = f"stress/{i}/topic"
+    await c.subscribe((topic, 0))
+
+    t0 = time.perf_counter()
+    for n in range(messages):
+        await c.publish(topic, payload)
+    pub_dt = time.perf_counter() - t0
+
+    got = 0
+    t0 = time.perf_counter()
+    while got < messages:
+        await c.next_message(timeout=30)
+        got += 1
+    recv_dt = time.perf_counter() - t0
+    await c.disconnect()
+    results.append((messages / pub_dt, messages / recv_dt))
+
+
+async def run_fanout(host: str, port: int, subscribers: int,
+                     messages: int, payload: bytes) -> dict:
+    """One publisher, N subscribers on one wildcard filter: the
+    delivery-amplification scenario the batch fan-out path is built for
+    (1 publish -> N deliveries; the broker encodes the QoS0 wire once)."""
+    from maxmq_tpu.mqtt_client import MQTTClient
+
+    subs = []
+    for i in range(subscribers):
+        c = MQTTClient(client_id=f"fan-sub-{i}")
+        await c.connect(host, port)
+        await c.subscribe(("fan/#", 0))
+        subs.append(c)
+    pub = MQTTClient(client_id="fan-pub")
+    await pub.connect(host, port)
+
+    async def drain(c):
+        for _ in range(messages):
+            await c.next_message(timeout=60)
+
+    t0 = time.perf_counter()
+    tasks = [asyncio.ensure_future(drain(c)) for c in subs]
+    for _ in range(messages):
+        await pub.publish("fan/x", payload)
+    await asyncio.gather(*tasks)
+    dt = time.perf_counter() - t0
+    for c in subs + [pub]:
+        await c.disconnect()
+    delivered = subscribers * messages
+    return {"deliveries": delivered,
+            "deliveries_per_sec": round(delivered / dt, 1),
+            "wall_s": round(dt, 2)}
+
+
+async def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients", type=int, default=2)
+    ap.add_argument("--messages", type=int, default=10_000)
+    ap.add_argument("--payload", type=int, default=64)
+    ap.add_argument("--fanout", type=int, default=0,
+                    help="N: run the 1-publisher/N-subscriber fan-out "
+                         "scenario instead of mqtt-stresser 1:1")
+    ap.add_argument("--host", default=None,
+                    help="external broker host (default: in-process)")
+    ap.add_argument("--port", type=int, default=1883)
+    args = ap.parse_args()
+
+    broker = None
+    host, port = args.host, args.port
+    if host is None:
+        # broker in its OWN process (as mqtt-stresser measures the
+        # reference: client harness and broker do not share a scheduler)
+        import subprocess
+
+        script = (
+            "import asyncio, sys\n"
+            f"sys.path.insert(0, {REPO!r})\n"
+            "from maxmq_tpu.broker import Broker, BrokerOptions, "
+            "Capabilities, TCPListener\n"
+            "from maxmq_tpu.hooks import AllowHook\n"
+            "async def main():\n"
+            "    b = Broker(BrokerOptions(capabilities=Capabilities("
+            "sys_topic_interval=0)))\n"
+            "    b.add_hook(AllowHook())\n"
+            "    lst = b.add_listener(TCPListener('bench', "
+            "'127.0.0.1:0'))\n"
+            "    await b.serve()\n"
+            "    print(lst._server.sockets[0].getsockname()[1], "
+            "flush=True)\n"
+            "    await asyncio.Event().wait()\n"
+            "asyncio.run(main())\n")
+        broker = subprocess.Popen([sys.executable, "-c", script],
+                                  stdout=subprocess.PIPE, text=True)
+        host = "127.0.0.1"
+        port = int(broker.stdout.readline())
+
+    payload = bytes(args.payload)
+    if args.fanout:
+        fan = await run_fanout(host, port, args.fanout,
+                               args.messages, payload)
+        if broker is not None:
+            broker.terminate()
+            broker.wait(timeout=10)
+        print(json.dumps({"metric": "e2e_broker_fanout_deliveries_per_sec",
+                          "subscribers": args.fanout,
+                          "messages": args.messages, **fan}))
+        return
+
+    results: list[tuple[float, float]] = []
+    t0 = time.perf_counter()
+    await asyncio.gather(*(run_client(i, host, port, args.messages,
+                                      payload, results)
+                           for i in range(args.clients)))
+    wall = time.perf_counter() - t0
+    if broker is not None:
+        broker.terminate()
+        broker.wait(timeout=10)
+
+    pub = sorted(r[0] for r in results)
+    recv = sorted(r[1] for r in results)
+    out = {
+        "metric": "e2e_broker_msgs_per_sec",
+        "clients": args.clients, "messages": args.messages,
+        "payload_bytes": args.payload,
+        "publish_median_per_client": round(statistics.median(pub), 1),
+        "receive_median_per_client": round(statistics.median(recv), 1),
+        "publish_aggregate": round(sum(pub), 1),
+        "receive_aggregate": round(sum(recv), 1),
+        "total_msgs": args.clients * args.messages,
+        "wall_s": round(wall, 2),
+        "reference_mochi_2_clients": {"publish_median": 125_456,
+                                      "receive_median": 313_186,
+                                      "hardware": "Apple M2 (README)"},
+    }
+    print(json.dumps(out))
+
+
+REPO = __file__.rsplit("/", 2)[0]
+
+if __name__ == "__main__":
+    sys.path.insert(0, REPO)
+    asyncio.run(main())

@@ -194,7 +194,6 @@ async def run_server(conf: Config, logger: Logger,
         if matcher is not None and hasattr(matcher, "close"):
             await matcher.close()
         if profiler is not None:
-            import pstats
             profiler.disable()
             profiler.dump_stats(f"{conf.profile_path}/cpu.prof")
             import tracemalloc

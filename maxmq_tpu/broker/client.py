@@ -11,10 +11,9 @@ from __future__ import annotations
 import asyncio
 import time
 from collections import deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from ..matching.trie import TopicAliases
-from ..protocol import codes
 from ..protocol.codec import PacketType as PT
 from ..protocol.packets import Packet, ProtocolError, Subscription, Will, parse_stream
 from .inflight import Inflight
@@ -78,7 +77,10 @@ class Client:
         self._packet_id_cursor = 0
 
         maxq = server.capabilities.maximum_client_writes_pending
-        self.outbound: asyncio.Queue[Packet | None] = asyncio.Queue(maxsize=maxq)
+        # bytes items are pre-encoded wire (QoS0 fan-out fast path);
+        # None is the writer-shutdown sentinel
+        self.outbound: asyncio.Queue[Packet | bytes | None] = \
+            asyncio.Queue(maxsize=maxq)
         self._writer_task: asyncio.Task | None = None
         self._reader_task: asyncio.Task | None = None
 
@@ -169,12 +171,27 @@ class Client:
 
     async def _write_loop(self) -> None:
         assert self.writer is not None
+        get_nowait = self.outbound.get_nowait
         try:
             while True:
                 packet = await self.outbound.get()
-                if packet is None:
-                    break
-                self._write_packet(packet)
+                # greedy drain: one task wake-up flushes everything queued
+                # (one await per BURST, not per packet)
+                while packet is not None:
+                    if type(packet) is bytes:  # pre-encoded QoS0 fast path
+                        self.writer.write(packet)
+                        info = self.server.info
+                        info.bytes_sent += len(packet)
+                        info.packets_sent += 1
+                        info.messages_sent += 1
+                    else:
+                        self._write_packet(packet)
+                    try:
+                        packet = get_nowait()
+                    except asyncio.QueueEmpty:
+                        break
+                else:
+                    break                      # drained a None: stop
             await self._drain()
         except (ConnectionError, asyncio.CancelledError, OSError):
             pass
@@ -208,6 +225,17 @@ class Client:
             return False
         try:
             self.outbound.put_nowait(packet)
+            return True
+        except asyncio.QueueFull:
+            return False
+
+    def send_wire(self, wire: bytes) -> bool:
+        """Enqueue pre-encoded bytes (the broker's QoS0 fan-out fast path:
+        one encode shared by every subscriber on the same fixed flags)."""
+        if self.closed or self.writer is None:
+            return False
+        try:
+            self.outbound.put_nowait(wire)
             return True
         except asyncio.QueueFull:
             return False

@@ -20,7 +20,7 @@ from ..matching.topics import valid_filter, valid_topic_name
 from ..matching.trie import SubscriberSet, TopicIndex
 from ..protocol import codes
 from ..protocol.codec import FixedHeader, MalformedPacketError, PacketType as PT
-from ..protocol.packets import Packet, ProtocolError, Subscription, Will
+from ..protocol.packets import Packet, ProtocolError, Subscription
 from .client import Client, ClientRegistry, PacketIDExhausted
 from .listeners import Listener, Listeners
 from .sys_info import SysInfo
@@ -78,6 +78,10 @@ class Broker:
         self._sys_task: asyncio.Task | None = None
         self._will_delays: dict[str, tuple[float, Packet]] = {}
         self._retained_expiry: list[tuple[float, str]] = []
+        # topic -> (sub_version, SubscriberSet): publish topics repeat
+        # heavily, and a trie walk costs ~20us; entries self-invalidate
+        # on any subscription change (version check), FIFO-bounded
+        self._match_cache: dict[str, tuple[int, SubscriberSet]] = {}
         self._running = False
         self.loop: asyncio.AbstractEventLoop | None = None
 
@@ -449,8 +453,27 @@ class Broker:
         if packet.fixed.retain:
             self.retain_message(client, packet)
         self._ack_publish(client, packet, success=True)
-        await self.publish_to_subscribers(packet)
+        if self.matcher is None:
+            self._fan_out(self._match_cached(packet.topic), packet)
+        else:
+            await self.publish_to_subscribers(packet)
         self.hooks.notify("on_published", client, packet)
+
+    def _match_cached(self, topic: str) -> SubscriberSet:
+        if self.hooks.overrides("on_select_subscribers"):
+            # the modify contract lets hooks mutate the set in place — a
+            # cached set must never be exposed to that
+            return self.topics.subscribers(topic)
+        version = self.topics.sub_version
+        hit = self._match_cache.get(topic)
+        if hit is not None and hit[0] == version:
+            return hit[1]
+        result = self.topics.subscribers(topic)
+        cache = self._match_cache
+        if len(cache) >= 8192:
+            cache.pop(next(iter(cache)))
+        cache[topic] = (version, result)
+        return result
 
     def _ack_publish(self, client: Client, packet: Packet, success: bool) -> None:
         qos = packet.fixed.qos
@@ -493,8 +516,17 @@ class Broker:
             subscribers = await self._match_async(packet.topic)
         else:
             subscribers = self.topics.subscribers(packet.topic)
-        subscribers = self.hooks.modify("on_select_subscribers", subscribers,
-                                        packet)
+        self._fan_out(subscribers, packet)
+
+    def _fan_out(self, subscribers: SubscriberSet, packet: Packet) -> None:
+        """Sync fan-out half (no awaits): shared-group selection + per-
+        subscriber delivery. The trie path calls it directly so a QoS0
+        publish costs no extra coroutine hop."""
+        if self.hooks.overrides("on_select_subscribers"):
+            # matchers alias live Subscription records for speed; a hook
+            # may mutate both the set and its records, so it gets copies
+            subscribers = self.hooks.modify(
+                "on_select_subscribers", subscribers.deep_copy(), packet)
 
         # $share: pick one member per (group, filter), merging per client
         selected: dict[str, Subscription] = {}
@@ -531,6 +563,58 @@ class Broker:
             return
         if sub.no_local and packet.origin == client_id:
             return  # v5 NoLocal [MQTT-3.8.3-3]
+
+        # QoS0 fan-out fast path: when the delivered packet carries no
+        # per-subscriber state (qos 0 out, retain cleared, no v5
+        # subscription ids / aliases) its wire bytes are IDENTICAL for
+        # every such subscriber — encode once per (version, retain) and
+        # enqueue the bytes. Per-message python copy + encode per client
+        # is the dominant e2e cost otherwise. Disabled when any hook
+        # watches the encode/sent events.
+        if (min(packet.fixed.qos, sub.qos, self.capabilities.maximum_qos)
+                == 0 and not client.closed
+                and not (sub.retain_as_published and packet.fixed.retain)
+                and not (client.properties.protocol_version >= 5
+                         and (sub.identifiers or sub.identifier
+                              or client.properties.topic_alias_maximum))
+                and not client.properties.maximum_packet_size
+                and not self.hooks.overrides("on_packet_encode")
+                and not self.hooks.overrides("on_packet_sent")):
+            version = client.properties.protocol_version
+            cache = packet.__dict__.get("_wire0")
+            if cache is None:
+                cache = {}
+                packet.__dict__["_wire0"] = cache
+            wire = cache.get(version)
+            if wire is None:
+                fast = packet.copy()
+                fast.protocol_version = version
+                fast.fixed.qos = 0
+                fast.fixed.dup = False
+                fast.fixed.retain = False
+                fast.packet_id = 0
+                if version >= 5:
+                    fast.properties.subscription_ids = []
+                    fast.properties.topic_alias = None
+                else:
+                    fast.properties = type(fast.properties)()
+                wire = fast.encode()
+                cache[version] = wire
+            if not client.send_wire(wire):
+                self.info.messages_dropped += 1
+                if self.hooks.overrides("on_publish_dropped"):
+                    # hand hooks the delivery-form packet, as the slow
+                    # path does (qos 0, retain cleared, client version)
+                    dropped = packet.copy()
+                    dropped.protocol_version = version
+                    dropped.fixed.qos = 0
+                    dropped.fixed.dup = False
+                    dropped.fixed.retain = False
+                    dropped.packet_id = 0
+                    self.hooks.notify("on_publish_dropped", client,
+                                      dropped)
+            return
+
         out = packet.copy()
         out.protocol_version = client.properties.protocol_version
         out.fixed.qos = min(packet.fixed.qos, sub.qos,
@@ -976,8 +1060,6 @@ class Broker:
     # ------------------------------------------------------------------
 
     async def _restore_from_storage(self) -> None:
-        from ..hooks import storage as st  # local import to avoid cycle
-
         for rec in self.hooks.first_non_empty("stored_clients"):
             client = Client(self, None, None, rec.listener)
             client.id = rec.client_id

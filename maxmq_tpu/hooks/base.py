@@ -152,10 +152,14 @@ class Hooks:
 
     def __init__(self) -> None:
         self._hooks: list[Hook] = []
+        # event -> hooks overriding it; computed once per hook-set change
+        # (dispatch runs several times per packet on the fan-out path)
+        self._override_cache: dict[str, list[Hook]] = {}
 
     def add(self, hook: Hook, config: Any = None) -> Hook:
         hook.init(config)
         self._hooks.append(hook)
+        self._override_cache.clear()
         return hook
 
     def stop_all(self) -> None:
@@ -171,11 +175,18 @@ class Hooks:
     def __len__(self) -> int:
         return len(self._hooks)
 
-    def _overriders(self, event: str):
-        base = getattr(Hook, event)
-        for h in self._hooks:
-            if getattr(type(h), event, base) is not base:
-                yield h
+    def _overriders(self, event: str) -> list[Hook]:
+        lst = self._override_cache.get(event)
+        if lst is None:
+            base = getattr(Hook, event)
+            lst = [h for h in self._hooks
+                   if getattr(type(h), event, base) is not base]
+            self._override_cache[event] = lst
+        return lst
+
+    def overrides(self, event: str) -> bool:
+        """True when any hook implements ``event`` (fast-path gates)."""
+        return bool(self._overriders(event))
 
     def notify(self, event: str, *args) -> None:
         for h in self._overriders(event):

@@ -12,8 +12,7 @@ from __future__ import annotations
 import json
 import sqlite3
 import threading
-import time
-from dataclasses import asdict, dataclass, field
+from dataclasses import asdict, dataclass
 
 from ..protocol.codec import FixedHeader, PacketType as PT
 from ..protocol.packets import Packet

@@ -41,7 +41,7 @@ import jax
 import jax.numpy as jnp
 
 from .nfa import Entry, EntryBuilder
-from .topics import UNK, intern_level, split_levels, tokenize_cached
+from .topics import intern_level, split_levels, tokenize_cached
 from .trie import SubscriberSet, TopicIndex
 
 PLUS = -2    # '+' sentinel in child_tok

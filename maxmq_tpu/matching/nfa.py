@@ -35,7 +35,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..protocol.packets import Subscription
-from .topics import (UNK, intern_level, parse_share, split_levels,
+from .topics import (intern_level, split_levels,
                      tokenize_cached)
 
 MAX_PROBES = 8   # linear-probe bound enforced at build time
@@ -164,7 +164,7 @@ def compile_trie(index, version: int | None = None) -> NFATables:
     return compile_subscriptions(index.all_subscriptions(), version)
 
 
-def compile_subscriptions(subs, version: int = 0,
+def compile_subscriptions(subs, version: int = 0,  # qa: complex
                           table_size: int | None = None,
                           vocab: dict[str, int] | None = None) -> NFATables:
     """Compile a subscription list (as produced by

@@ -51,7 +51,7 @@ from .dense import extract_nonzero_words
 from .nfa import Entry, EntryBuilder
 from .topics import (filter_matches_topic, intern_level, split_levels,
                      tokenize_cached, tokenize_topics)
-from .trie import SubscriberSet, TopicIndex
+from .trie import SubscriberSet, TopicIndex, merge_subscription
 
 MAX_GROUPS = 4096   # compile guard: pathological corpora fall back (engine)
 DEPTH_CAP = 63      # deepest literal level any compiled group may inspect
@@ -165,7 +165,7 @@ def compile_sig(index, version: int | None = None,
                                      vocab=vocab, max_levels=max_levels)
 
 
-def compile_sig_subscriptions(subs, version: int = 0,
+def compile_sig_subscriptions(subs, version: int = 0,  # qa: complex
                               vocab: dict[str, int] | None = None,
                               max_levels: int = 16) -> SigTables:
     """Build signature tables from a subscription snapshot (same input
@@ -892,6 +892,99 @@ def prepare_batch(tables, topics: list[str]):
     return toks, lens_enc, hostrows
 
 
+_STREAM_CHUNK = 1 << 19    # rows per stream-slice fetch (2 MB of uint32).
+                           # Slice bounds are static multiples of this, so
+                           # every slice shape compiles exactly once and
+                           # only the used front of the capacity-padded
+                           # stream ever crosses the link.
+
+
+_VER_PLUS = -1    # '+' level in the verify tables: matches any token
+_VER_ANY = -2     # position past the filter (or past the probe window)
+
+
+def _verify_arrays(tables):
+    """Row-side tables for the vectorized candidate verifier, built once
+    per compiled snapshot (cached): per row, the literal token at each
+    probe-window position (or PLUS/ANY), the required depth, exactness,
+    and the '$'-exclusion flag. Together these reproduce
+    ``topics.filter_matches_topic`` as pure array comparisons."""
+    vt = tables.__dict__.get("_verify_arrays")
+    if vt is not None:
+        return vt
+    n_rows = len(tables.row_levels)
+    window = max(tables.probe_depth, 1)
+    tok = np.full((n_rows, window), _VER_ANY, dtype=np.int32)
+    min_depth = np.zeros(n_rows, dtype=np.int32)
+    exact = np.zeros(n_rows, dtype=bool)
+    wild_first = np.zeros(n_rows, dtype=bool)
+    valid = np.zeros(n_rows, dtype=bool)
+    vocab = tables.vocab
+    for r, levels in enumerate(tables.row_levels):
+        if not levels:
+            continue
+        valid[r] = True
+        is_hash = levels[-1] == "#"
+        depth = len(levels) - 1 if is_hash else len(levels)
+        min_depth[r] = depth
+        exact[r] = not is_hash
+        wild_first[r] = levels[0] in ("+", "#")
+        for i in range(min(depth, window)):
+            lv = levels[i]
+            # a literal never in the vocab cannot exist post-compile; -3
+            # (matches nothing) keeps even that case safe
+            tok[r, i] = _VER_PLUS if lv == "+" else vocab.get(lv, -3)
+    vt = (tok, min_depth, exact, wild_first, valid)
+    tables.__dict__["_verify_arrays"] = vt
+    return vt
+
+
+def _decode_cache(tables):
+    """Per-row fast-path decode arrays (cached per snapshot): for rows
+    whose single entry is a plain (client, sub) with no v5 subscription
+    identifier, the union is two dict ops — no Entry walk, no merge
+    allocation. Rows with shared groups, multiple entries, or
+    identifiers keep the exact slow path."""
+    dc = tables.__dict__.get("_decode_cache")
+    if dc is not None:
+        return dc
+    entries = tables.entries
+    cids: list[str | None] = []
+    subs: list = []
+    for ents in tables.row_entries:
+        if len(ents) == 1:
+            e = entries[ents[0]]
+            if not e.group and e.subscription is not None \
+                    and not e.subscription.identifier \
+                    and not e.subscription.identifiers:
+                cids.append(e.client_id)
+                subs.append(e.subscription)
+                continue
+        cids.append(None)
+        subs.append(None)
+    dc = (cids, subs)
+    tables.__dict__["_decode_cache"] = dc
+    return dc
+
+
+def verify_pairs(tables, toks32, lengths, dollar, ti, rw) -> np.ndarray:
+    """Vectorized ``filter_matches_topic`` over candidate (topic, row)
+    pairs: ok[n] == the exact CPU check for topic ``ti[n]`` vs row
+    ``rw[n]``. All literal filter positions sit inside the probe window
+    (the compile invariant behind ``probe_depth``); positions beyond it
+    are '+'-only and are covered by the depth comparison."""
+    tok, min_depth, exact, wild_first, valid = _verify_arrays(tables)
+    rt = tok[rw]                                  # [N, W]
+    tt = toks32[ti][:, :rt.shape[1]]              # [N, W]
+    ok = ((rt == _VER_ANY) | (rt == _VER_PLUS) | (rt == tt)).all(axis=1)
+    md = min_depth[rw]
+    ln = lengths[ti]
+    ok &= np.where(exact[rw], ln == md, ln >= md)
+    ok &= ~(dollar[ti] & wild_first[rw])
+    ok &= valid[rw]
+    return ok
+
+
 class Overlay:
     """Host-side view of subscription mutations newer than the compiled
     tables, replayed from the TopicIndex journal.
@@ -1055,6 +1148,8 @@ class SigEngine(OverlayedEngine):
         self._refresh_lock = threading.Lock()
         self.fallbacks = 0
         self.matches = 0
+        # rows-count hint for the stream prefetch (see dispatch_fixed)
+        self._stream_rows_hint = _STREAM_CHUNK
         self._init_overlay()
         self.refresh(force=True)
 
@@ -1271,24 +1366,41 @@ class SigEngine(OverlayedEngine):
             out = self.dispatch_fixed(topics)
         # unpack with the SAME snapshot the dispatch used — a concurrent
         # refresh() must never pair a new format with an old result
-        out, hostrows, tables, fmt = out
-        o = np.asarray(out)
+        out, hostrows, tables, fmt = out[:4]
         kind = fmt["kind"]
-        if kind == "packed":
-            eb = fmt["enc_bits"]
+        if kind == "stream":
+            # counts + compacted row stream (the Pallas path's wire
+            # format): the counts and the hint-predicted front of the
+            # stream were already fetched asynchronously at dispatch
+            # time; only a hint shortfall costs a synchronous slice here.
+            # 255 = overflow sentinel -> 15, the fixed-path convention.
+            counts_dev, stream_dev, slices = out
             kr = fmt["max_rows"]
-            cnt = (o[:, 0] & 0xF).astype(np.int32)
-            o64 = o.astype(np.uint64)
-            rows = np.empty((len(o), kr), dtype=np.uint32)
-            bitpos = 4
-            for k in range(kr):
-                lane, off = divmod(bitpos, 32)
-                v = o64[:, lane] >> np.uint64(off)
-                if off + eb > 32:
-                    v |= o64[:, lane + 1] << np.uint64(32 - off)
-                rows[:, k] = v.astype(np.uint32) & np.uint32((1 << eb) - 1)
-                bitpos += eb
-        elif kind == "fmt16":
+            cnt_u8 = np.asarray(counts_dev)
+            cnt = np.where(cnt_u8 == 0xFF, 15, cnt_u8).astype(np.int32)
+            real = np.where(cnt_u8 == 0xFF, 0, cnt_u8).astype(np.int64)
+            total = int(real.sum())
+            # EMA hint for the next dispatch's prefetch (~1.25x headroom)
+            self._stream_rows_hint = (self._stream_rows_hint
+                                      + total + total // 4) // 2
+            rows = np.full((len(cnt), kr), 0xFFFFFFFF, dtype=np.uint32)
+            if total:
+                have = sum(s.shape[0] for s in slices)
+                parts = [np.asarray(s) for s in slices]
+                c0 = have
+                cap = stream_dev.shape[0]
+                while c0 < total:
+                    n = min(_STREAM_CHUNK, cap - c0)
+                    parts.append(np.asarray(stream_dev[c0:c0 + n]))
+                    c0 += n
+                flat = parts[0] if len(parts) == 1 else np.concatenate(
+                    parts)
+                mask = np.arange(kr, dtype=np.int64)[None, :] \
+                    < real[:, None]
+                rows[mask] = flat[:total]
+            return cnt, rows, hostrows, tables
+        o = np.asarray(out)
+        if kind == "fmt16":
             cnt = (o[:, 0] >> 28).astype(np.int32)
             row16 = [o[:, 0] & 0xFFFF]
             for c in range(1, o.shape[1]):
@@ -1313,11 +1425,32 @@ class SigEngine(OverlayedEngine):
                 "device matching disabled for this corpus "
                 f"(> {MAX_GROUPS} signature groups); use the subscribers_* "
                 "APIs, which fall back to the CPU trie")
-        tables, fn_fixed, fmt16 = state[0], state[6], state[7]
+        tables, fn_fixed, fmt = state[0], state[6], state[7]
         toks8, lens_enc, hostrows = prepare_batch(tables, topics)
         # both fixed-path programs are jitted and device_put numpy inputs
         out = fn_fixed(toks8, lens_enc)
-        return out, hostrows, tables, fmt16
+        if fmt["kind"] == "stream":
+            # start the device->host copies NOW so they ride the link
+            # while the host preps the next batch and the device chews on
+            # it: counts always, plus the stream slices a rows-count hint
+            # (EMA of recent batches) predicts will be needed. A short
+            # hint costs one synchronous slice fetch at unpack time.
+            counts_dev, stream_dev = out
+            counts_dev.copy_to_host_async()
+            cap = stream_dev.shape[0]
+            hint = min(self._stream_rows_hint, cap)
+            slices = []
+            c0 = 0
+            while c0 < hint or not slices:
+                n = min(_STREAM_CHUNK, cap - c0)
+                if n <= 0:
+                    break
+                s = stream_dev[c0:c0 + n]
+                s.copy_to_host_async()
+                slices.append(s)
+                c0 += n
+            out = (counts_dev, stream_dev, slices)
+        return out, hostrows, tables, fmt, toks8, lens_enc
 
     def _trie_batch(self, topics: list[str]) -> list[SubscriberSet] | None:
         """CPU-trie fallback for corpora the compiler declined
@@ -1332,31 +1465,117 @@ class SigEngine(OverlayedEngine):
 
     def subscribers_fixed_batch(self, topics: list[str]
                                 ) -> list[SubscriberSet]:
-        """subscribers_batch over the fixed-slot path."""
+        """subscribers_batch over the fixed-slot path.
+
+        Decode is batch-vectorized: every candidate (topic, row) pair —
+        device slots and host-probe hits together — is verified in ONE
+        numpy pass (``verify_pairs``); the python loop then only unions
+        the verified rows' entries, with no per-row filter walk. This is
+        the fan-out-rate-critical half the device cannot do."""
         cpu = self._trie_batch(topics)
         if cpu is not None:
             return cpu
         try:
-            cnt, rows, hostrows, tables = self.match_fixed(topics)
+            ctx = self.dispatch_fixed(topics)
         except RuntimeError:     # state swapped to trie-only mid-call
             return self._resync_batch(topics)
+        return self.collect_fixed(topics, ctx)
+
+    def collect_fixed(self, topics: list[str], ctx) -> list[SubscriberSet]:
+        """Decode half of the fixed-slot path: fetch + batch-verify +
+        entry union for a previously dispatched batch."""
+        cnt, rows, hostrows, tables = self.match_fixed([], out=ctx)
+        toks8, lens_enc = ctx[4], ctx[5]
         overlay = self.overlay_for(tables.version)
         if overlay == "resync":
             return self._resync_batch(topics)
         removed = overlay.removed if overlay else None
-        out = []
+
+        batch = len(topics)
+        self.matches += batch
+        lengths = np.abs(lens_enc.astype(np.int32))
+        dollar = lens_enc < 0
+        dtype, pad = _compact_dtype(tables)
+        toks32 = toks8.astype(np.int32)
+        if dtype is not np.int32:
+            toks32[toks32 == pad] = -1
+
+        fall = cnt == 15
+        kr = rows.shape[1]
+        real = np.where(fall, 0, cnt).astype(np.int64)
+        dmask = np.arange(kr, dtype=np.int64)[None, :] < real[:, None]
+        ti_dev = np.repeat(np.arange(batch), real)
+        rw_dev = rows[dmask].astype(np.int64)
+        if isinstance(hostrows, HostRows):
+            offs = hostrows.offsets[:batch + 1]
+            ti_h = np.repeat(np.arange(batch), np.diff(offs))
+            rw_h = hostrows.rows[:offs[-1]].astype(np.int64)
+        else:
+            ti_h = np.repeat(np.arange(batch),
+                             [len(h) for h in hostrows[:batch]])
+            rw_h = (np.concatenate([np.asarray(h) for h in
+                                    hostrows[:batch]]).astype(np.int64)
+                    if len(ti_h) else np.empty(0, dtype=np.int64))
+        ti = np.concatenate([ti_dev, ti_h])
+        rw = np.concatenate([rw_dev, rw_h])
+        keep = ~fall[ti] & (rw < len(tables.row_levels))
+        ti, rw = ti[keep], rw[keep]
+        ok = verify_pairs(tables, toks32, lengths, dollar, ti, rw)
+        ti, rw = ti[ok], rw[ok]
+
+        out = [SubscriberSet() for _ in range(batch)]
+        entries = tables.entries
+        row_entries = tables.row_entries
+        fast_cid, fast_sub = _decode_cache(tables)
+        if removed is None:
+            # hot loop: verified rows only, fast-path rows are two dict
+            # ops (merge_subscription aliases the stored Subscription)
+            dicts = [s.subscriptions for s in out]
+            merge = merge_subscription
+            for t, r in zip(ti.tolist(), rw.tolist()):
+                cid = fast_cid[r]
+                if cid is not None:
+                    d = dicts[t]
+                    sub = fast_sub[r]
+                    cur = d.get(cid)
+                    d[cid] = sub if cur is None else merge(cur, sub,
+                                                           sub.filter)
+                    continue
+                result = out[t]
+                for b in row_entries[r]:
+                    entry = entries[b]
+                    if entry.group:
+                        for cid, sub in entry.candidates.items():
+                            result.add_shared(entry.group, sub.filter,
+                                              cid, sub)
+                    else:
+                        sub = entry.subscription
+                        result.add(entry.client_id, sub, sub.filter)
+        else:
+            for t, r in zip(ti.tolist(), rw.tolist()):
+                result = out[t]
+                for b in row_entries[r]:
+                    entry = entries[b]
+                    if entry.group:
+                        for cid, sub in entry.candidates.items():
+                            if (cid, sub.filter) in removed:
+                                continue
+                            result.add_shared(entry.group, sub.filter,
+                                              cid, sub)
+                    else:
+                        sub = entry.subscription
+                        if (entry.client_id, sub.filter) in removed:
+                            continue
+                        result.add(entry.client_id, sub, sub.filter)
+
+        res = []
         for i, topic in enumerate(topics):
-            self.matches += 1
-            if cnt[i] == 15:
+            if fall[i]:
                 self.fallbacks += 1
-                out.append(self.index.subscribers(topic))
-                continue
-            result = self.decode_rows(topic, rows[i, :cnt[i]], tables,
-                                      removed=removed)
-            self.decode_rows(topic, hostrows[i], tables, into=result,
-                             removed=removed)
-            out.append(self.merge_delta(topic, result, overlay))
-        return out
+                res.append(self.index.subscribers(topic))
+            else:
+                res.append(self.merge_delta(topic, out[i], overlay))
+        return res
 
     def _resync_batch(self, topics: list[str]) -> list[SubscriberSet]:
         """The journal no longer reaches the compiled tables (mutation

@@ -231,15 +231,16 @@ def _run_chunk_select(kern, sig, flag, grp_c, planes_c, tb, g_pad, chunk,
 
 def build_fixed_fn(tables: SigTables, consts: dict, kplan: dict,
                    max_rows: int):
-    """(jit(toks8, lens_enc) -> bitpacked fixed slots, format descriptor)
-    via the fused chunk kernels + XLA merge — one device dispatch per
-    batch.
+    """(jit(toks8, lens_enc) -> (counts_u8, row stream), format
+    descriptor) via the fused chunk kernels + XLA merge — one device
+    dispatch per batch.
 
     ``consts`` are the engine's device constants (for the [B, G] signature
     prologue, which stays in XLA — it is tiny). The expansion one-hot and
     bit-plane tables are sliced per chunk and baked as kernel operands.
-    The wire format is the dense "packed" form (see the pack step below);
-    sig.py's unpack switches on the descriptor."""
+    The wire format is "stream": one uint8 count per topic plus the
+    matched row ids compacted in topic order (see the compaction step
+    below); sig.py's unpack switches on the descriptor."""
     w_pad, g_pad, tb = kplan["w_pad"], kplan["g_pad"], kplan["tb"]
     chunk, n_chunks = kplan["chunk"], kplan["n_chunks"]
     n_words = kplan["n_words"]
@@ -340,33 +341,25 @@ def build_fixed_fn(tables: SigTables, consts: dict, kplan: dict,
                                  jnp.uint32(0xFFFFFFFF), cand)
             rows_sorted = jnp.stack(merged, axis=1)
 
-        cnt = jnp.where(overflow, jnp.uint32(0xF),
-                        jnp.minimum(counts, max_rows).astype(jnp.uint32))
-        # dense bitpack: [4-bit count][max_rows x enc_bits rows] across
-        # uint32 lanes — the fetch crosses a narrow host link, so the
-        # wire format is sized by the actual encoding width, not by u32
-        # slots (~12B/topic at 1M subscriptions vs 60B unpacked)
-        lanes = [cnt]
-        lane_fill = 4
-        for k in range(max_rows):
-            r = jnp.where(rows_sorted[:, k] == jnp.uint32(0xFFFFFFFF),
-                          jnp.uint32((1 << enc_bits) - 1),
-                          rows_sorted[:, k])
-            if lane_fill == 32:
-                lanes.append(jnp.zeros_like(cnt))
-                lane_fill = 0
-            if lane_fill:
-                lanes[-1] = lanes[-1] | (r << jnp.uint32(lane_fill))
-            else:
-                lanes[-1] = lanes[-1] | r
-            spill = lane_fill + enc_bits - 32
-            if spill > 0:
-                lanes.append(r >> jnp.uint32(enc_bits - spill))
-                lane_fill = spill
-            else:
-                lane_fill += enc_bits
-        packed = jnp.stack(lanes, axis=1)
-        return packed[:batch]
+        # stream compaction: the fetch crosses a narrow host link (and a
+        # ~60ms-latency tunnel in this rig), so the wire format is ONE
+        # uint8 count per topic plus the matched row ids concatenated in
+        # topic order — ~1 + 4*matches bytes/topic instead of max_rows
+        # mostly-empty fixed slots. The host fetches the counts, sums
+        # them, and fetches only the used front of the stream.
+        counts_real = jnp.where(overflow, 0, counts)
+        counts_u8 = jnp.where(
+            overflow, jnp.uint32(0xFF),
+            jnp.minimum(counts, max_rows).astype(jnp.uint32)
+        ).astype(jnp.uint8)
+        offs = jnp.cumsum(counts_real) - counts_real        # exclusive
+        kidx = jnp.arange(max_rows, dtype=jnp.int32)[None, :]
+        valid = kidx < counts_real[:, None]
+        cap = rows_sorted.shape[0] * max_rows
+        pos = jnp.where(valid, offs[:, None] + kidx, cap)
+        stream = jnp.zeros((cap,), jnp.uint32).at[
+            pos.reshape(-1)].set(rows_sorted.reshape(-1), mode="drop")
+        return counts_u8[:batch], stream
 
-    return fn, {"kind": "packed", "enc_bits": enc_bits,
+    return fn, {"kind": "stream", "enc_bits": enc_bits,
                 "max_rows": max_rows}

@@ -36,6 +36,11 @@ def merge_subscription(base: Subscription | None, new: Subscription,
 
     Parity: packets.go:250-270 (Subscription.Merge) in the reference.
     """
+    if base is None and not new.identifier and not new.identifiers:
+        # single matching filter, no v5 subscription identifier — the
+        # overwhelmingly common fan-out case: no copy needed (consumers
+        # never mutate the returned Subscription)
+        return new
     merged = Subscription(
         filter=new.filter, qos=new.qos, no_local=new.no_local,
         retain_as_published=new.retain_as_published,
@@ -66,6 +71,24 @@ class SubscriberSet:
     def add(self, client_id: str, sub: Subscription, filter_: str) -> None:
         self.subscriptions[client_id] = merge_subscription(
             self.subscriptions.get(client_id), sub, filter_)
+
+    def deep_copy(self) -> "SubscriberSet":
+        """Copies of every Subscription record. Matching aliases stored
+        Subscription objects for speed; hand a hook that may mutate
+        delivery parameters this copy, never the originals."""
+        from ..protocol.packets import Subscription as S
+
+        def cp(s: Subscription) -> Subscription:
+            return S(filter=s.filter, qos=s.qos, no_local=s.no_local,
+                     retain_as_published=s.retain_as_published,
+                     retain_handling=s.retain_handling,
+                     identifier=s.identifier,
+                     identifiers=dict(s.identifiers))
+
+        return SubscriberSet(
+            subscriptions={c: cp(s) for c, s in self.subscriptions.items()},
+            shared={k: {c: cp(s) for c, s in m.items()}
+                    for k, m in self.shared.items()})
 
     def add_shared(self, group: str, filter_: str, client_id: str,
                    sub: Subscription) -> None:

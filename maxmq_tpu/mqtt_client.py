@@ -9,7 +9,6 @@ from __future__ import annotations
 import asyncio
 from dataclasses import dataclass, field
 
-from .protocol import codes
 from .protocol.codec import FixedHeader, PacketType as PT
 from .protocol.packets import Packet, Subscription, Will, parse_stream
 from .protocol.properties import Properties

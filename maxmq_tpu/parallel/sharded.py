@@ -101,7 +101,7 @@ def compile_sig_shards(subs, n_shards: int, version: int):
 def _sharded_sig_match(tables_dev, toks, lens_enc, *, sel_blocks, max_rows):
     """Runs INSIDE shard_map: this device's signature-table shard (leading
     axis of length 1, squeezed) over the local batch slice."""
-    from ..matching.sig import (adjusted_signatures, fixed_slots_from_words,
+    from ..matching.sig import (fixed_slots_from_words,
                                 sig_match_words_gather)
 
     topo_coef, depth_coef, min_depth, is_hash, wild_first, planes, grp = (
@@ -172,7 +172,8 @@ class ShardedSigEngine(OverlayedEngine):
             if any(len(t.groups) > MAX_GROUPS for t in shards):
                 # pathological corpus: serve exactly via the CPU trie
                 # (same discipline as SigEngine.refresh)
-                self._state = (version, shards, None, None, 0, {})
+                self._state = (version, shards, None, None, 0, {},
+                               self.dp)
                 return True
 
             # pad per-shard tables to common shapes and stack on 'subs'.
@@ -228,7 +229,11 @@ class ShardedSigEngine(OverlayedEngine):
             union_exact = {}
             for t in shards:
                 union_exact.update(t.host_exact or {})
-            self._state = (version, shards, dev, fn, d_max, union_exact)
+            # dp rides in the state tuple: a concurrent match must pad
+            # with the SAME data-axis factor the compiled fn expects,
+            # even while reshard() is swapping meshes
+            self._state = (version, shards, dev, fn, d_max, union_exact,
+                           self.dp)
             return True
 
     # ------------------------------------------------------------------
@@ -240,14 +245,14 @@ class ShardedSigEngine(OverlayedEngine):
                                     host_plus_rows, prepare_batch_sig)
 
         self.refresh_soon()
-        _version, shards, dev, fn, d_max, union_exact = self._state
+        _version, shards, dev, fn, d_max, union_exact, dp = self._state
         if fn is None:
             raise RuntimeError(
                 "device matching disabled for this corpus (> MAX_GROUPS "
                 "wildcard shapes in a shard); use subscribers_*, which "
                 "fall back to the CPU trie")
         batch = len(topics)
-        padded = -(-batch // self.dp) * self.dp
+        padded = -(-batch // dp) * dp
         padded_topics = topics + ["\x01pad"] * (padded - batch)
         # shared intern pool => identical tokens for every shard; one host
         # tokenize pass serves every shard's exact + '+'-shape probes
@@ -310,6 +315,20 @@ class ShardedSigEngine(OverlayedEngine):
 
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(None, self.subscribers, topic)
+
+    def reshard(self, mesh: Mesh) -> None:
+        """Elastic recovery: re-partition + recompile over a NEW mesh
+        (e.g. after losing devices). Matching stays exact throughout —
+        callers racing the swap use whichever complete state they hold,
+        and the state tuple pairs shards with their compiled fn
+        atomically (the reference's cluster design has no live story for
+        this; its Route Table rebuild is the moral equivalent,
+        docs/system-design.md:201-231)."""
+        with self._refresh_lock:
+            self.mesh = mesh
+            self.dp = mesh.shape["data"]
+            self.sp = mesh.shape["subs"]
+        self.refresh(force=True)
 
 
 class ShardedNFAEngine:

@@ -204,9 +204,10 @@ class FixedHeader:
             fh.retain = bool(flags & 0x1)
             if fh.qos == 3:
                 raise MalformedPacketError("publish qos 3 is malformed")
-            if fh.dup and fh.qos == 0:
-                raise MalformedPacketError(
-                    "publish dup with qos 0 is malformed")  # [MQTT-3.3.1-2]
+            # dup with qos 0 violates the SENDER requirement [MQTT-3.3.1-2]
+            # but the receive side tolerates it, as the reference does
+            # (tpackets.go TPublishDup is a pass case); the broker clears
+            # dup on forward regardless
         else:
             required = _FLAGS_REQUIRED.get(ptype)
             if required is None:

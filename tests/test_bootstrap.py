@@ -7,7 +7,6 @@ cancellable context and asserts the profile files are written."""
 from __future__ import annotations
 
 import asyncio
-import json
 import urllib.request
 
 from maxmq_tpu.bootstrap import (build_broker, capabilities_from_config,

@@ -14,7 +14,6 @@ from maxmq_tpu.protocol.codec import (
     read_varint,
     valid_utf8_string,
     varint_len,
-    write_binary,
     write_string,
     write_uint16,
     write_uint32,

@@ -160,7 +160,6 @@ def test_tokenize_sig_parity_with_python():
     from maxmq_tpu import native
     from maxmq_tpu.matching import TopicIndex
     from maxmq_tpu.matching.sig import (compile_sig, host_exact_rows,
-                                        host_exact_rows_from_sig,
                                         prepare_batch, tokenize_compact)
     from maxmq_tpu.protocol import Subscription
 

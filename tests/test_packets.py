@@ -369,9 +369,12 @@ def test_auth_rejected_pre_v5():
         dec("f000", version=4)
 
 
-def test_publish_dup_qos0_malformed():
-    with pytest.raises(MalformedPacketError):
-        dec("38050003616263")  # dup=1, qos=0
+def test_publish_dup_qos0_tolerated():
+    # dup with qos 0 violates the sender requirement [MQTT-3.3.1-2] but
+    # the receive side tolerates it, like the reference (tpackets.go
+    # TPublishDup decodes cleanly)
+    p = dec("38050003616263")  # dup=1, qos=0
+    assert p.fixed.dup and p.fixed.qos == 0 and p.topic == "abc"
 
 
 def test_publish_empty_topic_with_alias_ok_v5():

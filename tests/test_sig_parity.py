@@ -342,7 +342,6 @@ def test_overlay_serves_mutations_without_recompile():
 
 
 def test_overlay_journal_gap_resyncs_via_trie(monkeypatch):
-    import maxmq_tpu.matching.trie as triemod
     idx = TopicIndex()
     idx.subscribe("c1", Subscription(filter="a/b"))
     engine = _frozen_engine(idx)
@@ -404,6 +403,42 @@ def test_compact_max_rows_validated():
         SigEngine(idx, compact_max_rows=255)
     with pytest.raises(ValueError):
         SigEngine(idx, compact_max_rows=0)
+
+
+def test_decode_rate_unit_bench():
+    """VERDICT r1 #6: row -> SubscriberSet decode must sustain >= 1M
+    rows/s — the per-delivery half that bounds fan-out no matter how
+    fast the device matches. The batch path verifies all candidate pairs
+    in one numpy pass and only unions verified entries in python."""
+    import time
+
+    rng = random.Random(5)
+    alphabet = [f"s{i}" for i in range(50)]
+    idx = TopicIndex()
+    n = 20_000
+    for i in range(n):
+        depth = rng.randint(2, 6)
+        levels = [rng.choice(alphabet) for _ in range(depth)]
+        r = rng.random()
+        if r < 0.3:
+            levels[rng.randrange(depth)] = "+"
+        elif r < 0.45:
+            levels = levels[: rng.randint(1, depth)] + ["#"]
+        idx.subscribe(f"c{i}", Subscription(filter="/".join(levels)))
+    engine = SigEngine(idx, auto_refresh=False)
+    topics = ["/".join(rng.choice(alphabet)
+                       for _ in range(rng.randint(2, 6)))
+              for _ in range(4096)]
+    ctx = engine.dispatch_fixed(topics)
+    got = engine.collect_fixed(topics, ctx)               # warm tables
+    rows = sum(len(s.subscriptions) + len(s.shared) for s in got)
+    best = 0.0
+    for _ in range(5):                      # best-of: capability, not
+        t0 = time.perf_counter()            # current machine load
+        engine.collect_fixed(topics, ctx)   # fetch + verify + union only
+        best = max(best, rows / (time.perf_counter() - t0))
+    assert rows > 4096, "corpus produced too few matches to measure"
+    assert best >= 1_000_000, f"decode rate {best:,.0f} rows/s < 1M"
 
 
 def test_retained_churn_never_recompiles():

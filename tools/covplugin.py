@@ -1,0 +1,46 @@
+"""pytest plugin: line-coverage collector on ``sys.monitoring`` (PEP 669).
+
+Loaded by ``python tools/qa.py coverage`` via ``-p tools.covplugin``.
+Records executed lines of files under ``maxmq_tpu/``; every monitored
+location is disabled after its first hit (``sys.monitoring.DISABLE``), so
+the steady-state overhead is near zero — unlike ``trace``'s pure-Python
+tracer, the suite runs at close to full speed.
+
+Writes ``{path: [lines]}`` JSON to ``$MAXMQ_COV_OUT`` at session finish.
+Subprocesses (spawned brokers) are not instrumented; the system tests
+drive in-process brokers, so the hot paths are all visible.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+_PREFIX = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "maxmq_tpu")
+_executed: dict[str, set[int]] = {}
+_TOOL = sys.monitoring.COVERAGE_ID
+
+
+def _on_line(code, line):
+    fname = code.co_filename
+    if fname.startswith(_PREFIX):
+        _executed.setdefault(fname, set()).add(line)
+    return sys.monitoring.DISABLE
+
+
+def pytest_configure(config):
+    sys.monitoring.use_tool_id(_TOOL, "maxmq-qa-coverage")
+    sys.monitoring.register_callback(
+        _TOOL, sys.monitoring.events.LINE, _on_line)
+    sys.monitoring.set_events(_TOOL, sys.monitoring.events.LINE)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    sys.monitoring.set_events(_TOOL, 0)
+    out = os.environ.get("MAXMQ_COV_OUT")
+    if out:
+        with open(out, "w", encoding="utf-8") as fh:
+            json.dump({k: sorted(v) for k, v in _executed.items()}, fh)

@@ -1,0 +1,175 @@
+"""Extract the reference's packet-conformance corpus into JSON fixtures.
+
+The reference vendors a 3,865-line table of golden wire vectors —
+``vendor/github.com/mochi-co/mqtt/v2/packets/tpackets.go`` — covering
+every MQTT packet type in every protocol version, including dozens of
+malformed variants. The *data* (wire bytes + expected outcome) is the
+conformance surface; this script parses the Go literals mechanically and
+writes ``tests/fixtures/tpackets.json`` for the table-driven replay test
+(tests/test_tpackets.py). Run it only to regenerate the fixture file:
+
+    python tools/port_tpackets.py
+
+Each fixture: {ptype, case, desc, primary, raw (hex), fail_first,
+expect, protocol_version}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+
+SRC = ("/root/reference/vendor/github.com/mochi-co/mqtt/v2/packets/"
+       "tpackets.go")
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))), "tests", "fixtures", "tpackets.json")
+
+# packet-type constants from the reference's packets.go
+TYPES = {name: i + 1 for i, name in enumerate(
+    ["Connect", "Connack", "Publish", "Puback", "Pubrec", "Pubrel",
+     "Pubcomp", "Subscribe", "Suback", "Unsubscribe", "Unsuback",
+     "Pingreq", "Pingresp", "Disconnect", "Auth"])}
+TYPES["WillProperties"] = 0   # pseudo-type used for will-props sub-tests
+
+# reason-code constants referenced as `X.Code` inside RawBytes
+# (values from the reference's packets/codes.go)
+CODES = {
+    "CodeSuccess": 0x00, "CodeDisconnect": 0x00, "CodeGrantedQos0": 0x00,
+    "CodeGrantedQos2": 0x02, "CodeNoMatchingSubscribers": 0x10,
+    "CodeNoSubscriptionExisted": 0x11, "ErrUnspecifiedError": 0x80,
+    "ErrProtocolViolation": 0x82,
+    "ErrProtocolViolationProtocolVersion": 0x82,
+    "ErrProtocolViolationSecondConnect": 0x82,
+    "ErrProtocolViolationZeroNonZeroExpiry": 0x82,
+    "ErrProtocolViolationInvalidSharedNoLocal": 0x82,
+    "ErrClientIdentifierNotValid": 0x85,
+    "ErrBadUsernameOrPassword": 0x86, "ErrNotAuthorized": 0x87,
+    "ErrServerUnavailable": 0x88, "ErrServerShuttingDown": 0x8B,
+    "ErrSessionTakenOver": 0x8E, "ErrTopicFilterInvalid": 0x8F,
+    "ErrPacketIdentifierInUse": 0x91,
+    "ErrPacketIdentifierNotFound": 0x92, "ErrReceiveMaximum": 0x93,
+    "ErrConnectionRateExceeded": 0x9F, "Err3NotAuthorized": 0x05,
+}
+
+
+def _eval_byte_expr(expr: str) -> int:
+    """Evaluate one Go byte expression: ints, hex, char literals, type
+    names, shifts/ors (e.g. ``Connect << 4 | 1<<1``)."""
+    expr = expr.strip()
+    expr = re.sub(r"'(.)'", lambda m: str(ord(m.group(1))), expr)
+    expr = re.sub(r"\b(\w+)\.Code\b",
+                  lambda m: str(CODES[m.group(1)]), expr)
+    for name, val in TYPES.items():
+        expr = re.sub(rf"\b{name}\b", str(val), expr)
+    if not re.fullmatch(r"[0-9a-fA-FxX<>|&+\-*() ]+", expr):
+        raise ValueError(f"unsafe byte expr: {expr!r}")
+    return eval(expr, {"__builtins__": {}}) & 0xFF  # noqa: S307 (sanitized)
+
+
+def _strip_comment(line: str) -> str:
+    # careful: '/' appears inside char literals like '/'
+    out = []
+    i = 0
+    while i < len(line):
+        if line[i] == "'" and i + 2 < len(line) and line[i + 2] == "'":
+            out.append(line[i:i + 3])
+            i += 3
+            continue
+        if line.startswith("//", i):
+            break
+        out.append(line[i])
+        i += 1
+    return "".join(out)
+
+
+def parse() -> list[dict]:
+    with open(SRC, encoding="utf-8") as fh:
+        lines = fh.read().split("\n")
+    # find the data map
+    start = next(i for i, ln in enumerate(lines)
+                 if ln.startswith("var TPacketData"))
+    cases: list[dict] = []
+    ptype = None
+    cur: dict | None = None
+    raw: list[int] | None = None
+    depth = 0
+    for ln in lines[start + 1:]:
+        stripped = _strip_comment(ln).strip()
+        if not stripped:
+            continue
+        m = re.match(r"^(\w+): \{$", ln.strip())
+        if m and ln.startswith("\t") and not ln.startswith("\t\t") \
+                and m.group(1) in TYPES:
+            ptype = m.group(1)
+            continue
+        if stripped == "{" and cur is None:
+            cur = {"ptype": TYPES[ptype], "ptype_name": ptype,
+                   "primary": False, "fail_first": None, "expect": None,
+                   "protocol_version": None, "group": ""}
+            depth = 1
+            raw = None
+            continue
+        if cur is None:
+            continue
+        depth += stripped.count("{") - stripped.count("}")
+        if raw is not None:
+            # inside RawBytes until its closing brace
+            if stripped.startswith("}"):
+                cur["raw"] = bytes(raw).hex()
+                raw = None
+            else:
+                # convert char literals first: a literal ',' would break
+                # the comma split below
+                numeric = re.sub(r"'(.)'", lambda m: str(ord(m.group(1))),
+                                 stripped)
+                for part in numeric.split(","):
+                    part = part.strip()
+                    if part:
+                        raw.append(_eval_byte_expr(part))
+            if depth == 0:
+                cases.append(cur)
+                cur = None
+            continue
+        if depth <= 0:
+            if "raw" in cur:
+                cases.append(cur)
+            cur = None
+            continue
+        if m := re.match(r"Case:\s*(\w+),", stripped):
+            cur["case"] = m.group(1)
+        elif m := re.match(r'Desc:\s*"(.*)",', stripped):
+            cur["desc"] = m.group(1)
+        elif re.match(r"Primary:\s*true,", stripped):
+            cur["primary"] = True
+        elif m := re.match(r'Group:\s*"(.*)",', stripped):
+            cur["group"] = m.group(1)
+        elif m := re.match(r"FailFirst:\s*(\w+),", stripped):
+            cur["fail_first"] = m.group(1)
+        elif m := re.match(r"Expect:\s*(\w+),", stripped):
+            cur["expect"] = m.group(1)
+        elif m := re.match(r"ProtocolVersion:\s*(\d+),", stripped):
+            cur["protocol_version"] = int(m.group(1))
+        elif re.match(r"RawBytes:\s*\[\]byte\{$", stripped):
+            raw = []
+        elif m := re.match(r"RawBytes:\s*\[\]byte\{(.+)\},$", stripped):
+            raw_inline = [
+                _eval_byte_expr(p) for p in m.group(1).split(",")
+                if p.strip()]
+            cur["raw"] = bytes(raw_inline).hex()
+    return cases
+
+
+def main() -> None:
+    cases = parse()
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w", encoding="utf-8") as fh:
+        json.dump(cases, fh, indent=1)
+    n_fail = sum(1 for c in cases if c["fail_first"])
+    n_primary = sum(1 for c in cases if c["primary"])
+    print(f"{len(cases)} cases -> {OUT} "
+          f"({n_primary} primary, {n_fail} fail-first)")
+
+
+if __name__ == "__main__":
+    main()
