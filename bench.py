@@ -148,6 +148,13 @@ def bench_config(name: str, n_subs: int, batch: int, iters: int,
     t0 = time.perf_counter()
     engine = SigEngine(index, auto_refresh=False, **engine_kw)
     compile_s = time.perf_counter() - t0
+    if not engine.pallas_active and n_subs > 300_000 and batch > 32_768:
+        # the XLA fixed body materializes a [batch, words] matrix in HBM;
+        # without the Pallas kernels a large-corpus run must clamp the
+        # batch or OOM (LOUDLY — a silent clamp hid this in round 1)
+        log(f"[{name}] WARNING: Pallas plan declined; clamping batch "
+            f"{batch} -> 32768 for the XLA fallback")
+        batch = 32_768
     batches = [topic_gen(batch, seed2=100 + i) for i in range(iters)]
 
     run_sig(engine, batches[:1], depth)          # warm compile + slices

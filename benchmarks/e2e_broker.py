@@ -26,7 +26,7 @@ import time
 
 
 async def run_client(i: int, host: str, port: int, messages: int,
-                     payload: bytes, results: list):
+                     payload: bytes, results: list, raw_drain: bool):
     from maxmq_tpu.mqtt_client import MQTTClient
 
     c = MQTTClient(client_id=f"stress-{i}")
@@ -39,14 +39,40 @@ async def run_client(i: int, host: str, port: int, messages: int,
         await c.publish(topic, payload)
     pub_dt = time.perf_counter() - t0
 
-    got = 0
     t0 = time.perf_counter()
-    while got < messages:
-        await c.next_message(timeout=30)
-        got += 1
+    if raw_drain:
+        # count PUBLISH frames straight off the socket: measures BROKER
+        # delivery capacity, not this python client's per-message decode
+        reader = c.reader
+        buf = bytearray(await c.pause_reading())
+        got = c.messages.qsize()        # parsed before the pause
+        while got < messages:
+            got += _count_publish_frames(buf)
+            if got >= messages:
+                break
+            chunk = await asyncio.wait_for(reader.read(1 << 16), 30)
+            if not chunk:
+                break
+            buf.extend(chunk)
+    else:
+        got = 0
+        while got < messages:
+            await c.next_message(timeout=30)
+            got += 1
     recv_dt = time.perf_counter() - t0
-    await c.disconnect()
+    try:
+        await c.disconnect()
+    except Exception:
+        pass
     results.append((messages / pub_dt, messages / recv_dt))
+
+
+def _count_publish_frames(buf: bytearray) -> int:
+    """Consume complete frames from ``buf``, returning the PUBLISH count
+    (frames without per-message Packet.decode — the codec's own framer)."""
+    from maxmq_tpu.protocol.packets import parse_stream
+
+    return sum(1 for fh, _body in parse_stream(buf) if fh.type == 3)
 
 
 async def run_fanout(host: str, port: int, subscribers: int,
@@ -91,6 +117,10 @@ async def main() -> None:
     ap.add_argument("--fanout", type=int, default=0,
                     help="N: run the 1-publisher/N-subscriber fan-out "
                          "scenario instead of mqtt-stresser 1:1")
+    ap.add_argument("--raw-drain", action="store_true",
+                    help="count received PUBLISH frames off the raw "
+                         "socket (broker capacity, not python-client "
+                         "decode rate)")
     ap.add_argument("--host", default=None,
                     help="external broker host (default: in-process)")
     ap.add_argument("--port", type=int, default=1883)
@@ -140,7 +170,7 @@ async def main() -> None:
     results: list[tuple[float, float]] = []
     t0 = time.perf_counter()
     await asyncio.gather(*(run_client(i, host, port, args.messages,
-                                      payload, results)
+                                      payload, results, args.raw_drain)
                            for i in range(args.clients)))
     wall = time.perf_counter() - t0
     if broker is not None:

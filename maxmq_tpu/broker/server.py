@@ -460,10 +460,8 @@ class Broker:
         self.hooks.notify("on_published", client, packet)
 
     def _match_cached(self, topic: str) -> SubscriberSet:
-        if self.hooks.overrides("on_select_subscribers"):
-            # the modify contract lets hooks mutate the set in place — a
-            # cached set must never be exposed to that
-            return self.topics.subscribers(topic)
+        # safe even with on_select_subscribers hooks installed: _fan_out
+        # deep-copies the set before the only mutating hook sees it
         version = self.topics.sub_version
         hit = self._match_cache.get(topic)
         if hit is not None and hit[0] == version:
@@ -555,6 +553,58 @@ class Broker:
             result = await result
         return result
 
+    def _fast_qos0_eligible(self, client: Client, sub: Subscription,
+                            packet: Packet) -> bool:
+        """True when the delivered packet carries no per-subscriber state
+        (qos 0 out, retain cleared, no v5 subscription ids / aliases) —
+        its wire bytes are then IDENTICAL for every such subscriber.
+        Disabled when any hook watches the encode/sent events."""
+        return (min(packet.fixed.qos, sub.qos,
+                    self.capabilities.maximum_qos) == 0
+                and not client.closed
+                and not (sub.retain_as_published and packet.fixed.retain)
+                and not (client.properties.protocol_version >= 5
+                         and (sub.identifiers or sub.identifier
+                              or client.properties.topic_alias_maximum))
+                and not client.properties.maximum_packet_size
+                and not self.hooks.overrides("on_packet_encode")
+                and not self.hooks.overrides("on_packet_sent"))
+
+    @staticmethod
+    def _delivery_form(packet: Packet, version: int) -> Packet:
+        """The normalized QoS0 delivery copy (what the fast path encodes
+        and what drop hooks observe)."""
+        out = packet.copy()
+        out.protocol_version = version
+        out.fixed.qos = 0
+        out.fixed.dup = False
+        out.fixed.retain = False
+        out.packet_id = 0
+        if version >= 5:
+            out.properties.subscription_ids = []
+            out.properties.topic_alias = None
+        else:
+            out.properties = type(out.properties)()
+        return out
+
+    def _send_fast_qos0(self, client: Client, packet: Packet) -> None:
+        """Encode once per (packet, version) and enqueue raw bytes —
+        per-subscriber copy + encode is the dominant fan-out cost."""
+        version = client.properties.protocol_version
+        cache = packet.__dict__.get("_wire0")
+        if cache is None:
+            cache = {}
+            packet.__dict__["_wire0"] = cache
+        wire = cache.get(version)
+        if wire is None:
+            wire = self._delivery_form(packet, version).encode()
+            cache[version] = wire
+        if not client.send_wire(wire):
+            self.info.messages_dropped += 1
+            if self.hooks.overrides("on_publish_dropped"):
+                self.hooks.notify("on_publish_dropped", client,
+                                  self._delivery_form(packet, version))
+
     def _publish_to_client(self, client_id: str, sub: Subscription,
                            packet: Packet, shared: bool) -> None:
         """Parity: v2/server.go:795-868 (publishToClient)."""
@@ -563,56 +613,8 @@ class Broker:
             return
         if sub.no_local and packet.origin == client_id:
             return  # v5 NoLocal [MQTT-3.8.3-3]
-
-        # QoS0 fan-out fast path: when the delivered packet carries no
-        # per-subscriber state (qos 0 out, retain cleared, no v5
-        # subscription ids / aliases) its wire bytes are IDENTICAL for
-        # every such subscriber — encode once per (version, retain) and
-        # enqueue the bytes. Per-message python copy + encode per client
-        # is the dominant e2e cost otherwise. Disabled when any hook
-        # watches the encode/sent events.
-        if (min(packet.fixed.qos, sub.qos, self.capabilities.maximum_qos)
-                == 0 and not client.closed
-                and not (sub.retain_as_published and packet.fixed.retain)
-                and not (client.properties.protocol_version >= 5
-                         and (sub.identifiers or sub.identifier
-                              or client.properties.topic_alias_maximum))
-                and not client.properties.maximum_packet_size
-                and not self.hooks.overrides("on_packet_encode")
-                and not self.hooks.overrides("on_packet_sent")):
-            version = client.properties.protocol_version
-            cache = packet.__dict__.get("_wire0")
-            if cache is None:
-                cache = {}
-                packet.__dict__["_wire0"] = cache
-            wire = cache.get(version)
-            if wire is None:
-                fast = packet.copy()
-                fast.protocol_version = version
-                fast.fixed.qos = 0
-                fast.fixed.dup = False
-                fast.fixed.retain = False
-                fast.packet_id = 0
-                if version >= 5:
-                    fast.properties.subscription_ids = []
-                    fast.properties.topic_alias = None
-                else:
-                    fast.properties = type(fast.properties)()
-                wire = fast.encode()
-                cache[version] = wire
-            if not client.send_wire(wire):
-                self.info.messages_dropped += 1
-                if self.hooks.overrides("on_publish_dropped"):
-                    # hand hooks the delivery-form packet, as the slow
-                    # path does (qos 0, retain cleared, client version)
-                    dropped = packet.copy()
-                    dropped.protocol_version = version
-                    dropped.fixed.qos = 0
-                    dropped.fixed.dup = False
-                    dropped.fixed.retain = False
-                    dropped.packet_id = 0
-                    self.hooks.notify("on_publish_dropped", client,
-                                      dropped)
+        if self._fast_qos0_eligible(client, sub, packet):
+            self._send_fast_qos0(client, packet)
             return
 
         out = packet.copy()

@@ -967,6 +967,30 @@ def _decode_cache(tables):
     return dc
 
 
+def _candidate_pairs(batch: int, cnt, rows, hostrows, fall, tables):
+    """Flatten device slots + host-probe hits into (topic_idx, row_id)
+    pair arrays, dropping fallback topics and out-of-table row ids."""
+    kr = rows.shape[1]
+    real = np.where(fall, 0, cnt).astype(np.int64)
+    dmask = np.arange(kr, dtype=np.int64)[None, :] < real[:, None]
+    ti_dev = np.repeat(np.arange(batch), real)
+    rw_dev = rows[dmask].astype(np.int64)
+    if isinstance(hostrows, HostRows):
+        offs = hostrows.offsets[:batch + 1]
+        ti_h = np.repeat(np.arange(batch), np.diff(offs))
+        rw_h = hostrows.rows[:offs[-1]].astype(np.int64)
+    else:
+        ti_h = np.repeat(np.arange(batch),
+                         [len(h) for h in hostrows[:batch]])
+        rw_h = (np.concatenate([np.asarray(h) for h in
+                                hostrows[:batch]]).astype(np.int64)
+                if len(ti_h) else np.empty(0, dtype=np.int64))
+    ti = np.concatenate([ti_dev, ti_h])
+    rw = np.concatenate([rw_dev, rw_h])
+    keep = ~fall[ti] & (rw < len(tables.row_levels))
+    return ti[keep], rw[keep]
+
+
 def verify_pairs(tables, toks32, lengths, dollar, ti, rw) -> np.ndarray:
     """Vectorized ``filter_matches_topic`` over candidate (topic, row)
     pairs: ok[n] == the exact CPU check for topic ``ti[n]`` vs row
@@ -1501,25 +1525,7 @@ class SigEngine(OverlayedEngine):
             toks32[toks32 == pad] = -1
 
         fall = cnt == 15
-        kr = rows.shape[1]
-        real = np.where(fall, 0, cnt).astype(np.int64)
-        dmask = np.arange(kr, dtype=np.int64)[None, :] < real[:, None]
-        ti_dev = np.repeat(np.arange(batch), real)
-        rw_dev = rows[dmask].astype(np.int64)
-        if isinstance(hostrows, HostRows):
-            offs = hostrows.offsets[:batch + 1]
-            ti_h = np.repeat(np.arange(batch), np.diff(offs))
-            rw_h = hostrows.rows[:offs[-1]].astype(np.int64)
-        else:
-            ti_h = np.repeat(np.arange(batch),
-                             [len(h) for h in hostrows[:batch]])
-            rw_h = (np.concatenate([np.asarray(h) for h in
-                                    hostrows[:batch]]).astype(np.int64)
-                    if len(ti_h) else np.empty(0, dtype=np.int64))
-        ti = np.concatenate([ti_dev, ti_h])
-        rw = np.concatenate([rw_dev, rw_h])
-        keep = ~fall[ti] & (rw < len(tables.row_levels))
-        ti, rw = ti[keep], rw[keep]
+        ti, rw = _candidate_pairs(batch, cnt, rows, hostrows, fall, tables)
         ok = verify_pairs(tables, toks32, lengths, dollar, ti, rw)
         ti, rw = ti[ok], rw[ok]
 

@@ -111,7 +111,7 @@ class MQTTClient:
                 return self.connack
 
     async def _read_loop(self, initial: bytes = b"") -> None:
-        buf = bytearray(initial)
+        buf = self._read_buf = bytearray(initial)
         try:
             while True:
                 for fh, body in parse_stream(buf):
@@ -127,6 +127,19 @@ class MQTTClient:
             for fut in self._acks.values():
                 if not fut.done():
                     fut.set_exception(MQTTError("connection closed"))
+
+    async def pause_reading(self) -> bytes:
+        """Stop the internal read task and return any unconsumed buffered
+        bytes; the caller then owns ``self.reader`` (raw-socket
+        harnesses that count frames without per-message decode)."""
+        if self._read_task is not None:
+            self._read_task.cancel()
+            try:
+                await self._read_task
+            except asyncio.CancelledError:
+                pass
+            self._read_task = None
+        return bytes(getattr(self, "_read_buf", b""))
 
     async def _handle(self, packet: Packet) -> None:
         t = packet.type

@@ -55,6 +55,59 @@ def make_mesh(shape: tuple[int, int] = None, devices=None) -> Mesh:
     return Mesh(mesh_devices, axis_names=("data", "subs"))
 
 
+def make_multislice_mesh(n_slices: int | None = None,
+                         shape: tuple[int, int] | None = None,
+                         devices=None) -> Mesh:
+    """('slice', 'data', 'subs') mesh for multi-slice deployments.
+
+    Devices group by their hardware ``slice_index`` so the 'data'/'subs'
+    axes always sit INSIDE a slice (collective-free matching over ICI
+    neighbours); the leading 'slice' axis spans the DCN. The sharded
+    engines partition subscriptions over ('slice', 'subs') jointly, and
+    nothing in the match program communicates across 'slice' — matched
+    rows stay slice-local until the host fetch, so the slow inter-slice
+    fabric carries only result bytes, never compare traffic (the
+    scaling-book recipe: keep collectives on ICI, let DCN carry the
+    embarrassingly-parallel axis).
+
+    ``n_slices`` forces a synthetic split when the platform reports a
+    single slice (CPU meshes in tests; single-slice dev boxes).
+    """
+    import warnings
+
+    if devices is None:
+        devices = list(jax.devices())
+    groups: dict[int, list] = {}
+    for d in devices:
+        groups.setdefault(getattr(d, "slice_index", 0) or 0, []).append(d)
+    if len(groups) == 1 and n_slices and n_slices > 1:
+        per = len(devices) // n_slices
+        if per == 0:
+            raise ValueError(f"need >= {n_slices} devices for "
+                             f"{n_slices} slices, have {len(devices)}")
+        groups = {i: devices[i * per:(i + 1) * per]
+                  for i in range(n_slices)}
+    elif n_slices and n_slices != len(groups):
+        raise ValueError(f"n_slices={n_slices} but the platform reports "
+                         f"{len(groups)} hardware slice(s)")
+    slices = [groups[k] for k in sorted(groups)]
+    per = min(len(s) for s in slices)
+    if shape is None:
+        shape = (1, per)
+    dp, sp = shape
+    if dp * sp > per:
+        raise ValueError(f"per-slice shape {shape} needs {dp * sp} "
+                         f"devices; smallest slice has {per}")
+    idle = sum(len(s) - dp * sp for s in slices)
+    if idle:
+        warnings.warn(f"make_multislice_mesh leaves {idle} device(s) "
+                      f"idle (unequal slices, or shape {shape} smaller "
+                      "than a slice)", stacklevel=2)
+    mesh_devices = np.stack([np.asarray(s[: dp * sp]).reshape(dp, sp)
+                             for s in slices])
+    return Mesh(mesh_devices, axis_names=("slice", "data", "subs"))
+
+
 def compile_shards(subs, n_shards: int, version: int) -> list[NFATables]:
     """Partition a subscription list round-robin and compile one NFA per
     shard, all with a common edge-table size (grown together until every
@@ -143,8 +196,7 @@ class ShardedSigEngine(OverlayedEngine):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.sel_blocks = sel_blocks
         self.max_rows = max_rows
-        self.dp = self.mesh.shape["data"]
-        self.sp = self.mesh.shape["subs"]
+        self._bind_mesh_axes()
         self._state = None
         self._refresh_lock = threading.Lock()
         self.matches = 0
@@ -155,6 +207,18 @@ class ShardedSigEngine(OverlayedEngine):
     @staticmethod
     def _state_version(state) -> int:
         return state[0]
+
+    def _bind_mesh_axes(self) -> None:
+        """Subscriptions partition over ('slice', 'subs') jointly on a
+        multi-slice mesh (make_multislice_mesh) and over 'subs' on the
+        plain 2-axis mesh; the match program never communicates across
+        either axis, so the slice axis may ride the DCN for free."""
+        names = self.mesh.axis_names
+        self._subs_axes = tuple(a for a in ("slice", "subs") if a in names)
+        self.sp = 1
+        for a in self._subs_axes:
+            self.sp *= self.mesh.shape[a]
+        self.dp = self.mesh.shape["data"]
 
     # ------------------------------------------------------------------
 
@@ -212,7 +276,8 @@ class ShardedSigEngine(OverlayedEngine):
                         np.arange(g, dtype=np.int32), t.group_words)
 
             mesh = self.mesh
-            by_shard = NamedSharding(mesh, P("subs"))
+            subs_axes = self._subs_axes
+            by_shard = NamedSharding(mesh, P(subs_axes))
             dev = tuple(jax.device_put(a, by_shard)
                         for a in (topo, dc, mind, ish, wild, planes, grp))
 
@@ -220,9 +285,9 @@ class ShardedSigEngine(OverlayedEngine):
                 partial(_sharded_sig_match, sel_blocks=self.sel_blocks,
                         max_rows=self.max_rows),
                 mesh=mesh,
-                in_specs=(tuple(P("subs") for _ in range(7)),
+                in_specs=(tuple(P(subs_axes) for _ in range(7)),
                           P("data"), P("data")),
-                out_specs=P("subs", "data", None),
+                out_specs=P(subs_axes, "data", None),
             ))
             # exact-group coefficients are deterministic by shape, so the
             # union over shards gives ONE esig per topic valid everywhere
@@ -326,8 +391,7 @@ class ShardedSigEngine(OverlayedEngine):
         docs/system-design.md:201-231)."""
         with self._refresh_lock:
             self.mesh = mesh
-            self.dp = mesh.shape["data"]
-            self.sp = mesh.shape["subs"]
+            self._bind_mesh_axes()
         self.refresh(force=True)
 
 

@@ -190,6 +190,32 @@ def test_sharded_sig_scale_100k_and_reshard():
         assert_same(g, index.subscribers(topic), topic)
 
 
+def test_sharded_sig_multislice_mesh_parity():
+    """DCN/multi-slice story: subscriptions partition over
+    ('slice', 'subs') jointly; the match program never communicates
+    across 'slice', so only host result gathers cross the (slow)
+    inter-slice fabric. Virtual 2-slice x (data 1|2 x subs 2) meshes
+    must match the trie exactly."""
+    from maxmq_tpu.parallel.sharded import make_multislice_mesh
+
+    filters, topics = random_corpus(400, 48, seed=21)
+    index = build_index(filters)
+    for shape in [(1, 2), (2, 2)]:
+        mesh = make_multislice_mesh(n_slices=2, shape=shape)
+        assert mesh.axis_names == ("slice", "data", "subs")
+        engine = ShardedSigEngine(index, mesh=mesh)
+        assert engine.sp == 2 * shape[1]
+        got = engine.subscribers_batch(topics)
+        for topic, g in zip(topics, got):
+            assert_same(g, index.subscribers(topic), topic)
+
+    # elastic: drop to a single-slice 2-axis mesh and back
+    engine.reshard(make_mesh(shape=(1, 4)))
+    got = engine.subscribers_batch(topics[:16])
+    for topic, g in zip(topics[:16], got):
+        assert_same(g, index.subscribers(topic), topic)
+
+
 def test_sharded_sig_uneven_and_empty_shards():
     # fewer filters than shards: some shards compile empty
     index = build_index(["alpha/beta", "alpha/+", "gamma/#"])

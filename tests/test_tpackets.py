@@ -18,7 +18,7 @@ import os
 
 import pytest
 
-from maxmq_tpu.protocol.codec import MalformedPacketError, PacketType as PT
+from maxmq_tpu.protocol.codec import MalformedPacketError
 from maxmq_tpu.protocol.packets import Packet, ProtocolError, parse_stream
 
 FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures",

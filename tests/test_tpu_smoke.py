@@ -1,0 +1,80 @@
+"""Real-TPU smoke test: Pallas kernels active + exact parity on-chip.
+
+The rest of the suite pins the CPU backend process-wide
+(tests/conftest.py), so this runs in a SUBPROCESS with the pin stripped.
+Skips cleanly when no TPU is reachable (CPU-only boxes, or the tunnel
+is down). VERDICT r1 #8: nothing previously asserted ``pallas_active``
+on the hardware path.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_SCRIPT = r"""
+import json, random, sys
+sys.path.insert(0, __REPO__)
+import jax
+if jax.default_backend() not in ("tpu", "axon"):
+    print("SKIP-NO-TPU", jax.default_backend())
+    sys.exit(0)
+
+from maxmq_tpu.matching.trie import TopicIndex
+from maxmq_tpu.matching.sig import SigEngine
+from maxmq_tpu.protocol.packets import Subscription
+
+rng = random.Random(11)
+alphabet = [f"t{i}" for i in range(40)]
+idx = TopicIndex()
+for i in range(4000):
+    depth = rng.randint(1, 6)
+    levels = [rng.choice(alphabet) for _ in range(depth)]
+    r = rng.random()
+    if r < 0.3:
+        levels[rng.randrange(depth)] = "+"
+    elif r < 0.45:
+        levels = levels[: rng.randint(1, depth)] + ["#"]
+    f = "/".join(levels)
+    if rng.random() < 0.1:
+        f = f"$share/g{rng.randint(0,2)}/{f}"
+    idx.subscribe(f"c{i}", Subscription(filter=f, qos=i % 3))
+
+engine = SigEngine(idx, auto_refresh=False)
+assert engine.pallas_active, "Pallas kernel must be active on TPU"
+topics = ["/".join(rng.choice(alphabet) for _ in range(rng.randint(1, 6)))
+          for _ in range(512)] + ["$SYS/broker/x", "a//b"]
+got = engine.subscribers_fixed_batch(topics)
+checked = 0
+for t, s in zip(topics, got):
+    want = idx.subscribers(t)
+    assert set(s.subscriptions) == set(want.subscriptions), t
+    assert set(s.shared) == set(want.shared), t
+    checked += len(want.subscriptions)
+print("PASS", json.dumps({"topics": len(topics), "matched": checked,
+                          "pallas": engine.pallas_active,
+                          "backend": jax.default_backend()}))
+"""
+
+
+@pytest.mark.skipif(os.environ.get("MAXMQ_TPU_SMOKE") == "0",
+                    reason="disabled via MAXMQ_TPU_SMOKE=0")
+def test_tpu_pallas_parity_smoke():
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS",)}
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    timeout = int(os.environ.get("MAXMQ_TPU_SMOKE_TIMEOUT", "240"))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             _SCRIPT.replace("__REPO__", repr(repo))],
+            capture_output=True, text=True, timeout=timeout, env=env)
+    except subprocess.TimeoutExpired:
+        pytest.skip("TPU unreachable (timeout — tunnel down?)")
+    out = proc.stdout
+    if "SKIP-NO-TPU" in out:
+        pytest.skip(f"no TPU backend: {out.strip()}")
+    assert proc.returncode == 0, (
+        f"TPU smoke failed rc={proc.returncode}:\n{proc.stderr[-2000:]}")
+    assert "PASS" in out, out
