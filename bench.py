@@ -303,33 +303,90 @@ def main() -> None:
     iters = int(os.environ.get("MAXMQ_BENCH_ITERS", 4))
     depth = int(os.environ.get("MAXMQ_BENCH_DEPTH", 3))
 
+    import threading
+
     import jax
 
-    configs = []
+    # the image's sitecustomize pins jax_platforms to the hardware
+    # backend, overriding the env var — honor an explicit JAX_PLATFORMS
+    # (CPU validation runs) by pinning it back before backend init
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        try:
+            jax.config.update("jax_platforms", want)
+        except RuntimeError:
+            pass                       # backend already initialized
+
+    # backend watchdog: a wedged device tunnel would otherwise hang the
+    # whole bench with no output for the driver; fail loudly instead
+    ready = threading.Event()
+    init_error: list = []
+
+    def _warm():
+        try:
+            jax.numpy.arange(8).block_until_ready()
+        except Exception as exc:
+            init_error.append(repr(exc)[:300])
+        finally:
+            ready.set()
+
+    threading.Thread(target=_warm, daemon=True).start()
+    if not ready.wait(timeout=float(os.environ.get(
+            "MAXMQ_BENCH_BACKEND_TIMEOUT", "180"))) or init_error:
+        print(json.dumps({
+            "metric": "wildcard_topic_matches_per_sec_none",
+            "value": 0.0, "unit": "matches/sec", "vs_baseline": 0.0,
+            "detail": {"error": init_error[0] if init_error else
+                       "accelerator backend unreachable "
+                       "(device init timed out)"}}))
+        sys.stdout.flush()
+        os._exit(2)
+
+    scale = float(os.environ.get("MAXMQ_BENCH_SCALE", "1"))
+
+    def s(n: int) -> int:
+        return max(256, int(n * scale))
+
+    def s4(n: int) -> int:
+        # explicit MAXMQ_BENCH_SUBS/BATCH pins are used verbatim; scale
+        # applies to defaults only
+        return n if "MAXMQ_BENCH_SUBS" in os.environ             or "MAXMQ_BENCH_BATCH" in os.environ else s(n)
+
+    runs = []
     if "1" in which:
-        configs.append(bench_config(
-            "exact_1k", 1_000, 65_536, iters, depth,
-            engine_kw={}, corpus_kw={"exact_only": True}))
+        runs.append(("exact_1k", lambda: bench_config(
+            "exact_1k", s(1_000), s(65_536), iters, depth,
+            engine_kw={}, corpus_kw={"exact_only": True})))
     if "2" in which:
-        configs.append(bench_config(
-            "plus_10k", 10_000, 131_072, iters, depth,
-            engine_kw={}, corpus_kw={"plus_only": True}))
+        runs.append(("plus_10k", lambda: bench_config(
+            "plus_10k", s(10_000), s(131_072), iters, depth,
+            engine_kw={}, corpus_kw={"plus_only": True})))
     if "3" in which:
-        configs.append(bench_config(
-            "mixed_100k", 100_000, 262_144, iters, depth,
-            engine_kw={}, corpus_kw={}))
+        runs.append(("mixed_100k", lambda: bench_config(
+            "mixed_100k", s(100_000), s(262_144), iters, depth,
+            engine_kw={}, corpus_kw={})))
     if "4" in which:
-        configs.append(bench_config(
-            "iot_1m_share", n_subs4, batch4, iters, depth,
+        runs.append(("iot_1m_share", lambda: bench_config(
+            "iot_1m_share", s4(n_subs4), s4(batch4), iters, depth,
             engine_kw={"fixed_max_rows": 14},
-            corpus_kw={"share_frac": 0.1}))
+            corpus_kw={"share_frac": 0.1})))
     if "lat" in which:
-        configs.append(bench_latency())
+        runs.append(("latency_fanout",
+                     lambda: bench_latency(n_subs=s(100_000))))
     if "5" in which:
-        configs.append(bench_cluster())
+        runs.append(("cluster", lambda: bench_cluster(subs=s(100_000))))
+
+    configs = []
+    for name, fn in runs:
+        try:
+            configs.append(fn())
+        except Exception as exc:        # a broken config must not hide
+            log(f"[{name}] FAILED: {exc!r}")   # the others' numbers
+            configs.append({"config": name, "error": repr(exc)[:300]})
 
     headline = next((c for c in configs
-                     if c.get("config") == "iot_1m_share"), None)
+                     if c.get("config") == "iot_1m_share"
+                     and "matches_per_sec" in c), None)
     if headline is None:
         headline = next((c for c in configs
                          if "matches_per_sec" in c), {})

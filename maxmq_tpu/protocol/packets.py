@@ -430,6 +430,15 @@ class Packet:
     # ------------------------------------------------------------------
 
     def validate_publish(self) -> None:
+        if self.fixed.qos > 0 and not self.packet_id:
+            raise ProtocolError(codes.ErrProtocolViolation,
+                                "qos > 0 publish without packet id"
+                                )  # [MQTT-2.2.1-2]
+        if self.properties.subscription_ids:
+            # only the server sends subscription identifiers
+            raise ProtocolError(codes.ErrProtocolViolation,
+                                "subscription identifier from client"
+                                )  # [MQTT-3.3.4-6]
         if not self.topic:
             # a v5 publish may carry only a topic alias [MQTT-3.3.2-6]
             if self.v5 and self.properties.topic_alias:

@@ -441,6 +441,27 @@ def test_decode_rate_unit_bench():
     assert best >= 1_000_000, f"decode rate {best:,.0f} rows/s < 1M"
 
 
+def test_stream_prefetch_shortfall_fetches_rest(monkeypatch):
+    """When the EMA hint under-predicts, the unprefetched tail of the
+    row stream must be fetched synchronously — force tiny slices so the
+    shortfall path actually runs."""
+    import maxmq_tpu.matching.sig as sigmod
+
+    idx = TopicIndex()
+    for i in range(40):
+        idx.subscribe(f"c{i}", Subscription(filter=f"a/{i}/#"))
+        idx.subscribe(f"w{i}", Subscription(filter="a/+/x"))
+    monkeypatch.setattr(sigmod, "_STREAM_CHUNK", 8)
+    engine = SigEngine(idx, auto_refresh=False)
+    engine._stream_rows_hint = 0        # prefetch just one tiny slice
+    topics = [f"a/{i}/x" for i in range(40)]    # 2 rows per topic
+    got = engine.subscribers_fixed_batch(topics)
+    for i, (topic, s) in enumerate(zip(topics, got)):
+        want = idx.subscribers(topic)
+        assert set(s.subscriptions) == set(want.subscriptions), topic
+    assert engine._stream_rows_hint > 0     # EMA updated from the batch
+
+
 def test_retained_churn_never_recompiles():
     from maxmq_tpu.protocol.codec import PacketType as PT
     from maxmq_tpu.protocol.packets import FixedHeader, Packet

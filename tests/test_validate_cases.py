@@ -1,0 +1,136 @@
+"""Validate-direction conformance cases from the reference corpus.
+
+The tpackets corpus's ``Invalid*`` entries carry no wire bytes — they
+construct packets and expect the reference's XxxValidate step (or the
+broker) to reject them (tpackets.go, the cases without RawBytes). This
+module ports their SEMANTICS against our validation surface: some live
+on ``Packet.validate_*``, some on the broker's processing path, matching
+where the reference enforces each rule.
+"""
+
+import asyncio
+
+import pytest
+
+from maxmq_tpu.protocol import codes
+from maxmq_tpu.protocol.codec import FixedHeader, PacketType as PT
+from maxmq_tpu.protocol.codec import MalformedPacketError
+from maxmq_tpu.protocol.packets import (Packet, ProtocolError,
+                                        Subscription)
+
+from test_broker_system import connect, running_broker
+
+
+def publish(topic="a/b", qos=0, v5=False, **props) -> Packet:
+    p = Packet(fixed=FixedHeader(type=PT.PUBLISH, qos=qos), topic=topic,
+               protocol_version=5 if v5 else 4)
+    for k, v in props.items():
+        setattr(p.properties, k, v)
+    return p
+
+
+# --- TPublishInvalid*: PublishValidate (tpackets.go:2075-2168) ---------
+
+def test_publish_qos_must_have_packet_id():
+    # TPublishInvalidQosMustPacketID [MQTT-2.2.1-2]
+    p = publish(qos=1)
+    p.packet_id = 0
+    with pytest.raises(ProtocolError):
+        p.validate_publish()
+
+
+def test_publish_surplus_subscription_identifier():
+    # TPublishInvalidSurplusSubID [MQTT-3.3.4-6]
+    p = publish(v5=True, subscription_ids=[1])
+    with pytest.raises(ProtocolError):
+        p.validate_publish()
+
+
+@pytest.mark.parametrize("topic", ["a/+", "a/#", "+", "#", "a/+/c"])
+def test_publish_surplus_wildcard(topic):
+    # TPublishInvalidSurplusWildcard(2) [MQTT-3.3.2-2]
+    with pytest.raises(ProtocolError):
+        publish(topic=topic).validate_publish()
+
+
+def test_publish_no_topic_no_alias():
+    # TPublishInvalidNoTopic [MQTT-3.3.2-1]
+    with pytest.raises(ProtocolError):
+        publish(topic="").validate_publish()
+    # ... but alias-only is legal for v5 [MQTT-3.3.2-6]
+    publish(topic="", v5=True, topic_alias=3).validate_publish()
+
+
+async def test_publish_topic_alias_zero_and_excess():
+    # TPublishInvalidTopicAlias / TPublishInvalidExcessTopicAlias
+    # [MQTT-3.3.2-8]: enforced where the reference enforces it — on the
+    # broker's inbound alias resolution
+    async with running_broker(topic_alias_maximum=4) as broker:
+        c = await connect(broker, "c1", version=5)
+        cl = broker.clients.get("c1")
+        assert cl.aliases.resolve_inbound("t", 0) is None       # zero
+        assert cl.aliases.resolve_inbound("t", 5) is None       # excess
+        assert cl.aliases.resolve_inbound("t", 3) == "t"        # learns
+        assert cl.aliases.resolve_inbound("", 3) == "t"         # resolves
+        await c.disconnect()
+
+
+# --- TSubscribeInvalid* / TUnsubscribeInvalid* -------------------------
+
+async def test_subscribe_shared_no_local_rejected():
+    # TSubscribeInvalidSharedNoLocal [MQTT-3.8.3-4]: the broker must
+    # drop the connection on a $share filter with NoLocal
+    async with running_broker() as broker:
+        c = await connect(broker, "c1", version=5)
+        sub = Packet(fixed=FixedHeader(type=PT.SUBSCRIBE),
+                     protocol_version=5, packet_id=7,
+                     filters=[Subscription(filter="$share/g/a/b",
+                                           no_local=True)])
+        c.writer.write(sub.encode())
+        await c.writer.drain()
+        await c.wait_closed(timeout=5)
+        await asyncio.sleep(0.05)
+        assert broker.info.clients_connected == 0
+
+
+def test_subscribe_no_filters_rejected_at_decode():
+    # TSubscribeInvalidNoFilters [MQTT-3.8.3-3]: wire twin is the
+    # decode-time check
+    wire = Packet(fixed=FixedHeader(type=PT.SUBSCRIBE),
+                  protocol_version=5, packet_id=8, filters=[]).encode()
+    from maxmq_tpu.protocol.packets import parse_stream
+    buf = bytearray(wire)
+    [(fh, body)] = list(parse_stream(buf))
+    with pytest.raises((ProtocolError, MalformedPacketError)):
+        Packet.decode(fh, body, 5)
+
+
+def test_unsubscribe_no_filters_rejected_at_decode():
+    # TUnsubscribeInvalidNoFilters [MQTT-3.10.3-2]
+    wire = Packet(fixed=FixedHeader(type=PT.UNSUBSCRIBE),
+                  protocol_version=5, packet_id=9, filters=[]).encode()
+    from maxmq_tpu.protocol.packets import parse_stream
+    buf = bytearray(wire)
+    [(fh, body)] = list(parse_stream(buf))
+    with pytest.raises((ProtocolError, MalformedPacketError)):
+        Packet.decode(fh, body, 5)
+
+
+# --- TDisconnect* encode cases (tpackets.go fail-state section) --------
+
+def test_disconnect_reason_codes_roundtrip():
+    # TDisconnectTakeover / ShuttingDown / SecondConnect /
+    # ReceiveMaximum: encode-direction cases — the v5 reason code must
+    # survive an encode/decode roundtrip
+    from maxmq_tpu.protocol.packets import parse_stream
+    for code in (codes.ErrSessionTakenOver, codes.ErrServerShuttingDown,
+                 codes.ErrProtocolViolationSecondConnect
+                 if hasattr(codes, "ErrProtocolViolationSecondConnect")
+                 else codes.ErrProtocolViolation,
+                 codes.ErrReceiveMaximumExceeded):
+        p = Packet(fixed=FixedHeader(type=PT.DISCONNECT),
+                   protocol_version=5, reason_code=code.value)
+        buf = bytearray(p.encode())
+        [(fh, body)] = list(parse_stream(buf))
+        got = Packet.decode(fh, body, 5)
+        assert got.reason_code == code.value
