@@ -347,10 +347,10 @@ def main() -> None:
     def s(n: int) -> int:
         return max(256, int(n * scale))
 
-    def s4(n: int) -> int:
-        # explicit MAXMQ_BENCH_SUBS/BATCH pins are used verbatim; scale
-        # applies to defaults only
-        return n if "MAXMQ_BENCH_SUBS" in os.environ             or "MAXMQ_BENCH_BATCH" in os.environ else s(n)
+    def s4(n: int, env: str) -> int:
+        # an explicitly pinned knob is used verbatim; scale applies to
+        # the defaults only (per knob, not jointly)
+        return n if env in os.environ else s(n)
 
     runs = []
     if "1" in which:
@@ -367,7 +367,8 @@ def main() -> None:
             engine_kw={}, corpus_kw={})))
     if "4" in which:
         runs.append(("iot_1m_share", lambda: bench_config(
-            "iot_1m_share", s4(n_subs4), s4(batch4), iters, depth,
+            "iot_1m_share", s4(n_subs4, "MAXMQ_BENCH_SUBS"),
+            s4(batch4, "MAXMQ_BENCH_BATCH"), iters, depth,
             engine_kw={"fixed_max_rows": 14},
             corpus_kw={"share_frac": 0.1})))
     if "lat" in which:

@@ -19,7 +19,8 @@ from ..hooks.base import Hook, Hooks, RejectPacket
 from ..matching.topics import valid_filter, valid_topic_name
 from ..matching.trie import SubscriberSet, TopicIndex
 from ..protocol import codes
-from ..protocol.codec import FixedHeader, MalformedPacketError, PacketType as PT
+from ..protocol.codec import (FixedHeader, MalformedPacketError,
+                              PacketType as PT, write_varint)
 from ..protocol.packets import Packet, ProtocolError, Subscription
 from .client import Client, ClientRegistry, PacketIDExhausted
 from .listeners import Listener, Listeners
@@ -597,7 +598,20 @@ class Broker:
             packet.__dict__["_wire0"] = cache
         wire = cache.get(version)
         if wire is None:
-            wire = self._delivery_form(packet, version).encode()
+            if version < 5 or packet.properties.is_empty():
+                # direct wire build — the common no-properties delivery
+                # needs no Packet/Properties copies at all
+                tb = packet.topic.encode()
+                body = bytearray(len(tb).to_bytes(2, "big"))
+                body += tb
+                if version >= 5:
+                    body.append(0)          # empty properties block
+                body += packet.payload
+                wire_b = bytearray([0x30])  # PUBLISH, qos0/dup0/retain0
+                write_varint(wire_b, len(body))
+                wire = bytes(wire_b + body)
+            else:
+                wire = self._delivery_form(packet, version).encode()
             cache[version] = wire
         if not client.send_wire(wire):
             self.info.messages_dropped += 1

@@ -1,0 +1,167 @@
+"""MQTT-over-WebSocket listener: RFC 6455 handshake + frame bridging.
+
+Drives the broker's own WS server (broker/listeners.py WSListener) with
+a minimal in-test WS client — handshake, masked binary frames, ping and
+close — and runs a full MQTT CONNECT/SUBSCRIBE/PUBLISH roundtrip through
+it. Parity surface: the reference's gorilla-websocket adapter
+(vendor/.../v2/listeners/websocket.go).
+"""
+
+import asyncio
+import base64
+import hashlib
+import os
+import struct
+
+import pytest
+
+from maxmq_tpu.broker import Broker, BrokerOptions, Capabilities, WSListener
+from maxmq_tpu.hooks import AllowHook
+from maxmq_tpu.protocol.codec import FixedHeader, PacketType as PT
+from maxmq_tpu.protocol.packets import Packet, parse_stream
+
+_WS_MAGIC = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+
+class WSClient:
+    """Just enough RFC 6455 to drive the listener: client handshake,
+    masked binary frames out, unmasked frames in."""
+
+    def __init__(self):
+        self.reader = None
+        self.writer = None
+        self._buf = bytearray()
+        self._mqtt = bytearray()
+
+    async def connect(self, host: str, port: int):
+        self.reader, self.writer = await asyncio.open_connection(host, port)
+        key = base64.b64encode(os.urandom(16)).decode()
+        self.writer.write(
+            (f"GET /mqtt HTTP/1.1\r\nHost: {host}:{port}\r\n"
+             "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+             f"Sec-WebSocket-Key: {key}\r\n"
+             "Sec-WebSocket-Version: 13\r\n"
+             "Sec-WebSocket-Protocol: mqtt\r\n\r\n").encode())
+        await self.writer.drain()
+        resp = await asyncio.wait_for(
+            self.reader.readuntil(b"\r\n\r\n"), 5)
+        assert b"101" in resp.split(b"\r\n", 1)[0]
+        want = base64.b64encode(hashlib.sha1(
+            (key + _WS_MAGIC).encode()).digest())
+        assert want in resp
+        return self
+
+    def send_frame(self, opcode: int, payload: bytes):
+        mask = os.urandom(4)
+        head = bytearray([0x80 | opcode])
+        n = len(payload)
+        if n < 126:
+            head.append(0x80 | n)
+        elif n < 65536:
+            head.append(0x80 | 126)
+            head.extend(struct.pack(">H", n))
+        else:
+            head.append(0x80 | 127)
+            head.extend(struct.pack(">Q", n))
+        masked = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        self.writer.write(bytes(head) + mask + masked)
+
+    def send_mqtt(self, packet: Packet):
+        self.send_frame(0x2, packet.encode())
+
+    async def recv_frame(self, timeout: float = 5.0):
+        hdr = await asyncio.wait_for(self.reader.readexactly(2), timeout)
+        opcode = hdr[0] & 0x0F
+        length = hdr[1] & 0x7F
+        if length == 126:
+            length = struct.unpack(
+                ">H", await self.reader.readexactly(2))[0]
+        elif length == 127:
+            length = struct.unpack(
+                ">Q", await self.reader.readexactly(8))[0]
+        payload = await self.reader.readexactly(length)
+        return opcode, payload
+
+    async def recv_mqtt(self, timeout: float = 5.0) -> Packet:
+        while True:
+            pk = list(parse_stream(self._mqtt))
+            if pk:
+                return Packet.decode(*pk[0])
+            opcode, payload = await self.recv_frame(timeout)
+            if opcode in (0x0, 0x1, 0x2):
+                self._mqtt.extend(payload)
+
+
+async def ws_broker():
+    b = Broker(BrokerOptions(capabilities=Capabilities(
+        sys_topic_interval=0)))
+    b.add_hook(AllowHook())
+    lst = b.add_listener(WSListener("ws1", "127.0.0.1:0"))
+    await b.serve()
+    port = lst._server.sockets[0].getsockname()[1]
+    return b, port
+
+
+async def test_ws_mqtt_roundtrip():
+    broker, port = await ws_broker()
+    try:
+        c = await WSClient().connect("127.0.0.1", port)
+        c.send_mqtt(Packet(fixed=FixedHeader(type=PT.CONNECT),
+                           protocol_version=4, clean_start=True,
+                           client_id="wsc"))
+        connack = await c.recv_mqtt()
+        assert connack.type == PT.CONNACK and connack.reason_code == 0
+
+        from maxmq_tpu.protocol.packets import Subscription
+        c.send_mqtt(Packet(fixed=FixedHeader(type=PT.SUBSCRIBE),
+                           protocol_version=4, packet_id=1,
+                           filters=[Subscription(filter="ws/+")]))
+        suback = await c.recv_mqtt()
+        assert suback.type == PT.SUBACK
+
+        c.send_mqtt(Packet(fixed=FixedHeader(type=PT.PUBLISH),
+                           protocol_version=4, topic="ws/x",
+                           payload=b"frame-bridged"))
+        msg = await c.recv_mqtt()
+        assert (msg.type, msg.topic, msg.payload) == \
+            (PT.PUBLISH, "ws/x", b"frame-bridged")
+
+        # a split MQTT packet across two WS frames must reassemble
+        ping = Packet(fixed=FixedHeader(type=PT.PINGREQ),
+                      protocol_version=4).encode()
+        c.send_frame(0x2, ping[:1])
+        c.send_frame(0x2, ping[1:])
+        resp = await c.recv_mqtt()
+        assert resp.type == PT.PINGRESP
+    finally:
+        await broker.close()
+
+
+async def test_ws_ping_pong_and_close():
+    broker, port = await ws_broker()
+    try:
+        c = await WSClient().connect("127.0.0.1", port)
+        c.send_mqtt(Packet(fixed=FixedHeader(type=PT.CONNECT),
+                           protocol_version=4, clean_start=True,
+                           client_id="wsp"))
+        await c.recv_mqtt()
+        c.send_frame(0x9, b"hb")            # WS ping
+        opcode, payload = await c.recv_frame()
+        assert (opcode, payload) == (0xA, b"hb")
+        c.send_frame(0x8, b"")              # WS close
+        await asyncio.sleep(0.1)
+        assert broker.info.clients_connected == 0
+    finally:
+        await broker.close()
+
+
+async def test_ws_bad_handshake_rejected():
+    broker, port = await ws_broker()
+    try:
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(b"GET / HTTP/1.1\r\nHost: x\r\n\r\n")  # no upgrade
+        await writer.drain()
+        data = await asyncio.wait_for(reader.read(64), 5)
+        assert data == b""                  # connection dropped
+    finally:
+        await broker.close()
