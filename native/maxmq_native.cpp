@@ -419,8 +419,7 @@ int64_t mq_probe_run(void* h, const void* toks, int32_t tok_mode,
         int32_t j = g.probe(sig);
         for (; j >= 0 && static_cast<size_t>(j) < g.sigs.size() &&
                g.sigs[j] == sig; ++j) {
-          ti_t.push_back(i);
-          rw_t.push_back(g.rows[j]);
+          emit(i, g.rows[j]);
         }
       }
     }
@@ -455,26 +454,28 @@ int64_t mq_probe_run(void* h, const void* toks, int32_t tok_mode,
 // Outputs: toks_out/lens_out as mq_tokenize_sig; (ti_out, row_out) hit
 // pairs in topic order (up to cap — returns the total regardless, the
 // caller re-invokes with a larger buffer when total > cap).
-int64_t mq_tokenize_probe(void* v, void* h, const char* buf, int64_t buf_len,
-                          int64_t n_topics, int64_t window, int32_t tok_mode,
-                          void* toks_out, int8_t* lens_out, int64_t* ti_out,
-                          int32_t* row_out, int64_t cap) {
-  Vocab* vb = static_cast<Vocab*>(v);
-  if (vb->dirty) vb->build();
-  const Vocab& map = *vb;
-  const ProbeSet* set = static_cast<ProbeSet*>(h);
+namespace {
+
+// One contiguous topic range of the fused tokenize+probe (the worker
+// body shared by the single-thread and threaded paths). ``tstarts``
+// holds n_topics+1 byte offsets: topic i spans
+// [tstarts[i], tstarts[i+1]-1) (the -1 drops the '\0' separator; the
+// final sentinel is buf_len+1 so the last, unterminated topic spans to
+// buf_len).
+template <typename Sink>
+void tokenize_probe_range(const Vocab& map, const ProbeSet* set,
+                          const char* buf, const int64_t* tstarts,
+                          int64_t lo, int64_t hi, int64_t window,
+                          int32_t tok_mode, void* toks_out,
+                          int8_t* lens_out, Sink&& emit) {
   constexpr int64_t kDepthCap = 63;
   uint8_t* t8 = static_cast<uint8_t*>(toks_out);
   uint16_t* t16 = static_cast<uint16_t*>(toks_out);
   int32_t* t32 = static_cast<int32_t*>(toks_out);
-  int64_t topic_start = 0;
-  int64_t i = 0;
-  int64_t hits = 0;
   int32_t level_toks[kDepthCap];
-  for (int64_t end = 0; end <= buf_len && i < n_topics; ++end) {
-    if (end != buf_len && buf[end] != '\0') continue;
-    const char* start = buf + topic_start;
-    const int64_t tlen = end - topic_start;
+  for (int64_t i = lo; i < hi; ++i) {
+    const char* start = buf + tstarts[i];
+    const int64_t tlen = tstarts[i + 1] - 1 - tstarts[i];
     const bool dollar = tlen > 0 && start[0] == '$';
 
     int64_t n_levels = 0;
@@ -520,19 +521,87 @@ int64_t mq_tokenize_probe(void* v, void* h, const char* buf, int64_t buf_len,
         int32_t j = g.probe(sig);
         for (; j >= 0 && static_cast<size_t>(j) < g.sigs.size() &&
                g.sigs[j] == sig; ++j) {
-          if (hits < cap) {
-            ti_out[hits] = i;
-            row_out[hits] = g.rows[j];
-          }
-          ++hits;
+          ti_t.push_back(i);
+          rw_t.push_back(g.rows[j]);
         }
       }
     }
-
-    topic_start = end + 1;
-    ++i;
   }
-  return hits;
+}
+
+}  // namespace
+
+int64_t mq_tokenize_probe(void* v, void* h, const char* buf, int64_t buf_len,
+                          int64_t n_topics, int64_t window, int32_t tok_mode,
+                          void* toks_out, int8_t* lens_out, int64_t* ti_out,
+                          int32_t* row_out, int64_t cap) {
+  Vocab* vb = static_cast<Vocab*>(v);
+  if (vb->dirty) vb->build();
+  const Vocab& map = *vb;
+  const ProbeSet* set = static_cast<ProbeSet*>(h);
+  if (n_topics <= 0) return 0;
+
+  // topic boundaries ('\0'-joined buffer, exactly n_topics-1 separators)
+  std::vector<int64_t> tstarts(n_topics + 1);
+  tstarts[0] = 0;
+  int64_t idx = 0;
+  for (int64_t e = 0; e < buf_len && idx < n_topics - 1; ++e)
+    if (buf[e] == '\0') tstarts[++idx] = e + 1;
+  tstarts[n_topics] = buf_len + 1;
+
+  int32_t n_threads =
+      static_cast<int32_t>(std::thread::hardware_concurrency());
+  if (n_threads <= 0) n_threads = 1;
+  if (n_threads > 8) n_threads = 8;
+  if (n_topics < 16384) n_threads = 1;
+
+  if (n_threads == 1) {
+    // publish hot path: write hits straight into the caller's buffers
+    // (partial fill up to cap, total returned regardless) — no
+    // per-call vectors beyond the boundary index
+    int64_t hits = 0;
+    tokenize_probe_range(map, set, buf, tstarts.data(), 0, n_topics,
+                         window, tok_mode, toks_out, lens_out,
+                         [&](int64_t i, int32_t r) {
+                           if (hits < cap) {
+                             ti_out[hits] = i;
+                             row_out[hits] = r;
+                           }
+                           ++hits;
+                         });
+    return hits;
+  }
+
+  std::vector<std::vector<int64_t>> ti(n_threads);
+  std::vector<std::vector<int32_t>> rw(n_threads);
+  auto worker = [&](int32_t t) {
+    auto& ti_t = ti[t];
+    auto& rw_t = rw[t];
+    tokenize_probe_range(map, set, buf, tstarts.data(),
+                         n_topics * t / n_threads,
+                         n_topics * (t + 1) / n_threads, window, tok_mode,
+                         toks_out, lens_out,
+                         [&](int64_t i, int32_t r) {
+                           ti_t.push_back(i);
+                           rw_t.push_back(r);
+                         });
+  };
+  std::vector<std::thread> threads;
+  threads.reserve(n_threads);
+  for (int32_t t = 0; t < n_threads; ++t) threads.emplace_back(worker, t);
+  for (auto& th : threads) th.join();
+
+  int64_t total = 0;
+  for (const auto& vv : ti) total += static_cast<int64_t>(vv.size());
+  int64_t off = 0;
+  for (int32_t t = 0; t < n_threads && off < cap; ++t) {
+    const int64_t take = std::min<int64_t>(
+        static_cast<int64_t>(ti[t].size()), cap - off);
+    std::copy(ti[t].begin(), ti[t].begin() + take, ti_out + off);
+    std::copy(rw[t].begin(), rw[t].begin() + take, row_out + off);
+    off += take;
+  }
+  return total;
 }
 
 // Scan `buf` (len bytes) for complete MQTT control-packet frames.

@@ -108,6 +108,37 @@ def test_retained_expiry_skips_sys_and_disabled():
     assert b2.topics.retained_get("x") is not None
 
 
+class _WireSink:
+    """Stub client: captures what _send_fast_qos0 enqueues."""
+
+    def __init__(self, version: int):
+        from maxmq_tpu.broker.client import ClientProperties
+        self.properties = ClientProperties(protocol_version=version)
+        self.wires: list[bytes] = []
+
+    def send_wire(self, wire: bytes) -> bool:
+        self.wires.append(wire)
+        return True
+
+
+def test_fast_qos0_wire_matches_full_encoder():
+    """The direct wire build in _send_fast_qos0 must stay byte-identical
+    to the codec's own encoding of the delivery form — this pins the
+    inlined fast path to the codec against future encoding changes."""
+    b = Broker(BrokerOptions())
+    for version in (3, 4, 5):
+        for topic, payload in [("a/b", b"x" * 64), ("t", b""),
+                               ("deep/l1/l2/l3", b"\x00\xff" * 40),
+                               ("unicodé/世界", b"p")]:
+            pkt = Packet(fixed=FixedHeader(type=PT.PUBLISH, qos=1,
+                                           retain=True, dup=True),
+                         topic=topic, payload=payload, packet_id=9)
+            want = b._delivery_form(pkt, version).encode()
+            sink = _WireSink(version)
+            b._send_fast_qos0(sink, pkt)
+            assert sink.wires == [want], (version, topic)
+
+
 class _CapturingLogger:
     def __init__(self):
         self.errors = []

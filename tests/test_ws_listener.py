@@ -13,8 +13,6 @@ import hashlib
 import os
 import struct
 
-import pytest
-
 from maxmq_tpu.broker import Broker, BrokerOptions, Capabilities, WSListener
 from maxmq_tpu.hooks import AllowHook
 from maxmq_tpu.protocol.codec import FixedHeader, PacketType as PT
@@ -30,8 +28,8 @@ class WSClient:
     def __init__(self):
         self.reader = None
         self.writer = None
-        self._buf = bytearray()
         self._mqtt = bytearray()
+        self._parsed: list[Packet] = []
 
     async def connect(self, host: str, port: int):
         self.reader, self.writer = await asyncio.open_connection(host, port)
@@ -84,9 +82,13 @@ class WSClient:
 
     async def recv_mqtt(self, timeout: float = 5.0) -> Packet:
         while True:
-            pk = list(parse_stream(self._mqtt))
-            if pk:
-                return Packet.decode(*pk[0])
+            if self._parsed:
+                return self._parsed.pop(0)
+            self._parsed.extend(
+                Packet.decode(fh, body)
+                for fh, body in parse_stream(self._mqtt))
+            if self._parsed:
+                continue
             opcode, payload = await self.recv_frame(timeout)
             if opcode in (0x0, 0x1, 0x2):
                 self._mqtt.extend(payload)
