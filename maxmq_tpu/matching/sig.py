@@ -1281,6 +1281,13 @@ class SigEngine(OverlayedEngine):
     def tables(self) -> SigTables:
         return self._state[0]
 
+    @property
+    def fixed_program(self):
+        """(jitted fixed-path fn, wire-format descriptor) — the public
+        view of the compiled program for harnesses that dispatch the
+        device half directly (the driver's compile check)."""
+        return self._state[6], self._state[7]
+
     # ------------------------------------------------------------------
 
     def match_raw(self, topics: list[str]):

@@ -80,11 +80,18 @@ def test_make_mesh_default_shape():
 
 
 def test_graft_entry_single_chip():
+    import numpy as np
+
     import __graft_entry__ as ge
 
     fn, example_args = ge.entry()
-    acc, overflow = fn(*example_args)
-    assert acc.shape[0] == example_args[0].shape[0]
+    counts, stream = fn(*example_args)      # stream wire format
+    batch = example_args[0].shape[0]
+    counts = np.asarray(counts)
+    assert counts.shape == (batch,)
+    assert (counts != 255).all()            # 255 = overflow sentinel;
+    total = int(counts.sum())               # this corpus never overflows
+    assert 0 < total <= stream.shape[0]
 
 
 def test_graft_entry_multichip():
