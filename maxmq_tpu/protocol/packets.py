@@ -25,7 +25,7 @@ from .codec import (
     write_string,
     write_uint16,
 )
-from .properties import Properties
+from .properties import Properties, blank_properties
 
 PROTOCOL_NAMES = {3: "MQIsdp", 4: "MQTT", 5: "MQTT"}
 
@@ -276,7 +276,7 @@ class Packet:
             # means a truncated buffer was fed directly (the conformance
             # corpus's Mal* fixtures do exactly this)
             raise MalformedPacketError("body shorter than remaining length")
-        p = cls(fixed=fixed, protocol_version=protocol_version)
+        p = _blank_packet(fixed, protocol_version)
         t = fixed.type
         try:
             if t == PT.CONNECT:
@@ -449,6 +449,37 @@ class Packet:
                                 "wildcards in publish topic")  # [MQTT-3.3.2-2]
         if not valid_utf8_string(self.topic.encode("utf-8")):
             raise ProtocolError(codes.ErrTopicNameInvalid)
+
+
+# Dataclass construction runs on the per-packet hot path; building from
+# prebuilt default templates (immutable values shared, the three mutable
+# fields set fresh) costs ~1/3 of the generated __init__. Parity is
+# pinned by the conformance corpus (tests/test_tpackets.py) and
+# test_packets.py — every decoded packet goes through this.
+_PACKET_TEMPLATE: dict | None = None
+
+
+def _blank_packet(fixed: FixedHeader, protocol_version: int) -> "Packet":
+    global _PACKET_TEMPLATE
+    if _PACKET_TEMPLATE is None:
+        import dataclasses
+
+        tmpl = {k: v for k, v in Packet().__dict__.items()
+                if not isinstance(v, (list, dict, Properties, FixedHeader))}
+        # a future mutable field must be added to the resets below, not
+        # silently shared or dropped
+        assert set(tmpl) | {"fixed", "protocol_version", "reason_codes",
+                            "filters", "properties"} == \
+            {f.name for f in dataclasses.fields(Packet)}
+        _PACKET_TEMPLATE = tmpl
+    q = object.__new__(Packet)
+    q.__dict__.update(_PACKET_TEMPLATE)
+    q.fixed = fixed
+    q.protocol_version = protocol_version
+    q.reason_codes = []
+    q.filters = []
+    q.properties = blank_properties()
+    return q
 
 
 def parse_stream(buf: bytearray, max_packet_size: int = 0):

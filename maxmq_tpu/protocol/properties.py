@@ -241,7 +241,7 @@ class Properties:
         end = off + length
         if end > len(buf):
             raise MalformedPacketError("truncated properties block")
-        p = cls()
+        p = blank_properties()
         seen: set[int] = set()
         while off < end:
             pid, off = read_varint(buf, off)
@@ -322,3 +322,27 @@ class Properties:
             if off > end:
                 raise MalformedPacketError("property ran past block end")
         return p, off
+
+
+_PROPS_TEMPLATE: dict | None = None
+
+
+def blank_properties() -> "Properties":
+    """Template-built Properties: immutable defaults shared, the two
+    list fields fresh — ~1/3 the cost of the generated __init__ on the
+    per-packet decode path."""
+    global _PROPS_TEMPLATE
+    if _PROPS_TEMPLATE is None:
+        import dataclasses
+
+        tmpl = {k: v for k, v in Properties().__dict__.items()
+                if not isinstance(v, (list, dict))}
+        # a future mutable field must be added to the resets below, not
+        # silently shared or dropped
+        assert set(tmpl) | {"subscription_ids", "user_properties"} ==             {f.name for f in dataclasses.fields(Properties)}
+        _PROPS_TEMPLATE = tmpl
+    q = object.__new__(Properties)
+    q.__dict__.update(_PROPS_TEMPLATE)
+    q.subscription_ids = []
+    q.user_properties = []
+    return q
