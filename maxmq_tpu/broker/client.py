@@ -178,12 +178,13 @@ class Client:
                 # greedy drain: one task wake-up flushes everything queued
                 # (one await per BURST, not per packet)
                 while packet is not None:
-                    if type(packet) is bytes:  # pre-encoded QoS0 fast path
+                    if type(packet) is bytes:  # pre-encoded fast path
                         self.writer.write(packet)
                         info = self.server.info
                         info.bytes_sent += len(packet)
                         info.packets_sent += 1
-                        info.messages_sent += 1
+                        if packet[0] >> 4 == PT.PUBLISH:
+                            info.messages_sent += 1
                     else:
                         self._write_packet(packet)
                     try:

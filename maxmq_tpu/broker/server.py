@@ -483,19 +483,31 @@ class Broker:
         reason = 0 if success else codes.ErrNotAuthorized.value
         if qos == 1:
             client.inflight.return_receive_quota()
-            client.send(Packet(fixed=FixedHeader(type=PT.PUBACK),
-                               protocol_version=packet.protocol_version,
-                               packet_id=packet.packet_id,
-                               reason_code=reason))
+            self._send_ack(client, PT.PUBACK, packet, reason)
         elif qos == 2:
             if success:
                 client.pubrec_inbound.add(packet.packet_id)
             else:
                 client.inflight.return_receive_quota()
-            client.send(Packet(fixed=FixedHeader(type=PT.PUBREC),
-                               protocol_version=packet.protocol_version,
-                               packet_id=packet.packet_id,
-                               reason_code=reason))
+            self._send_ack(client, PT.PUBREC, packet, reason)
+
+    def _send_ack(self, client: Client, ptype: int, packet: Packet,
+                  reason: int) -> None:
+        """QoS acks run once per QoS>0 publish: a success ack is a fixed
+        4-byte wire (v5 elides the zero reason code + empty properties,
+        [MQTT-3.4.2.1]), built directly unless a hook watches the encode
+        or sent events."""
+        pid = packet.packet_id
+        if reason == 0 and not self.hooks.overrides("on_packet_encode") \
+                and not self.hooks.overrides("on_packet_sent"):
+            # PUBACK/PUBREC/PUBCOMP only (flags 0). Broker-side PUBREL
+            # cannot take this path: it needs an inflight Packet copy
+            # for resend (_process_pubrec).
+            client.send_wire(bytes((ptype << 4, 2, pid >> 8, pid & 0xFF)))
+            return
+        client.send(Packet(fixed=FixedHeader(type=ptype),
+                           protocol_version=packet.protocol_version,
+                           packet_id=pid, reason_code=reason))
 
     def retain_message(self, client: Client, packet: Packet) -> None:
         stored = self.topics.retain(packet.copy())
@@ -737,12 +749,14 @@ class Broker:
         client.pubrec_inbound.discard(packet.packet_id)
         if known:
             client.inflight.return_receive_quota()
-        client.send(Packet(
-            fixed=FixedHeader(type=PT.PUBCOMP),
-            protocol_version=client.properties.protocol_version,
-            packet_id=packet.packet_id,
-            reason_code=0 if known or client.properties.protocol_version < 5
-            else codes.ErrPacketIdentifierNotFound.value))
+        if known or client.properties.protocol_version < 5:
+            self._send_ack(client, PT.PUBCOMP, packet, 0)
+        else:
+            client.send(Packet(
+                fixed=FixedHeader(type=PT.PUBCOMP),
+                protocol_version=client.properties.protocol_version,
+                packet_id=packet.packet_id,
+                reason_code=codes.ErrPacketIdentifierNotFound.value))
         if known:
             self.hooks.notify("on_qos_complete", client, packet)
 
