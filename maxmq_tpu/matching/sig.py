@@ -1517,6 +1517,14 @@ class SigEngine(OverlayedEngine):
         entry union for a previously dispatched batch."""
         cnt, rows, hostrows, tables = self.match_fixed([], out=ctx)
         toks8, lens_enc = ctx[4], ctx[5]
+        return self.decode_fixed(topics, cnt, rows, hostrows, tables,
+                                 toks8, lens_enc)
+
+    def decode_fixed(self, topics: list[str], cnt, rows, hostrows, tables,
+                     toks8, lens_enc) -> list[SubscriberSet]:
+        """Pure host decode given already-fetched match results: batch
+        verify + entry union. Split from collect_fixed so harnesses can
+        time (and the native runtime can own) this stage in isolation."""
         overlay = self.overlay_for(tables.version)
         if overlay == "resync":
             return self._resync_batch(topics)
