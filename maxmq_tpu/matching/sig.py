@@ -967,6 +967,64 @@ def _decode_cache(tables):
     return dc
 
 
+def _native_decode(tables):
+    """(maxmq_decode module, table capsule) for the C verify+union fast
+    path, built once per compiled snapshot — or None when the extension
+    is unavailable. Flattens every row's entry walk (the exact loop in
+    decode_fixed's python fallback) into an action stream the C pass
+    replays: PLAIN inserts, identifier MERGEs, SHARED-group inserts.
+    The capsule's Py_buffer views keep the arrays alive."""
+    nd = tables.__dict__.get("_native_decode", False)
+    if nd is not False:
+        return nd
+    nd = None
+    try:
+        from ..native import decode_module
+        mod = decode_module()
+        # engage only when trie.py's import-time rebind took: decode
+        # returns instances of mod.SubscriberSet, and mixing C results
+        # with the python fallback class would split the result type
+        if mod is not None and mod.SubscriberSet is SubscriberSet:
+            tok, min_depth, exact, wild_first, valid = \
+                _verify_arrays(tables)
+            flags = (exact.astype(np.uint8)
+                     | (wild_first.astype(np.uint8) << 1)
+                     | (valid.astype(np.uint8) << 2))
+            entries = tables.entries
+            offsets = np.zeros(len(tables.row_entries) + 1,
+                               dtype=np.int64)
+            kinds: list[int] = []
+            keys: list = []
+            cids: list = []
+            subs: list = []
+            for r, ents in enumerate(tables.row_entries):
+                for b in ents:
+                    e = entries[b]
+                    if e.group:
+                        for cid, sub in e.candidates.items():
+                            kinds.append(2)
+                            keys.append((e.group, sub.filter))
+                            cids.append(cid)
+                            subs.append(sub)
+                    else:
+                        sub = e.subscription
+                        kinds.append(1 if (sub.identifier
+                                           or sub.identifiers) else 0)
+                        keys.append(sub.filter)
+                        cids.append(e.client_id)
+                        subs.append(sub)
+                offsets[r + 1] = len(kinds)
+            cap = mod.table_new(
+                np.ascontiguousarray(tok),
+                np.ascontiguousarray(min_depth), flags, offsets,
+                np.array(kinds, dtype=np.uint8), keys, cids, subs)
+            nd = (mod, cap)
+    except Exception:
+        nd = None
+    tables.__dict__["_native_decode"] = nd
+    return nd
+
+
 def _candidate_pairs(batch: int, cnt, rows, hostrows, fall, tables):
     """Flatten device slots + host-probe hits into (topic_idx, row_id)
     pair arrays, dropping fallback topics and out-of-table row ids."""
@@ -1532,23 +1590,38 @@ class SigEngine(OverlayedEngine):
 
         batch = len(topics)
         self.matches += batch
-        lengths = np.abs(lens_enc.astype(np.int32))
-        dollar = lens_enc < 0
-        dtype, pad = _compact_dtype(tables)
-        toks32 = toks8.astype(np.int32)
-        if dtype is not np.int32:
-            toks32[toks32 == pad] = -1
-
         fall = cnt == 15
         ti, rw = _candidate_pairs(batch, cnt, rows, hostrows, fall, tables)
-        ok = verify_pairs(tables, toks32, lengths, dollar, ti, rw)
-        ti, rw = ti[ok], rw[ok]
 
-        out = [SubscriberSet() for _ in range(batch)]
+        nd = _native_decode(tables) if removed is None else None
+        if nd is not None:
+            # one C pass: verify + the whole entry union (plain inserts,
+            # identifier merges via the merge_subscription callback,
+            # shared-group maps) + the SubscriberSet construction —
+            # nothing left to walk in python
+            mod, capsule = nd
+            _dt, pad = _compact_dtype(tables)
+            out = mod.decode_batch(
+                capsule, toks8, toks8.dtype.itemsize, int(pad), lens_enc,
+                batch, np.ascontiguousarray(ti),
+                np.ascontiguousarray(rw))
+            ti = rw = None
+        else:
+            lengths = np.abs(lens_enc.astype(np.int32))
+            dollar = lens_enc < 0
+            dtype, pad = _compact_dtype(tables)
+            toks32 = toks8.astype(np.int32)
+            if dtype is not np.int32:
+                toks32[toks32 == pad] = -1
+            ok = verify_pairs(tables, toks32, lengths, dollar, ti, rw)
+            ti, rw = ti[ok], rw[ok]
+            out = [SubscriberSet() for _ in range(batch)]
         entries = tables.entries
         row_entries = tables.row_entries
         fast_cid, fast_sub = _decode_cache(tables)
-        if removed is None:
+        if ti is None:                 # the C pass already did the walk
+            pass
+        elif removed is None:
             # hot loop: verified rows only, fast-path rows are two dict
             # ops (merge_subscription aliases the stored Subscription)
             dicts = [s.subscriptions for s in out]
@@ -1589,14 +1662,19 @@ class SigEngine(OverlayedEngine):
                             continue
                         result.add(entry.client_id, sub, sub.filter)
 
-        res = []
-        for i, topic in enumerate(topics):
-            if fall[i]:
+        # overlay/fallback post-pass; the overwhelmingly common case
+        # (fresh tables, no overflow) returns the union output as-is
+        any_fall = bool(fall.any())
+        if overlay is not None:
+            fl = fall.tolist() if any_fall else None
+            for i, topic in enumerate(topics):
+                if fl is None or not fl[i]:   # fall slots get replaced
+                    out[i] = self.merge_delta(topic, out[i], overlay)
+        if any_fall:
+            for i in np.nonzero(fall)[0].tolist():
                 self.fallbacks += 1
-                res.append(self.index.subscribers(topic))
-            else:
-                res.append(self.merge_delta(topic, out[i], overlay))
-        return res
+                out[i] = self.index.subscribers(topics[i])
+        return out
 
     def _resync_batch(self, topics: list[str]) -> list[SubscriberSet]:
         """The journal no longer reaches the compiled tables (mutation

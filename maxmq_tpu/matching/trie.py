@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import threading
 from collections import deque
-from dataclasses import dataclass, field
 
 from ..protocol.packets import Packet, Subscription
 from .topics import is_dollar, parse_share, split_levels
@@ -57,16 +56,48 @@ def merge_subscription(base: Subscription | None, new: Subscription,
     return merged
 
 
-@dataclass
+def _copy_subscription(s: Subscription) -> Subscription:
+    """Field copy of one Subscription record (deep_copy's unit step)."""
+    return Subscription(filter=s.filter, qos=s.qos, no_local=s.no_local,
+                        retain_as_published=s.retain_as_published,
+                        retain_handling=s.retain_handling,
+                        identifier=s.identifier,
+                        identifiers=dict(s.identifiers))
+
+
 class SubscriberSet:
     """Result of a topic match: per-client merged non-shared subscriptions and
-    shared-group candidate maps (group -> client -> subscription)."""
+    shared-group candidate maps (group -> client -> subscription).
 
-    subscriptions: dict[str, Subscription] = field(default_factory=dict)
-    # (group, filter) -> client -> subscription: each pair delivers to exactly
-    # one of its members [MQTT-4.8.2-4].
-    shared: dict[tuple[str, str], dict[str, Subscription]] = field(
-        default_factory=dict)
+    A plain __slots__ class, not a dataclass: one of these is built per
+    matched topic on the fan-out hot path, and slot storage makes both
+    the constructor and the attribute reads measurably cheaper. When the
+    maxmq_decode C extension is present, the name below is rebound to
+    its C twin (same surface, C-speed construction); this class stays as
+    the documented fallback and the semantic reference."""
+
+    __slots__ = ("subscriptions", "shared")
+
+    def __init__(self, subscriptions: dict[str, Subscription] | None = None,
+                 shared: dict[tuple[str, str],
+                              dict[str, Subscription]] | None = None):
+        self.subscriptions = {} if subscriptions is None else subscriptions
+        # (group, filter) -> client -> subscription: each pair delivers to
+        # exactly one of its members [MQTT-4.8.2-4].
+        self.shared = {} if shared is None else shared
+
+    def __eq__(self, other) -> bool:
+        # duck-typed (not isinstance): must hold across the C twin and
+        # this fallback, and the module global is rebindable
+        try:
+            return (self.subscriptions == other.subscriptions
+                    and self.shared == other.shared)
+        except AttributeError:
+            return NotImplemented
+
+    def __repr__(self) -> str:
+        return (f"SubscriberSet(subscriptions={self.subscriptions!r}, "
+                f"shared={self.shared!r})")
 
     def add(self, client_id: str, sub: Subscription, filter_: str) -> None:
         self.subscriptions[client_id] = merge_subscription(
@@ -76,15 +107,7 @@ class SubscriberSet:
         """Copies of every Subscription record. Matching aliases stored
         Subscription objects for speed; hand a hook that may mutate
         delivery parameters this copy, never the originals."""
-        from ..protocol.packets import Subscription as S
-
-        def cp(s: Subscription) -> Subscription:
-            return S(filter=s.filter, qos=s.qos, no_local=s.no_local,
-                     retain_as_published=s.retain_as_published,
-                     retain_handling=s.retain_handling,
-                     identifier=s.identifier,
-                     identifiers=dict(s.identifiers))
-
+        cp = _copy_subscription
         return SubscriberSet(
             subscriptions={c: cp(s) for c, s in self.subscriptions.items()},
             shared={k: {c: cp(s) for c, s in m.items()}
@@ -96,6 +119,22 @@ class SubscriberSet:
 
     def __len__(self) -> int:
         return len(self.subscriptions) + sum(len(g) for g in self.shared.values())
+
+
+_PySubscriberSet = SubscriberSet
+try:
+    # rebind to the C twin when the extension is ALREADY BUILT —
+    # build=False keeps package import instant on fresh checkouts
+    # (`make -C native` produces the .so; sig.py's device path also
+    # builds it on demand, taking effect at the next interpreter)
+    from ..native import decode_module as _decode_module
+
+    _cmod = _decode_module(build=False)
+    if _cmod is not None:
+        _cmod.configure(merge_subscription, _copy_subscription)
+        SubscriberSet = _cmod.SubscriberSet  # type: ignore[misc]
+except Exception:       # any load failure keeps the python class
+    pass
 
 
 class _Node:

@@ -116,6 +116,50 @@ def available() -> bool:
     return _try_load() is not None
 
 
+_decode_mod = None
+_decode_attempted = False
+
+
+def decode_module(build: bool = True):
+    """The maxmq_decode CPython extension (candidate verify + subscriber
+    union in C; see native/maxmq_decode.cpp), or None. A separate .so
+    from the ctypes runtime because its hot loop builds Python objects —
+    that needs the C API, not a C ABI.
+
+    ``build=False`` only loads an already-built .so (import-time callers
+    must not block on a compile); the device match path passes the
+    default and compiles on demand."""
+    global _decode_mod, _decode_attempted
+    with _load_lock:
+        if _decode_attempted:
+            return _decode_mod
+        if os.environ.get("MAXMQ_NO_NATIVE"):
+            _decode_attempted = True
+            return None
+        path = os.path.join(_NATIVE_DIR, "maxmq_decode.so")
+        if not os.path.exists(path):
+            if not build or not os.path.isdir(_NATIVE_DIR):
+                return None            # stay retriable for build=True
+            try:
+                subprocess.run(["make", "-C", _NATIVE_DIR, "-s",
+                                "maxmq_decode.so"],
+                               check=True, capture_output=True, timeout=120)
+            except Exception:
+                _decode_attempted = True
+                return None
+        _decode_attempted = True
+        try:
+            import importlib.util
+            spec = importlib.util.spec_from_file_location(
+                "maxmq_decode", path)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _decode_mod = mod
+        except Exception:
+            _decode_mod = None
+        return _decode_mod
+
+
 class NativeVocab:
     """C++ mirror of a matcher vocabulary dict (level string -> token id).
     Built once per table refresh; reads are lock-free in C++."""

@@ -1,0 +1,621 @@
+// maxmq_decode — CPython extension owning the decode half of the
+// fixed-slot match path: candidate verification + the full per-topic
+// subscriber union (maxmq_tpu/matching/sig.py:decode_fixed), plus the
+// SubscriberSet result type itself.
+//
+// Why a C extension and not the ctypes runtime (maxmq_native.cpp): the
+// decode's output is Python objects — per-topic SubscriberSets holding
+// {client_id: Subscription} dicts, the merged-Subscribers shape of the
+// reference's TopicsIndex.Subscribers (vendor/github.com/mochi-co/
+// mqtt/v2/topics.go:484-518) — so the hot loop IS object construction
+// and PyDict traffic. Doing the verify compare, the dict inserts, AND
+// the result-object allocation in one C pass removes the interpreter
+// dispatch that capped the python walk at ~1.5M pairs/s and the
+// ~1.3us/topic object-building tail.
+//
+// SubscriberSet here is a heap type with C-speed construction; the
+// cold-path semantics (merge_subscription, Subscription copying for
+// deep_copy) stay in python and are registered via configure() so the
+// v5 identifier-union rules live in exactly one place (trie.py:32-57).
+//
+// Per compiled snapshot the python side flattens every row's entry
+// walk into an ACTION STREAM (CSR over rows). Each action is one of:
+//   PLAIN  — insert the stored Subscription aliased (the common case);
+//            a same-client collision calls merge_subscription exactly
+//            like SubscriberSet.add (trie.py)
+//   MERGE  — v5 subscription identifiers present: ALWAYS route through
+//            merge_subscription so the identifier-union copy semantics
+//            are preserved even for the first insert
+//   SHARED — shared-group candidate: shared[(group, filter)][cid] = sub
+//            [MQTT-4.8.2-4]; pre-built (group, filter) key tuples
+// Verification itself mirrors sig.py:verify_pairs (window compare,
+// depth rule, '$'-exclusion, valid bit) on the same arrays.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstddef>
+#include <cstdint>
+#include <vector>
+
+namespace {
+
+constexpr int32_t VER_PLUS = -1;   // '+' — matches any present level
+constexpr int32_t VER_ANY = -2;    // past the filter / probe window
+constexpr uint8_t FLAG_EXACT = 1;  // no trailing '#': depth must equal
+constexpr uint8_t FLAG_WILDF = 2;  // leading wildcard: '$'-excluded
+constexpr uint8_t FLAG_VALID = 4;  // row exists in this snapshot
+
+constexpr uint8_t ACT_PLAIN = 0;
+constexpr uint8_t ACT_MERGE = 1;
+constexpr uint8_t ACT_SHARED = 2;
+
+// registered by trie.py:configure() — the python-side semantics
+PyObject *g_merge_fn = nullptr;     // merge_subscription(base, new, filt)
+PyObject *g_copy_sub = nullptr;     // copy_subscription(sub)
+
+// ----------------------------------------------------------------- //
+//  SubscriberSet — the C result type                                //
+// ----------------------------------------------------------------- //
+
+struct SubSetObject {
+  PyObject_HEAD
+  PyObject *subscriptions;  // dict: client_id -> Subscription
+  PyObject *shared;         // dict: (group, filter) -> {cid: Subscription}
+};
+
+PyTypeObject *g_subset_type = nullptr;  // set at module init
+
+SubSetObject *subset_alloc() {
+  auto *self = PyObject_GC_New(SubSetObject, g_subset_type);
+  if (!self) return nullptr;
+  self->subscriptions = nullptr;
+  self->shared = nullptr;
+  PyObject_GC_Track(self);
+  return self;
+}
+
+// fast constructor used by decode_batch: steals nothing, fills missing
+// dicts lazily at first attribute read (see subset_getattro note) —
+// no: keep it simple and always materialize, dict alloc is ~40ns
+SubSetObject *subset_new_fast(PyObject *subs, PyObject *shared) {
+  auto *self = subset_alloc();
+  if (!self) return nullptr;
+  self->subscriptions = subs ? Py_NewRef(subs) : PyDict_New();
+  self->shared = shared ? Py_NewRef(shared) : PyDict_New();
+  if (!self->subscriptions || !self->shared) {
+    Py_DECREF(self);
+    return nullptr;
+  }
+  return self;
+}
+
+int subset_init(PyObject *self_o, PyObject *args, PyObject *kwargs) {
+  auto *self = reinterpret_cast<SubSetObject *>(self_o);
+  PyObject *subs = nullptr, *shared = nullptr;
+  static const char *kwlist[] = {"subscriptions", "shared", nullptr};
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "|OO",
+                                   const_cast<char **>(kwlist), &subs,
+                                   &shared))
+    return -1;
+  if (subs == Py_None) subs = nullptr;
+  if (shared == Py_None) shared = nullptr;
+  PyObject *ns = subs ? Py_NewRef(subs) : PyDict_New();
+  PyObject *nh = shared ? Py_NewRef(shared) : PyDict_New();
+  if (!ns || !nh) {
+    Py_XDECREF(ns);
+    Py_XDECREF(nh);
+    return -1;
+  }
+  Py_XSETREF(self->subscriptions, ns);
+  Py_XSETREF(self->shared, nh);
+  return 0;
+}
+
+int subset_traverse(PyObject *self_o, visitproc visit, void *arg) {
+  auto *self = reinterpret_cast<SubSetObject *>(self_o);
+  Py_VISIT(self->subscriptions);
+  Py_VISIT(self->shared);
+  return 0;
+}
+
+int subset_clear(PyObject *self_o) {
+  auto *self = reinterpret_cast<SubSetObject *>(self_o);
+  Py_CLEAR(self->subscriptions);
+  Py_CLEAR(self->shared);
+  return 0;
+}
+
+void subset_dealloc(PyObject *self_o) {
+  PyObject_GC_UnTrack(self_o);
+  subset_clear(self_o);
+  PyTypeObject *tp = Py_TYPE(self_o);
+  PyObject_GC_Del(self_o);
+  Py_DECREF(tp);  // heap types own a ref from each instance
+}
+
+// add(client_id, sub, filter_) — merge-insert one non-shared
+// subscription; mirrors trie.py SubscriberSet.add
+PyObject *subset_add(PyObject *self_o, PyObject *const *args,
+                     Py_ssize_t nargs) {
+  if (nargs != 3) {
+    PyErr_SetString(PyExc_TypeError,
+                    "add(client_id, sub, filter_) takes 3 arguments");
+    return nullptr;
+  }
+  auto *self = reinterpret_cast<SubSetObject *>(self_o);
+  PyObject *cur = PyDict_GetItemWithError(self->subscriptions, args[0]);
+  if (!cur && PyErr_Occurred()) return nullptr;
+  PyObject *mg = PyObject_CallFunctionObjArgs(
+      g_merge_fn, cur ? cur : Py_None, args[1], args[2], nullptr);
+  if (!mg) return nullptr;
+  const int rc = PyDict_SetItem(self->subscriptions, args[0], mg);
+  Py_DECREF(mg);
+  if (rc < 0) return nullptr;
+  Py_RETURN_NONE;
+}
+
+// add_shared(group, filter_, client_id, sub)
+PyObject *subset_add_shared(PyObject *self_o, PyObject *const *args,
+                            Py_ssize_t nargs) {
+  if (nargs != 4) {
+    PyErr_SetString(
+        PyExc_TypeError,
+        "add_shared(group, filter_, client_id, sub) takes 4 arguments");
+    return nullptr;
+  }
+  auto *self = reinterpret_cast<SubSetObject *>(self_o);
+  PyObject *key = PyTuple_Pack(2, args[0], args[1]);
+  if (!key) return nullptr;
+  PyObject *g = PyDict_GetItemWithError(self->shared, key);
+  if (!g) {
+    if (PyErr_Occurred()) {
+      Py_DECREF(key);
+      return nullptr;
+    }
+    g = PyDict_New();
+    if (!g || PyDict_SetItem(self->shared, key, g) < 0) {
+      Py_XDECREF(g);
+      Py_DECREF(key);
+      return nullptr;
+    }
+    Py_DECREF(g);  // borrowed from self->shared hereafter
+  }
+  Py_DECREF(key);
+  if (PyDict_SetItem(g, args[2], args[3]) < 0) return nullptr;
+  Py_RETURN_NONE;
+}
+
+// deep_copy() — copies every Subscription via the registered python
+// helper; hook-facing cold path (hooks may mutate delivery params)
+PyObject *subset_deep_copy(PyObject *self_o, PyObject *) {
+  auto *self = reinterpret_cast<SubSetObject *>(self_o);
+  PyObject *subs = PyDict_New(), *shared = nullptr;
+  if (subs) shared = PyDict_New();
+  if (!subs || !shared) {
+    Py_XDECREF(subs);
+    Py_XDECREF(shared);
+    return nullptr;
+  }
+  auto bail = [&]() -> PyObject * {
+    Py_DECREF(subs);
+    Py_DECREF(shared);
+    return nullptr;
+  };
+  PyObject *k, *v;
+  Py_ssize_t pos = 0;
+  while (PyDict_Next(self->subscriptions, &pos, &k, &v)) {
+    PyObject *cp = PyObject_CallOneArg(g_copy_sub, v);
+    if (!cp || PyDict_SetItem(subs, k, cp) < 0) {
+      Py_XDECREF(cp);
+      return bail();
+    }
+    Py_DECREF(cp);
+  }
+  pos = 0;
+  while (PyDict_Next(self->shared, &pos, &k, &v)) {
+    PyObject *m = PyDict_New();
+    if (!m || PyDict_SetItem(shared, k, m) < 0) {
+      Py_XDECREF(m);
+      return bail();
+    }
+    Py_DECREF(m);
+    PyObject *k2, *v2;
+    Py_ssize_t pos2 = 0;
+    while (PyDict_Next(v, &pos2, &k2, &v2)) {
+      PyObject *cp = PyObject_CallOneArg(g_copy_sub, v2);
+      if (!cp || PyDict_SetItem(m, k2, cp) < 0) {
+        Py_XDECREF(cp);
+        return bail();
+      }
+      Py_DECREF(cp);
+    }
+  }
+  auto *out = subset_new_fast(subs, shared);
+  Py_DECREF(subs);
+  Py_DECREF(shared);
+  return reinterpret_cast<PyObject *>(out);
+}
+
+Py_ssize_t subset_len(PyObject *self_o) {
+  auto *self = reinterpret_cast<SubSetObject *>(self_o);
+  Py_ssize_t n = PyDict_Size(self->subscriptions);
+  PyObject *k, *v;
+  Py_ssize_t pos = 0;
+  while (PyDict_Next(self->shared, &pos, &k, &v)) n += PyDict_Size(v);
+  return n;
+}
+
+PyObject *subset_richcompare(PyObject *a, PyObject *b, int op) {
+  if ((op != Py_EQ && op != Py_NE) ||
+      !PyObject_TypeCheck(a, g_subset_type) ||
+      !PyObject_TypeCheck(b, g_subset_type))
+    Py_RETURN_NOTIMPLEMENTED;
+  auto *x = reinterpret_cast<SubSetObject *>(a);
+  auto *y = reinterpret_cast<SubSetObject *>(b);
+  int eq = PyObject_RichCompareBool(x->subscriptions, y->subscriptions,
+                                    Py_EQ);
+  if (eq > 0) eq = PyObject_RichCompareBool(x->shared, y->shared, Py_EQ);
+  if (eq < 0) return nullptr;
+  return PyBool_FromLong(op == Py_EQ ? eq : !eq);
+}
+
+PyObject *subset_repr(PyObject *self_o) {
+  auto *self = reinterpret_cast<SubSetObject *>(self_o);
+  return PyUnicode_FromFormat("SubscriberSet(subscriptions=%R, shared=%R)",
+                              self->subscriptions, self->shared);
+}
+
+PyMemberDef subset_members[] = {
+    {"subscriptions", Py_T_OBJECT_EX, offsetof(SubSetObject, subscriptions),
+     0, "client_id -> merged Subscription"},
+    {"shared", Py_T_OBJECT_EX, offsetof(SubSetObject, shared), 0,
+     "(group, filter) -> {client_id: Subscription}"},
+    {nullptr, 0, 0, 0, nullptr}};
+
+PyMethodDef subset_methods[] = {
+    {"add", reinterpret_cast<PyCFunction>(subset_add), METH_FASTCALL,
+     "Merge-insert a non-shared subscription."},
+    {"add_shared", reinterpret_cast<PyCFunction>(subset_add_shared),
+     METH_FASTCALL, "Insert a shared-group candidate."},
+    {"deep_copy", subset_deep_copy, METH_NOARGS,
+     "Subscription-deep copy for hooks that may mutate."},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyType_Slot subset_slots[] = {
+    {Py_tp_doc, const_cast<char *>(
+         "Result of a topic match: per-client merged non-shared "
+         "subscriptions and shared-group candidate maps "
+         "(group -> client -> subscription). C-accelerated twin of "
+         "matching/trie.py's python fallback.")},
+    {Py_tp_init, reinterpret_cast<void *>(subset_init)},
+    {Py_tp_dealloc, reinterpret_cast<void *>(subset_dealloc)},
+    {Py_tp_traverse, reinterpret_cast<void *>(subset_traverse)},
+    {Py_tp_clear, reinterpret_cast<void *>(subset_clear)},
+    {Py_tp_members, subset_members},
+    {Py_tp_methods, subset_methods},
+    {Py_sq_length, reinterpret_cast<void *>(subset_len)},
+    {Py_tp_richcompare, reinterpret_cast<void *>(subset_richcompare)},
+    {Py_tp_repr, reinterpret_cast<void *>(subset_repr)},
+    {0, nullptr}};
+
+PyType_Spec subset_spec = {
+    "maxmq_decode.SubscriberSet", sizeof(SubSetObject), 0,
+    Py_TPFLAGS_DEFAULT | Py_TPFLAGS_BASETYPE | Py_TPFLAGS_HAVE_GC,
+    subset_slots};
+
+// configure(merge_fn, copy_sub_fn) — register the python semantics
+PyObject *configure(PyObject *, PyObject *args) {
+  PyObject *merge, *copy;
+  if (!PyArg_ParseTuple(args, "OO", &merge, &copy)) return nullptr;
+  Py_XSETREF(g_merge_fn, Py_NewRef(merge));
+  Py_XSETREF(g_copy_sub, Py_NewRef(copy));
+  Py_RETURN_NONE;
+}
+
+// ----------------------------------------------------------------- //
+//  Decode table + batch                                             //
+// ----------------------------------------------------------------- //
+
+struct DecodeTable {
+  Py_buffer tok;        // int32 [R, W] row-major
+  Py_buffer min_depth;  // int32 [R]
+  Py_buffer flags;      // uint8 [R]
+  Py_buffer offsets;    // int64 [R + 1] action CSR
+  Py_buffer kinds;      // uint8 [A]
+  PyObject *keys;       // list len A: filter str (PLAIN/MERGE) or
+                        //             (group, filter) tuple (SHARED)
+  PyObject *cids;       // list len A: client-id str
+  PyObject *subs;       // list len A: Subscription
+  std::vector<PyObject *> key, cid, sub;  // borrowed from the lists
+  Py_ssize_t R, W, A;
+};
+
+void table_destroy(PyObject *capsule) {
+  auto *t = static_cast<DecodeTable *>(
+      PyCapsule_GetPointer(capsule, "maxmq_decode.table"));
+  if (!t) return;
+  PyBuffer_Release(&t->tok);
+  PyBuffer_Release(&t->min_depth);
+  PyBuffer_Release(&t->flags);
+  PyBuffer_Release(&t->offsets);
+  PyBuffer_Release(&t->kinds);
+  Py_XDECREF(t->keys);
+  Py_XDECREF(t->cids);
+  Py_XDECREF(t->subs);
+  delete t;
+}
+
+// table_new(tok, min_depth, flags, offsets, kinds, keys, cids, subs)
+//   -> capsule
+PyObject *table_new(PyObject *, PyObject *args) {
+  PyObject *tok_o, *md_o, *fl_o, *off_o, *kind_o;
+  PyObject *keys, *cids, *subs;
+  if (!PyArg_ParseTuple(args, "OOOOOOOO", &tok_o, &md_o, &fl_o, &off_o,
+                        &kind_o, &keys, &cids, &subs))
+    return nullptr;
+  if (!g_merge_fn) {
+    PyErr_SetString(PyExc_RuntimeError, "configure() not called");
+    return nullptr;
+  }
+  auto t = new DecodeTable();
+  t->tok.obj = t->min_depth.obj = t->flags.obj = nullptr;
+  t->offsets.obj = t->kinds.obj = nullptr;
+  t->keys = t->cids = t->subs = nullptr;
+  PyObject *capsule = PyCapsule_New(t, "maxmq_decode.table",
+                                    table_destroy);
+  if (!capsule) {
+    delete t;
+    return nullptr;
+  }
+  auto fail = [&](const char *msg) -> PyObject * {
+    if (msg) PyErr_SetString(PyExc_ValueError, msg);
+    Py_DECREF(capsule);  // destructor releases whatever was acquired
+    return nullptr;
+  };
+  if (PyObject_GetBuffer(tok_o, &t->tok, PyBUF_SIMPLE) < 0 ||
+      PyObject_GetBuffer(md_o, &t->min_depth, PyBUF_SIMPLE) < 0 ||
+      PyObject_GetBuffer(fl_o, &t->flags, PyBUF_SIMPLE) < 0 ||
+      PyObject_GetBuffer(off_o, &t->offsets, PyBUF_SIMPLE) < 0 ||
+      PyObject_GetBuffer(kind_o, &t->kinds, PyBUF_SIMPLE) < 0)
+    return fail(nullptr);
+  if (!PyList_Check(keys) || !PyList_Check(cids) || !PyList_Check(subs))
+    return fail("keys/cids/subs must be lists");
+  t->R = (Py_ssize_t)t->flags.len;
+  t->A = PyList_GET_SIZE(keys);
+  if ((Py_ssize_t)t->min_depth.len != t->R * 4 ||
+      (Py_ssize_t)t->offsets.len != (t->R + 1) * 8 ||
+      (Py_ssize_t)t->kinds.len != t->A ||
+      PyList_GET_SIZE(cids) != t->A || PyList_GET_SIZE(subs) != t->A ||
+      (t->R && t->tok.len % (t->R * 4) != 0))
+    return fail("table array lengths disagree");
+  const auto *off = static_cast<const int64_t *>(t->offsets.buf);
+  if (off[0] != 0 || off[t->R] != t->A)
+    return fail("offsets do not span the action stream");
+  for (Py_ssize_t r = 0; r < t->R; r++)
+    if (off[r] > off[r + 1]) return fail("offsets not monotonic");
+  t->W = t->R ? t->tok.len / (t->R * 4) : 0;
+  t->keys = Py_NewRef(keys);
+  t->cids = Py_NewRef(cids);
+  t->subs = Py_NewRef(subs);
+  t->key.resize(t->A);
+  t->cid.resize(t->A);
+  t->sub.resize(t->A);
+  for (Py_ssize_t a = 0; a < t->A; a++) {
+    t->key[a] = PyList_GET_ITEM(keys, a);  // borrowed; lists hold refs
+    t->cid[a] = PyList_GET_ITEM(cids, a);
+    t->sub[a] = PyList_GET_ITEM(subs, a);
+  }
+  return capsule;
+}
+
+inline int32_t topic_tok(const void *base, int mode, int32_t pad,
+                         Py_ssize_t t, Py_ssize_t W, Py_ssize_t i) {
+  int32_t v;
+  switch (mode) {
+    case 1: v = static_cast<const uint8_t *>(base)[t * W + i]; break;
+    case 2: v = static_cast<const uint16_t *>(base)[t * W + i]; break;
+    default: v = static_cast<const int32_t *>(base)[t * W + i]; break;
+  }
+  return v == pad ? -1 : v;
+}
+
+// result[t] as a SubscriberSet, materialized on first touch
+inline SubSetObject *lazy_set(PyObject *list, Py_ssize_t t) {
+  PyObject *s = PyList_GET_ITEM(list, t);
+  if (s != Py_None) return reinterpret_cast<SubSetObject *>(s);
+  auto *n = subset_new_fast(nullptr, nullptr);
+  if (!n) return nullptr;
+  PyList_SetItem(list, t, reinterpret_cast<PyObject *>(n));  // steals
+  return n;
+}
+
+// decode_batch(table, toks, mode, pad, lens_enc, B, ti, rw)
+//   -> list[SubscriberSet] of length B (every slot populated)
+//
+// toks: [B, Wt] tokens in the compact dtype (mode 1/2/4 = u8/u16/i32),
+// pad: that dtype's pad value. ti/rw: int64 UNVERIFIED candidate pair
+// arrays (fallback topics and out-of-table rows already dropped by
+// _candidate_pairs). Unverified pairs are discarded; verified rows'
+// action streams are applied.
+PyObject *decode_batch(PyObject *, PyObject *args) {
+  PyObject *cap, *toks_o, *lens_o, *ti_o, *rw_o;
+  int mode;
+  long pad_l;
+  Py_ssize_t B;
+  if (!PyArg_ParseTuple(args, "OOilOnOO", &cap, &toks_o, &mode, &pad_l,
+                        &lens_o, &B, &ti_o, &rw_o))
+    return nullptr;
+  auto *t = static_cast<DecodeTable *>(
+      PyCapsule_GetPointer(cap, "maxmq_decode.table"));
+  if (!t) return nullptr;
+
+  Py_buffer bufs[4];
+  PyObject *objs[4] = {toks_o, lens_o, ti_o, rw_o};
+  int n_buf = 0;
+  struct Rel {
+    Py_buffer *b;
+    int *n;
+    ~Rel() {
+      for (int i = 0; i < *n; i++) PyBuffer_Release(&b[i]);
+    }
+  } rel{bufs, &n_buf};
+  while (n_buf < 4) {
+    if (PyObject_GetBuffer(objs[n_buf], &bufs[n_buf], PyBUF_SIMPLE) < 0)
+      return nullptr;
+    n_buf++;
+  }
+  const Py_buffer &toks = bufs[0], &lens = bufs[1];
+  const Py_buffer &ti_b = bufs[2], &rw_b = bufs[3];
+
+  const Py_ssize_t N = ti_b.len / 8;
+  const Py_ssize_t Wt = B ? toks.len / (B * mode) : 0;
+  const Py_ssize_t W = t->W < Wt ? t->W : Wt;
+  if ((Py_ssize_t)rw_b.len / 8 < N || (Py_ssize_t)lens.len < B) {
+    PyErr_SetString(PyExc_ValueError, "batch array lengths disagree");
+    return nullptr;
+  }
+  const auto *ti = static_cast<const int64_t *>(ti_b.buf);
+  const auto *rw = static_cast<const int64_t *>(rw_b.buf);
+  const auto *lens_enc = static_cast<const int8_t *>(lens.buf);
+  const auto *tok = static_cast<const int32_t *>(t->tok.buf);
+  const auto *md = static_cast<const int32_t *>(t->min_depth.buf);
+  const auto *fl = static_cast<const uint8_t *>(t->flags.buf);
+  const auto *off = static_cast<const int64_t *>(t->offsets.buf);
+  const auto *kind = static_cast<const uint8_t *>(t->kinds.buf);
+  const int32_t pad = static_cast<int32_t>(pad_l);
+
+  PyObject *out = PyList_New(B);
+  if (!out) return nullptr;
+  for (Py_ssize_t i = 0; i < B; i++)
+    PyList_SET_ITEM(out, i, Py_NewRef(Py_None));
+  auto bail = [&]() -> PyObject * {
+    Py_DECREF(out);
+    return nullptr;
+  };
+
+  for (Py_ssize_t k = 0; k < N; k++) {
+    const int64_t tp = ti[k], r = rw[k];
+    if (tp < 0 || tp >= B || r < 0 || r >= t->R) continue;
+    const uint8_t f = fl[r];
+    if (!(f & FLAG_VALID)) continue;
+    const int8_t le = lens_enc[tp];
+    const int32_t ln = le < 0 ? -static_cast<int32_t>(le) : le;
+    const int32_t m = md[r];
+    if ((f & FLAG_EXACT) ? (ln != m) : (ln < m)) continue;
+    if (le < 0 && (f & FLAG_WILDF)) continue;
+    const int32_t *rt = tok + r * t->W;
+    bool ok = true;
+    for (Py_ssize_t i = 0; i < W; i++) {
+      const int32_t rv = rt[i];
+      if (rv == VER_ANY || rv == VER_PLUS) continue;
+      if (rv != topic_tok(toks.buf, mode, pad, tp, Wt, i)) {
+        ok = false;
+        break;
+      }
+    }
+    // window positions past the topic matrix (t->W > Wt) would read
+    // topic token -1; only ANY/'+'/pad-literal can match there
+    for (Py_ssize_t i = W; ok && i < t->W; i++) {
+      const int32_t rv = rt[i];
+      if (rv != VER_ANY && rv != VER_PLUS && rv != -1) ok = false;
+    }
+    if (!ok) continue;
+
+    SubSetObject *res = lazy_set(out, tp);
+    if (!res) return bail();
+    for (int64_t a = off[r]; a < off[r + 1]; a++) {
+      switch (kind[a]) {
+        case ACT_PLAIN: {
+          PyObject *cur =
+              PyDict_GetItemWithError(res->subscriptions, t->cid[a]);
+          if (!cur) {
+            if (PyErr_Occurred() ||
+                PyDict_SetItem(res->subscriptions, t->cid[a],
+                               t->sub[a]) < 0)
+              return bail();
+          } else if (cur != t->sub[a]) {  // same-client collision
+            PyObject *mg = PyObject_CallFunctionObjArgs(
+                g_merge_fn, cur, t->sub[a], t->key[a], nullptr);
+            if (!mg ||
+                PyDict_SetItem(res->subscriptions, t->cid[a], mg) < 0) {
+              Py_XDECREF(mg);
+              return bail();
+            }
+            Py_DECREF(mg);
+          }
+          break;
+        }
+        case ACT_MERGE: {  // v5 identifiers: copy semantics via python
+          PyObject *cur =
+              PyDict_GetItemWithError(res->subscriptions, t->cid[a]);
+          if (!cur && PyErr_Occurred()) return bail();
+          PyObject *mg = PyObject_CallFunctionObjArgs(
+              g_merge_fn, cur ? cur : Py_None, t->sub[a], t->key[a],
+              nullptr);
+          if (!mg ||
+              PyDict_SetItem(res->subscriptions, t->cid[a], mg) < 0) {
+            Py_XDECREF(mg);
+            return bail();
+          }
+          Py_DECREF(mg);
+          break;
+        }
+        default: {  // ACT_SHARED
+          PyObject *g = PyDict_GetItemWithError(res->shared, t->key[a]);
+          if (!g) {
+            if (PyErr_Occurred()) return bail();
+            g = PyDict_New();
+            if (!g || PyDict_SetItem(res->shared, t->key[a], g) < 0) {
+              Py_XDECREF(g);
+              return bail();
+            }
+            Py_DECREF(g);  // res->shared holds the ref now
+          }
+          if (PyDict_SetItem(g, t->cid[a], t->sub[a]) < 0) return bail();
+          break;
+        }
+      }
+    }
+  }
+  // fill the untouched slots with fresh empty sets so every consumer
+  // sees a real SubscriberSet (callers may mutate their slot)
+  for (Py_ssize_t i = 0; i < B; i++) {
+    if (PyList_GET_ITEM(out, i) != Py_None) continue;
+    auto *n = subset_new_fast(nullptr, nullptr);
+    if (!n) return bail();
+    PyList_SetItem(out, i, reinterpret_cast<PyObject *>(n));
+  }
+  return out;
+}
+
+PyMethodDef methods[] = {
+    {"configure", configure, METH_VARARGS,
+     "Register merge_subscription and the Subscription copy helper."},
+    {"table_new", table_new, METH_VARARGS,
+     "Register a compiled-snapshot decode table; returns a capsule."},
+    {"decode_batch", decode_batch, METH_VARARGS,
+     "Verify candidate pairs and union their subscriber entries into "
+     "per-topic SubscriberSets."},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyModuleDef mod = {PyModuleDef_HEAD_INIT, "maxmq_decode",
+                   "Native verify + subscriber-union decode.", -1,
+                   methods, nullptr, nullptr, nullptr, nullptr};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit_maxmq_decode(void) {
+  PyObject *m = PyModule_Create(&mod);
+  if (!m) return nullptr;
+  auto *tp = reinterpret_cast<PyTypeObject *>(
+      PyType_FromSpec(&subset_spec));
+  if (!tp || PyModule_AddObject(m, "SubscriberSet",
+                                reinterpret_cast<PyObject *>(tp)) < 0) {
+    Py_XDECREF(reinterpret_cast<PyObject *>(tp));
+    Py_DECREF(m);
+    return nullptr;
+  }
+  g_subset_type = tp;  // module holds the ref
+  return m;
+}
