@@ -211,6 +211,8 @@ def stage_decomposition(engine, topics_batch: list[str],
         batch * iters / (time.perf_counter() - t0), 1)
 
     if fmt["kind"] == "stream":
+        # the dispatch loop above ended with block_until_ready, so this
+        # times the pure device->host transfer
         counts_dev, stream_dev = out
         t0 = time.perf_counter()
         cnt_u8 = np.asarray(counts_dev)

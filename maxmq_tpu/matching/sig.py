@@ -1025,14 +1025,10 @@ def _native_decode(tables):
     return nd
 
 
-def _candidate_pairs(batch: int, cnt, rows, hostrows, fall, tables):
-    """Flatten device slots + host-probe hits into (topic_idx, row_id)
-    pair arrays, dropping fallback topics and out-of-table row ids."""
-    kr = rows.shape[1]
-    real = np.where(fall, 0, cnt).astype(np.int64)
-    dmask = np.arange(kr, dtype=np.int64)[None, :] < real[:, None]
-    ti_dev = np.repeat(np.arange(batch), real)
-    rw_dev = rows[dmask].astype(np.int64)
+def _pairs_with_host(batch: int, ti_dev, rw_dev, hostrows, fall, tables):
+    """Concatenate device pairs with the host-probe hits and drop
+    fallback topics / out-of-table row ids (group-padded layouts emit
+    padding row ids past the real table)."""
     if isinstance(hostrows, HostRows):
         offs = hostrows.offsets[:batch + 1]
         ti_h = np.repeat(np.arange(batch), np.diff(offs))
@@ -1047,6 +1043,17 @@ def _candidate_pairs(batch: int, cnt, rows, hostrows, fall, tables):
     rw = np.concatenate([rw_dev, rw_h])
     keep = ~fall[ti] & (rw < len(tables.row_levels))
     return ti[keep], rw[keep]
+
+
+def _candidate_pairs(batch: int, cnt, rows, hostrows, fall, tables):
+    """Flatten device slots + host-probe hits into (topic_idx, row_id)
+    pair arrays, dropping fallback topics and out-of-table row ids."""
+    kr = rows.shape[1]
+    real = np.where(fall, 0, cnt).astype(np.int64)
+    dmask = np.arange(kr, dtype=np.int64)[None, :] < real[:, None]
+    ti_dev = np.repeat(np.arange(batch), real)
+    rw_dev = rows[dmask].astype(np.int64)
+    return _pairs_with_host(batch, ti_dev, rw_dev, hostrows, fall, tables)
 
 
 def verify_pairs(tables, toks32, lengths, dollar, ti, rw) -> np.ndarray:
@@ -1458,35 +1465,13 @@ class SigEngine(OverlayedEngine):
         out, hostrows, tables, fmt = out[:4]
         kind = fmt["kind"]
         if kind == "stream":
-            # counts + compacted row stream (the Pallas path's wire
-            # format): the counts and the hint-predicted front of the
-            # stream were already fetched asynchronously at dispatch
-            # time; only a hint shortfall costs a synchronous slice here.
-            # 255 = overflow sentinel -> 15, the fixed-path convention.
-            counts_dev, stream_dev, slices = out
+            cnt, real, flat = self._fetch_stream(out)
             kr = fmt["max_rows"]
-            cnt_u8 = np.asarray(counts_dev)
-            cnt = np.where(cnt_u8 == 0xFF, 15, cnt_u8).astype(np.int32)
-            real = np.where(cnt_u8 == 0xFF, 0, cnt_u8).astype(np.int64)
-            total = int(real.sum())
-            # EMA hint for the next dispatch's prefetch (~1.25x headroom)
-            self._stream_rows_hint = (self._stream_rows_hint
-                                      + total + total // 4) // 2
             rows = np.full((len(cnt), kr), 0xFFFFFFFF, dtype=np.uint32)
-            if total:
-                have = sum(s.shape[0] for s in slices)
-                parts = [np.asarray(s) for s in slices]
-                c0 = have
-                cap = stream_dev.shape[0]
-                while c0 < total:
-                    n = min(_STREAM_CHUNK, cap - c0)
-                    parts.append(np.asarray(stream_dev[c0:c0 + n]))
-                    c0 += n
-                flat = parts[0] if len(parts) == 1 else np.concatenate(
-                    parts)
+            if flat is not None:
                 mask = np.arange(kr, dtype=np.int64)[None, :] \
                     < real[:, None]
-                rows[mask] = flat[:total]
+                rows[mask] = flat
             return cnt, rows, hostrows, tables
         o = np.asarray(out)
         if kind == "fmt16":
@@ -1500,6 +1485,34 @@ class SigEngine(OverlayedEngine):
             cnt = o[:, 0].astype(np.int32)
             rows = o[:, 1:1 + self.fixed_max_rows]
         return cnt, rows, hostrows, tables
+
+    def _fetch_stream(self, out):
+        """Fetch the stream wire format to host: (cnt int32[B] with 15 =
+        overflow, real int64[B] true per-topic counts, flat uint32[total]
+        topic-sorted row stream or None when empty). The counts and the
+        hint-predicted front of the stream were already fetched
+        asynchronously at dispatch time; only a hint shortfall costs a
+        synchronous slice here. 255 = overflow sentinel -> 15."""
+        counts_dev, stream_dev, slices = out
+        cnt_u8 = np.asarray(counts_dev)
+        cnt = np.where(cnt_u8 == 0xFF, 15, cnt_u8).astype(np.int32)
+        real = np.where(cnt_u8 == 0xFF, 0, cnt_u8).astype(np.int64)
+        total = int(real.sum())
+        # EMA hint for the next dispatch's prefetch (~1.25x headroom)
+        self._stream_rows_hint = (self._stream_rows_hint
+                                  + total + total // 4) // 2
+        if not total:
+            return cnt, real, None
+        have = sum(s.shape[0] for s in slices)
+        parts = [np.asarray(s) for s in slices]
+        c0 = have
+        cap = stream_dev.shape[0]
+        while c0 < total:
+            n = min(_STREAM_CHUNK, cap - c0)
+            parts.append(np.asarray(stream_dev[c0:c0 + n]))
+            c0 += n
+        flat = parts[0] if len(parts) == 1 else np.concatenate(parts)
+        return cnt, real, flat[:total]
 
     def dispatch_fixed(self, topics: list[str]):
         """Tokenize + enqueue the fixed-slot match without waiting: the
@@ -1572,17 +1585,46 @@ class SigEngine(OverlayedEngine):
 
     def collect_fixed(self, topics: list[str], ctx) -> list[SubscriberSet]:
         """Decode half of the fixed-slot path: fetch + batch-verify +
-        entry union for a previously dispatched batch."""
-        cnt, rows, hostrows, tables = self.match_fixed([], out=ctx)
+        entry union for a previously dispatched batch. The stream wire
+        format skips the [B, max_rows] matrix round-trip entirely — the
+        fetched stream already IS the topic-sorted device pair list."""
+        out, hostrows, tables, fmt = ctx[:4]
         toks8, lens_enc = ctx[4], ctx[5]
+        if fmt["kind"] == "stream":
+            if self.overlay_for(tables.version) == "resync":
+                return self._resync_batch(topics)   # skip the flatten
+            cnt, real, flat = self._fetch_stream(out)
+            batch = len(topics)
+            fall = cnt == 15
+            ti_dev = np.repeat(np.arange(batch), real)
+            rw_dev = (flat.astype(np.int64) if flat is not None
+                      else np.empty(0, dtype=np.int64))
+            ti, rw = _pairs_with_host(batch, ti_dev, rw_dev, hostrows,
+                                      fall, tables)
+            return self.decode_pairs(topics, fall, ti, rw, tables,
+                                     toks8, lens_enc)
+        cnt, rows, hostrows, tables = self.match_fixed([], out=ctx)
         return self.decode_fixed(topics, cnt, rows, hostrows, tables,
                                  toks8, lens_enc)
 
     def decode_fixed(self, topics: list[str], cnt, rows, hostrows, tables,
                      toks8, lens_enc) -> list[SubscriberSet]:
-        """Pure host decode given already-fetched match results: batch
-        verify + entry union. Split from collect_fixed so harnesses can
-        time (and the native runtime can own) this stage in isolation."""
+        """Pure host decode given already-fetched match results in the
+        row-matrix form: batch verify + entry union. Split from
+        collect_fixed so harnesses can time this stage in isolation."""
+        if self.overlay_for(tables.version) == "resync":
+            return self._resync_batch(topics)       # skip the flatten
+        fall = cnt == 15
+        ti, rw = _candidate_pairs(len(topics), cnt, rows, hostrows, fall,
+                                  tables)
+        return self.decode_pairs(topics, fall, ti, rw, tables, toks8,
+                                 lens_enc)
+
+    def decode_pairs(self, topics: list[str], fall, ti, rw, tables,
+                     toks8, lens_enc) -> list[SubscriberSet]:
+        """Pure host decode given flattened candidate pairs: batch
+        verify + entry union (one C pass when the maxmq_decode extension
+        is active)."""
         overlay = self.overlay_for(tables.version)
         if overlay == "resync":
             return self._resync_batch(topics)
@@ -1590,8 +1632,6 @@ class SigEngine(OverlayedEngine):
 
         batch = len(topics)
         self.matches += batch
-        fall = cnt == 15
-        ti, rw = _candidate_pairs(batch, cnt, rows, hostrows, fall, tables)
 
         nd = _native_decode(tables) if removed is None else None
         if nd is not None:
