@@ -380,6 +380,10 @@ class Broker:
         elif t == PT.DISCONNECT:
             self._process_disconnect(client, packet)
         elif t == PT.AUTH:
+            if not packet.reason_code_valid():
+                raise ProtocolError(codes.ErrProtocolViolation,
+                                    "invalid AUTH reason code"
+                                    )  # [MQTT-3.15.2-1]
             self.hooks.modify("on_auth_packet", packet, client)
         elif t == PT.CONNECT:
             raise ProtocolError(codes.ErrProtocolViolation,
@@ -723,19 +727,25 @@ class Broker:
             self._release_held(client)
 
     def _process_pubrec(self, client: Client, packet: Packet) -> None:
-        if packet.reason_code >= 0x80:
-            if client.inflight.delete(packet.packet_id):
-                self.info.inflight -= 1
-                client.inflight.return_send_quota()
-            return
         if client.inflight.get(packet.packet_id) is None:
             # unknown id -> PUBREL with not-found (v5)
+            # [MQTT-4.3.3-7]; checked before the reason, as the
+            # reference does (server.go:926-936)
             client.send(Packet(
                 fixed=FixedHeader(type=PT.PUBREL),
                 protocol_version=client.properties.protocol_version,
                 packet_id=packet.packet_id,
                 reason_code=codes.ErrPacketIdentifierNotFound.value
                 if client.properties.protocol_version >= 5 else 0))
+            return
+        if packet.reason_code >= 0x80 or not packet.reason_code_valid():
+            # [MQTT-4.3.3-4]: error or out-of-spec reason ends the QoS2
+            # flow (MQTT5 §4.13.2 ¶2; reference server.go:930-936)
+            if client.inflight.delete(packet.packet_id):
+                self.info.inflight -= 1
+                client.inflight.return_send_quota()
+            self.hooks.notify("on_qos_dropped", client, packet)
+            self._release_held(client)
             return
         rel = Packet(fixed=FixedHeader(type=PT.PUBREL),
                      protocol_version=client.properties.protocol_version,
@@ -745,20 +755,28 @@ class Broker:
         client.send(rel)
 
     def _process_pubrel(self, client: Client, packet: Packet) -> None:
-        known = packet.packet_id in client.pubrec_inbound
+        if packet.packet_id not in client.pubrec_inbound:
+            # unknown id -> PUBCOMP (not-found on v5) [MQTT-4.3.3-7];
+            # checked before the reason, as the reference does
+            # (server.go:946-957)
+            if client.properties.protocol_version < 5:
+                self._send_ack(client, PT.PUBCOMP, packet, 0)
+            else:
+                client.send(Packet(
+                    fixed=FixedHeader(type=PT.PUBCOMP),
+                    protocol_version=client.properties.protocol_version,
+                    packet_id=packet.packet_id,
+                    reason_code=codes.ErrPacketIdentifierNotFound.value))
+            return
         client.pubrec_inbound.discard(packet.packet_id)
-        if known:
-            client.inflight.return_receive_quota()
-        if known or client.properties.protocol_version < 5:
-            self._send_ack(client, PT.PUBCOMP, packet, 0)
-        else:
-            client.send(Packet(
-                fixed=FixedHeader(type=PT.PUBCOMP),
-                protocol_version=client.properties.protocol_version,
-                packet_id=packet.packet_id,
-                reason_code=codes.ErrPacketIdentifierNotFound.value))
-        if known:
-            self.hooks.notify("on_qos_complete", client, packet)
+        client.inflight.return_receive_quota()
+        if packet.reason_code >= 0x80 or not packet.reason_code_valid():
+            # [MQTT-4.3.3-9]: the receiver abandons the inbound QoS2
+            # message (reference server.go:951-957)
+            self.hooks.notify("on_qos_dropped", client, packet)
+            return
+        self._send_ack(client, PT.PUBCOMP, packet, 0)
+        self.hooks.notify("on_qos_complete", client, packet)
 
     def _process_pubcomp(self, client: Client, packet: Packet) -> None:
         if client.inflight.delete(packet.packet_id):

@@ -433,6 +433,10 @@ class Packet:
         if self.fixed.qos > 0 and not self.packet_id:
             raise ProtocolError(codes.ErrProtocolViolation,
                                 "qos > 0 publish without packet id"
+                                )  # [MQTT-2.2.1-3]
+        if self.fixed.qos == 0 and self.packet_id:
+            raise ProtocolError(codes.ErrProtocolViolation,
+                                "qos 0 publish with packet id"
                                 )  # [MQTT-2.2.1-2]
         if self.properties.subscription_ids:
             # only the server sends subscription identifiers
@@ -449,6 +453,53 @@ class Packet:
                                 "wildcards in publish topic")  # [MQTT-3.3.2-2]
         if not valid_utf8_string(self.topic.encode("utf-8")):
             raise ProtocolError(codes.ErrTopicNameInvalid)
+
+    def reason_code_valid(self) -> bool:
+        """Whether the reason code is one the spec allows for this packet
+        type (reference parity surface: ReasonCodeValid,
+        vendor/.../v2/packets/packets.go:779-829; AUTH per AuthValidate,
+        packets.go:1133-1141 [MQTT-3.15.2-1])."""
+        t = self.fixed.type
+        allowed = _VALID_REASONS.get(t)
+        return allowed is None or self.reason_code in allowed
+
+
+# Spec-allowed reason codes per packet type. Types absent here are
+# unconstrained (PUBACK mirrors the reference, whose switch has no case
+# for it — packets.go:779-829).
+_VALID_REASONS = {
+    PT.PUBREC: frozenset({
+        codes.Success.value, codes.NoMatchingSubscribers.value,
+        codes.ErrUnspecifiedError.value,
+        codes.ErrImplementationSpecificError.value,
+        codes.ErrNotAuthorized.value, codes.ErrTopicNameInvalid.value,
+        codes.ErrPacketIdentifierInUse.value,
+        codes.ErrQuotaExceeded.value,
+        codes.ErrPayloadFormatInvalid.value}),
+    PT.PUBREL: frozenset({
+        codes.Success.value, codes.ErrPacketIdentifierNotFound.value}),
+    PT.PUBCOMP: frozenset({
+        codes.Success.value, codes.ErrPacketIdentifierNotFound.value}),
+    PT.SUBACK: frozenset({
+        codes.GrantedQos0.value, codes.GrantedQos1.value,
+        codes.GrantedQos2.value, codes.ErrUnspecifiedError.value,
+        codes.ErrImplementationSpecificError.value,
+        codes.ErrNotAuthorized.value, codes.ErrTopicFilterInvalid.value,
+        codes.ErrPacketIdentifierInUse.value,
+        codes.ErrQuotaExceeded.value,
+        codes.ErrSharedSubscriptionsNotSupported.value,
+        codes.ErrSubscriptionIdentifiersNotSupported.value,
+        codes.ErrWildcardSubscriptionsNotSupported.value}),
+    PT.UNSUBACK: frozenset({
+        codes.Success.value, codes.NoSubscriptionExisted.value,
+        codes.ErrUnspecifiedError.value,
+        codes.ErrImplementationSpecificError.value,
+        codes.ErrNotAuthorized.value, codes.ErrTopicFilterInvalid.value,
+        codes.ErrPacketIdentifierInUse.value}),
+    PT.AUTH: frozenset({
+        codes.Success.value, codes.ContinueAuthentication.value,
+        codes.ReAuthenticate.value}),
+}
 
 
 # Dataclass construction runs on the per-packet hot path; building from

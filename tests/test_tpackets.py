@@ -114,3 +114,29 @@ def test_corpus_size_and_coverage():
     assert types == set(range(1, 16))
     assert sum(1 for c in CASES if c["fail_first"]) >= 40
     assert sum(1 for c in CASES if c["primary"]) >= 50
+
+
+def test_every_reference_case_accounted():
+    """All 174 reference corpus cases are either replayed as wire
+    vectors here or ported as named validate-direction tests
+    (tools/tpackets_accounting.py keeps the ledger)."""
+    path = os.path.join(os.path.dirname(__file__), "fixtures",
+                        "tpackets_accounting.json")
+    with open(path, encoding="utf-8") as fh:
+        acct = json.load(fh)
+    assert len(acct) >= 174
+    unaccounted = [k for k, v in acct.items()
+                   if v["status"] == "UNACCOUNTED"]
+    assert not unaccounted, unaccounted
+    # ledger in sync with the replayed fixture
+    wire = {c["case"] for c in CASES}
+    ledger_wire = {k for k, v in acct.items() if v["status"] == "wire"}
+    assert wire <= ledger_wire | {None}
+    # every covered-by test actually exists
+    import re as _re
+    src = open(os.path.join(os.path.dirname(__file__),
+                            "test_validate_cases.py")).read()
+    for v in acct.values():
+        if v["status"] == "covered-by" and "::" in v["by"]:
+            name = v["by"].split("::")[1]
+            assert _re.search(rf"def {name}\b", src), v["by"]

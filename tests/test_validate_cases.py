@@ -32,9 +32,18 @@ def publish(topic="a/b", qos=0, v5=False, **props) -> Packet:
 # --- TPublishInvalid*: PublishValidate (tpackets.go:2075-2168) ---------
 
 def test_publish_qos_must_have_packet_id():
-    # TPublishInvalidQosMustPacketID [MQTT-2.2.1-2]
+    # TPublishInvalidQosMustPacketID [MQTT-2.2.1-3]
     p = publish(qos=1)
     p.packet_id = 0
+    with pytest.raises(ProtocolError):
+        p.validate_publish()
+
+
+def test_publish_qos0_surplus_packet_id():
+    # TPublishInvalidQos0NoPacketID [MQTT-2.2.1-2]: a qos0 publish must
+    # not carry a packet id
+    p = publish(qos=0)
+    p.packet_id = 7
     with pytest.raises(ProtocolError):
         p.validate_publish()
 
@@ -114,6 +123,229 @@ def test_unsubscribe_no_filters_rejected_at_decode():
     [(fh, body)] = list(parse_stream(buf))
     with pytest.raises((ProtocolError, MalformedPacketError)):
         Packet.decode(fh, body, 5)
+
+
+# --- TConnectInvalid*: ConnectValidate (tpackets.go "validate" group) --
+#
+# The reference feeds hand-built structs to ConnectValidate; our
+# enforcement is decode-time, so each case is replayed as the wire bytes
+# that express the same violation. Struct states the wire cannot express
+# (username present but flag clear is trailing bytes; >65535-byte fields
+# cannot be length-prefixed) are asserted at the matching boundary.
+
+def connect_wire(name="MQTT", version=4, flags=0, client_id=b"\x00\x02cl",
+                 extra=b"") -> bytes:
+    body = bytearray()
+    body.extend(len(name).to_bytes(2, "big") + name.encode())
+    body.append(version)
+    body.append(flags)
+    body.extend(b"\x00\x1e")            # keepalive
+    if version == 5:
+        body.append(0)                  # empty properties
+    body.extend(client_id)
+    body.extend(extra)
+    return bytes([0x10, len(body)]) + bytes(body)
+
+
+def decode_wire(raw: bytes, version_hint=4) -> Packet:
+    from maxmq_tpu.protocol.packets import parse_stream
+    buf = bytearray(raw)
+    [(fh, body)] = list(parse_stream(buf))
+    return Packet.decode(fh, body, version_hint)
+
+
+@pytest.mark.parametrize("name,version", [
+    ("stuff", 4),       # TConnectInvalidProtocolName
+    ("MQTT", 2),        # TConnectInvalidProtocolVersion
+    ("MQIsdp", 2),      # TConnectInvalidProtocolVersion2
+])
+def test_connect_bad_protocol_name_version(name, version):
+    with pytest.raises(ProtocolError):
+        decode_wire(connect_wire(name=name, version=version))
+
+
+def test_connect_reserved_bit():
+    # TConnectInvalidReservedBit [MQTT-3.1.2-3]
+    with pytest.raises(ProtocolError):
+        decode_wire(connect_wire(flags=0x01))
+
+
+def test_connect_field_no_flag_is_trailing_garbage():
+    # TConnectInvalidUsernameNoFlag / TConnectInvalidPasswordNoFlag:
+    # a username/password present without its flag is, on the wire,
+    # surplus bytes after the declared payload
+    with pytest.raises((ProtocolError, MalformedPacketError)):
+        decode_wire(connect_wire(extra=b"\x00\x04user"))
+
+
+def test_connect_flag_no_password_truncates():
+    # TConnectInvalidFlagNoPassword: password flag set, field missing
+    # (v5: username flag may be clear — craft flags=0x40)
+    with pytest.raises((ProtocolError, MalformedPacketError)):
+        decode_wire(connect_wire(version=5, flags=0x40), 5)
+
+
+def test_connect_oversize_fields_unencodable():
+    # TConnectInvalidClientIDTooLong / UsernameTooLong / PasswordTooLong:
+    # 65,536-byte fields cannot be length-prefixed on the wire; the
+    # encoder is the boundary that enforces it
+    from maxmq_tpu.protocol.codec import write_binary
+    with pytest.raises(MalformedPacketError):
+        write_binary(bytearray(), bytes(65536))
+    p = Packet(fixed=FixedHeader(type=PT.CONNECT), protocol_version=4,
+               client_id="x" * 65536)
+    with pytest.raises(MalformedPacketError):
+        p.encode()
+
+
+def test_connect_will_flag_no_payload_truncates():
+    # TConnectInvalidWillFlagNoPayload: will flag set, topic/payload
+    # fields absent
+    with pytest.raises((ProtocolError, MalformedPacketError)):
+        decode_wire(connect_wire(flags=0x04))
+
+
+def test_connect_will_qos_out_of_range():
+    # TConnectInvalidWillFlagQosOutOfRange: the 2-bit wire field caps at
+    # 3; 3 is the expressible out-of-range value
+    with pytest.raises(ProtocolError):
+        decode_wire(connect_wire(flags=0x04 | 0x18,
+                                 extra=b"\x00\x01t\x00\x01p"))
+
+
+def test_connect_surplus_retain():
+    # TConnectInvalidWillSurplusRetain [MQTT-3.1.2-15]
+    with pytest.raises(ProtocolError):
+        decode_wire(connect_wire(flags=0x20))
+
+
+# --- Ack / AUTH reason-code validity (ReasonCodeValid,
+#     reference packets.go:779-829; server.go:930,951) ------------------
+
+async def test_pubrec_invalid_reason_drops_qos_flow():
+    # TPubrecInvalidReason: 0x9F (connection rate exceeded) is not a
+    # legal PUBREC reason (< 0x80 codes can be invalid too); the QoS2
+    # flow ends, inflight is released, on_qos_dropped fires — exercised
+    # at the server processing path, where the reference enforces it
+    # (server.go:930-936)
+    from maxmq_tpu.hooks.base import Hook
+
+    dropped = []
+
+    class Spy(Hook):
+        def on_qos_dropped(self, client, packet):
+            dropped.append(packet.reason_code)
+
+    async with running_broker() as broker:
+        broker.hooks.add(Spy())
+        sub = await connect(broker, "sub", version=5)
+        cl = broker.clients.get("sub")
+        out = Packet(fixed=FixedHeader(type=PT.PUBLISH, qos=2),
+                     protocol_version=5, topic="a/b", payload=b"m",
+                     packet_id=7)
+        cl.inflight.set(out)
+        broker.info.inflight += 1
+        bad = Packet(fixed=FixedHeader(type=PT.PUBREC),
+                     protocol_version=5, packet_id=7,
+                     reason_code=codes.NoSubscriptionExisted.value)
+        broker._process_pubrec(cl, bad)     # 0x11: <0x80 but invalid
+        assert dropped == [codes.NoSubscriptionExisted.value]
+        assert cl.inflight.get(7) is None   # flow ended
+        assert broker.info.inflight == 0
+
+        # unknown id beats the reason check [MQTT-4.3.3-7]: PUBREL
+        # (not-found) is replied, no phantom drop fires
+        sent = []
+        cl.send = lambda p: sent.append(p)
+        unknown = Packet(fixed=FixedHeader(type=PT.PUBREC),
+                         protocol_version=5, packet_id=99,
+                         reason_code=0x80)
+        broker._process_pubrec(cl, unknown)
+        assert len(dropped) == 1            # unchanged
+        assert sent[0].fixed.type == PT.PUBREL
+        assert (sent[0].reason_code
+                == codes.ErrPacketIdentifierNotFound.value)
+        # same order for PUBREL: unknown id -> PUBCOMP(not-found), even
+        # with an error reason
+        sent.clear()
+        broker._process_pubrel(cl, Packet(
+            fixed=FixedHeader(type=PT.PUBREL, qos=1),
+            protocol_version=5, packet_id=99, reason_code=0x92))
+        assert sent and sent[0].fixed.type == PT.PUBCOMP
+        assert (sent[0].reason_code
+                == codes.ErrPacketIdentifierNotFound.value)
+        await sub.disconnect()
+
+
+def test_reason_code_valid_table():
+    # TPubrelInvalidReason / TPubcompInvalidReason /
+    # TAuthInvalidReason(2) / plus positive cases
+    def pk(t, reason, qos=0):
+        return Packet(fixed=FixedHeader(type=t, qos=qos),
+                      protocol_version=5, reason_code=reason)
+    assert not pk(PT.PUBREL, 0x9F, qos=1).reason_code_valid()
+    assert not pk(PT.PUBCOMP, 0x9F).reason_code_valid()
+    assert not pk(PT.PUBREC, codes.NoSubscriptionExisted.value
+                  ).reason_code_valid()
+    assert not pk(PT.AUTH, codes.NoMatchingSubscribers.value
+                  ).reason_code_valid()
+    assert pk(PT.PUBREL, 0x92, qos=1).reason_code_valid()
+    assert pk(PT.PUBREC, 0x10).reason_code_valid()
+    assert pk(PT.AUTH, 0x18).reason_code_valid()
+    assert pk(PT.PUBACK, 0x9F).reason_code_valid()   # unconstrained
+
+
+async def test_auth_invalid_reason_disconnects():
+    # TAuthInvalidReason(2) [MQTT-3.15.2-1]
+    async with running_broker() as broker:
+        c = await connect(broker, "c1", version=5)
+        c.writer.write(bytes([0xF0, 2,
+                              codes.NoMatchingSubscribers.value, 0]))
+        await c.writer.drain()
+        await c.wait_closed(timeout=5)
+        await asyncio.sleep(0.05)
+        assert broker.info.clients_connected == 0
+
+
+# --- Remaining SUBSCRIBE / UNSUBSCRIBE validate cases ------------------
+
+def test_subscribe_packet_id_zero_rejected():
+    # TSubscribeInvalidQosMustPacketID / TUnsubscribeInvalidQosMustPacketID
+    from maxmq_tpu.protocol.packets import parse_stream
+    for t in (PT.SUBSCRIBE, PT.UNSUBSCRIBE):
+        body = bytearray(b"\x00\x00")            # packet id 0
+        if t == PT.SUBSCRIBE:
+            body += b"\x00"                      # v5 empty props
+            body += b"\x00\x03a/b\x00"
+        else:
+            body += b"\x00"
+            body += b"\x00\x03a/b"
+        raw = bytes([(t << 4) | 0x02, len(body)]) + bytes(body)
+        buf = bytearray(raw)
+        [(fh, b)] = list(parse_stream(buf))
+        with pytest.raises(ProtocolError):
+            Packet.decode(fh, b, 5)
+
+
+def test_subscription_identifier_oversize_rejected():
+    # TSubscribeInvalidIdentifierOversize: 268,435,456 needs a 5-byte
+    # varint, which the wire forbids; both codec directions refuse
+    from maxmq_tpu.protocol.codec import write_varint, read_varint
+    with pytest.raises(MalformedPacketError):
+        write_varint(bytearray(), 268_435_456)
+    with pytest.raises(MalformedPacketError):
+        read_varint(b"\xff\xff\xff\xff\x7f", 0)
+
+
+async def test_subscribe_invalid_shared_filter():
+    # TSubscribeInvalidFilter ($SHARE/#): malformed share filter must be
+    # refused (reference uses it as a reference-group input to the
+    # server's subscribe rejection)
+    async with running_broker() as broker:
+        c = await connect(broker, "c1", version=5)
+        [rc] = await c.subscribe("$share/#")
+        assert rc >= 0x80
+        await c.disconnect()
 
 
 # --- TDisconnect* encode cases (tpackets.go fail-state section) --------

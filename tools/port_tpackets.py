@@ -32,6 +32,25 @@ TYPES = {name: i + 1 for i, name in enumerate(
      "Pingreq", "Pingresp", "Disconnect", "Auth"])}
 TYPES["WillProperties"] = 0   # pseudo-type used for will-props sub-tests
 
+CODES_SRC = ("/root/reference/vendor/github.com/mochi-co/mqtt/v2/packets/"
+             "codes.go")
+
+
+def _parse_codes() -> dict[str, tuple[int, str]]:
+    """Mechanically read ``Name = Code{Code: 0xNN, Reason: "..."}`` pairs
+    from the reference's codes.go so ``X.Code`` / ``X.Reason`` references
+    inside RawBytes resolve without a hand-maintained table."""
+    out: dict[str, tuple[int, str]] = {}
+    with open(CODES_SRC, encoding="utf-8") as fh:
+        for m in re.finditer(
+                r'(\w+)\s*=\s*Code\{Code:\s*(0x[0-9A-Fa-f]+|\d+),\s*'
+                r'Reason:\s*"([^"]*)"\}', fh.read()):
+            out[m.group(1)] = (int(m.group(2), 0), m.group(3))
+    return out
+
+
+REASONS = _parse_codes()
+
 # reason-code constants referenced as `X.Code` inside RawBytes
 # (values from the reference's packets/codes.go)
 CODES = {
@@ -58,8 +77,12 @@ def _eval_byte_expr(expr: str) -> int:
     names, shifts/ors (e.g. ``Connect << 4 | 1<<1``)."""
     expr = expr.strip()
     expr = re.sub(r"'(.)'", lambda m: str(ord(m.group(1))), expr)
+    expr = re.sub(r"byte\(len\((\w+)\.Reason\)\)",
+                  lambda m: str(len(REASONS[m.group(1)][1])), expr)
     expr = re.sub(r"\b(\w+)\.Code\b",
-                  lambda m: str(CODES[m.group(1)]), expr)
+                  lambda m: str(CODES.get(m.group(1),
+                                          REASONS.get(m.group(1),
+                                                      (None,))[0])), expr)
     for name, val in TYPES.items():
         expr = re.sub(rf"\b{name}\b", str(val), expr)
     if not re.fullmatch(r"[0-9a-fA-FxX<>|&+\-*() ]+", expr):
@@ -116,6 +139,11 @@ def parse() -> list[dict]:
         if raw is not None:
             # inside RawBytes until its closing brace
             if stripped.startswith("}"):
+                # append([]byte{...}, []byte(X.Reason)...) closes as
+                # `}, []byte(Name.Reason)...),` — splice the reason text
+                if m := re.match(r"\},\s*\[\]byte\((\w+)\.Reason\)",
+                                 stripped):
+                    raw.extend(REASONS[m.group(1)][1].encode())
                 cur["raw"] = bytes(raw).hex()
                 raw = None
             else:
@@ -150,7 +178,7 @@ def parse() -> list[dict]:
             cur["expect"] = m.group(1)
         elif m := re.match(r"ProtocolVersion:\s*(\d+),", stripped):
             cur["protocol_version"] = int(m.group(1))
-        elif re.match(r"RawBytes:\s*\[\]byte\{$", stripped):
+        elif re.match(r"RawBytes:\s*(append\()?\[\]byte\{$", stripped):
             raw = []
         elif m := re.match(r"RawBytes:\s*\[\]byte\{(.+)\},$", stripped):
             raw_inline = [

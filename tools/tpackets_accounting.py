@@ -1,0 +1,127 @@
+"""Per-case accounting for the reference conformance corpus.
+
+Maps every ``Case:`` entry in the reference's
+vendor/github.com/mochi-co/mqtt/v2/packets/tpackets.go to how this repo
+covers it:
+
+* ``wire``       — golden wire vector in tests/fixtures/tpackets.json,
+                   replayed by tests/test_tpackets.py;
+* ``covered-by`` — semantics ported as a named test (the Go case builds
+                   a struct and runs a Validate step; our enforcement
+                   boundary is decode/broker, so the port exercises the
+                   same rule at that boundary);
+* anything unaccounted fails tests/test_tpackets.py's accounting check.
+
+Writes tests/fixtures/tpackets_accounting.json. Regenerate with:
+
+    python tools/tpackets_accounting.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+
+SRC = ("/root/reference/vendor/github.com/mochi-co/mqtt/v2/packets/"
+       "tpackets.go")
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(HERE, "tests", "fixtures", "tpackets.json")
+OUT = os.path.join(HERE, "tests", "fixtures", "tpackets_accounting.json")
+
+V = "tests/test_validate_cases.py"
+
+# Validate-direction cases (no RawBytes in the Go corpus): the test that
+# ports each case's semantics to our enforcement boundary.
+COVERED_BY = {
+    "TConnectInvalidProtocolName":
+        f"{V}::test_connect_bad_protocol_name_version",
+    "TConnectInvalidProtocolVersion":
+        f"{V}::test_connect_bad_protocol_name_version",
+    "TConnectInvalidProtocolVersion2":
+        f"{V}::test_connect_bad_protocol_name_version",
+    "TConnectInvalidReservedBit": f"{V}::test_connect_reserved_bit",
+    "TConnectInvalidClientIDTooLong":
+        f"{V}::test_connect_oversize_fields_unencodable",
+    "TConnectInvalidUsernameNoFlag":
+        f"{V}::test_connect_field_no_flag_is_trailing_garbage",
+    "TConnectInvalidPasswordNoFlag":
+        f"{V}::test_connect_field_no_flag_is_trailing_garbage",
+    "TConnectInvalidFlagNoPassword":
+        f"{V}::test_connect_flag_no_password_truncates",
+    "TConnectInvalidUsernameTooLong":
+        f"{V}::test_connect_oversize_fields_unencodable",
+    "TConnectInvalidPasswordTooLong":
+        f"{V}::test_connect_oversize_fields_unencodable",
+    "TConnectInvalidWillFlagNoPayload":
+        f"{V}::test_connect_will_flag_no_payload_truncates",
+    "TConnectInvalidWillFlagQosOutOfRange":
+        f"{V}::test_connect_will_qos_out_of_range",
+    "TConnectInvalidWillSurplusRetain":
+        f"{V}::test_connect_surplus_retain",
+    "TPublishInvalidQos0NoPacketID":
+        f"{V}::test_publish_qos0_surplus_packet_id",
+    "TPublishInvalidQosMustPacketID":
+        f"{V}::test_publish_qos_must_have_packet_id",
+    "TPublishInvalidSurplusSubID":
+        f"{V}::test_publish_surplus_subscription_identifier",
+    "TPublishInvalidSurplusWildcard":
+        f"{V}::test_publish_surplus_wildcard",
+    "TPublishInvalidSurplusWildcard2":
+        f"{V}::test_publish_surplus_wildcard",
+    "TPublishInvalidNoTopic": f"{V}::test_publish_no_topic_no_alias",
+    "TPublishInvalidTopicAlias":
+        f"{V}::test_publish_topic_alias_zero_and_excess",
+    "TPublishInvalidExcessTopicAlias":
+        f"{V}::test_publish_topic_alias_zero_and_excess",
+    "TPubrecInvalidReason":
+        f"{V}::test_pubrec_invalid_reason_drops_qos_flow",
+    "TPubrelInvalidReason": f"{V}::test_reason_code_valid_table",
+    "TPubcompInvalidReason": f"{V}::test_reason_code_valid_table",
+    "TSubscribeInvalidFilter":
+        f"{V}::test_subscribe_invalid_shared_filter",
+    "TSubscribeInvalidSharedNoLocal":
+        f"{V}::test_subscribe_shared_no_local_rejected",
+    "TSubscribeInvalidQosMustPacketID":
+        f"{V}::test_subscribe_packet_id_zero_rejected",
+    "TSubscribeInvalidNoFilters":
+        f"{V}::test_subscribe_no_filters_rejected_at_decode",
+    "TSubscribeInvalidIdentifierOversize":
+        f"{V}::test_subscription_identifier_oversize_rejected",
+    "TUnsubscribeInvalidQosMustPacketID":
+        f"{V}::test_subscribe_packet_id_zero_rejected",
+    "TUnsubscribeInvalidNoFilters":
+        f"{V}::test_unsubscribe_no_filters_rejected_at_decode",
+    "TAuthInvalidReason": f"{V}::test_auth_invalid_reason_disconnects",
+    "TAuthInvalidReason2": f"{V}::test_reason_code_valid_table",
+}
+
+
+def main() -> None:
+    with open(SRC, encoding="utf-8") as fh:
+        go = fh.read()
+    # the case-table entries (skip the const block declaring the names)
+    names = sorted(set(re.findall(r"Case:\s+(T\w+)", go)))
+    with open(FIXTURE, encoding="utf-8") as fh:
+        wire = {c["case"] for c in json.load(fh)}
+    acct = {}
+    for name in names:
+        if name in wire:
+            acct[name] = {"status": "wire",
+                          "by": "tests/test_tpackets.py"}
+        elif name in COVERED_BY:
+            acct[name] = {"status": "covered-by",
+                          "by": COVERED_BY[name]}
+        else:
+            acct[name] = {"status": "UNACCOUNTED", "by": None}
+    with open(OUT, "w", encoding="utf-8") as fh:
+        json.dump(acct, fh, indent=1, sort_keys=True)
+    n_wire = sum(1 for v in acct.values() if v["status"] == "wire")
+    n_cov = sum(1 for v in acct.values() if v["status"] == "covered-by")
+    n_un = sum(1 for v in acct.values() if v["status"] == "UNACCOUNTED")
+    print(f"{len(acct)} cases: {n_wire} wire, {n_cov} covered-by, "
+          f"{n_un} unaccounted -> {OUT}")
+
+
+if __name__ == "__main__":
+    main()
