@@ -1074,6 +1074,55 @@ def verify_pairs(tables, toks32, lengths, dollar, ti, rw) -> np.ndarray:
     return ok
 
 
+def _union_pairs(out, ti, rw, tables) -> None:
+    """Union verified candidate pairs into the per-topic SubscriberSets.
+    Hot loop: fast-path rows (single plain subscription) are two dict
+    ops; merge_subscription aliases the stored Subscription."""
+    entries = tables.entries
+    row_entries = tables.row_entries
+    fast_cid, fast_sub = _decode_cache(tables)
+    dicts = [s.subscriptions for s in out]
+    merge = merge_subscription
+    for t, r in zip(ti.tolist(), rw.tolist()):
+        cid = fast_cid[r]
+        if cid is not None:
+            d = dicts[t]
+            sub = fast_sub[r]
+            cur = d.get(cid)
+            d[cid] = sub if cur is None else merge(cur, sub, sub.filter)
+            continue
+        result = out[t]
+        for b in row_entries[r]:
+            entry = entries[b]
+            if entry.group:
+                for cid, sub in entry.candidates.items():
+                    result.add_shared(entry.group, sub.filter, cid, sub)
+            else:
+                sub = entry.subscription
+                result.add(entry.client_id, sub, sub.filter)
+
+
+def _union_pairs_removed(out, ti, rw, tables, removed) -> None:
+    """Union loop for the overlay case: (client, filter) pairs the host
+    overlay has removed are filtered out row by row."""
+    entries = tables.entries
+    row_entries = tables.row_entries
+    for t, r in zip(ti.tolist(), rw.tolist()):
+        result = out[t]
+        for b in row_entries[r]:
+            entry = entries[b]
+            if entry.group:
+                for cid, sub in entry.candidates.items():
+                    if (cid, sub.filter) in removed:
+                        continue
+                    result.add_shared(entry.group, sub.filter, cid, sub)
+            else:
+                sub = entry.subscription
+                if (entry.client_id, sub.filter) in removed:
+                    continue
+                result.add(entry.client_id, sub, sub.filter)
+
+
 class Overlay:
     """Host-side view of subscription mutations newer than the compiled
     tables, replayed from the TopicIndex journal.
@@ -1314,33 +1363,38 @@ class SigEngine(OverlayedEngine):
                 _, out = jax.lax.scan(step, 0, (toks8, lens_enc))
                 return out
 
-            sb, kr = self.fixed_sel_blocks, self.fixed_max_rows
-            fmt16 = n_words * 32 <= 65536
-            fmt = {"kind": "fmt16"} if fmt16 else {"kind": "fmt32"}
-
-            fn_fixed = None
-            self.pallas_active = False
-            if self.use_pallas:
-                from . import sig_pallas
-                kplan = sig_pallas.plan(tables)
-                if kplan is not None:
-                    fn_fixed, fmt = sig_pallas.build_fixed_fn(
-                        tables, consts, kplan, max_rows=kr)
-                    self.pallas_active = True
-                elif self.use_pallas is True:
-                    raise ValueError(
-                        "use_pallas=True but tables exceed the kernel's "
-                        "VMEM plan (use 'auto' to fall back to XLA)")
-            if fn_fixed is None:
-                @jax.jit
-                def fn_fixed(toks8, lens_enc):
-                    return sig_match_fixed_body(consts, planes, toks8,
-                                                lens_enc, sel_blocks=sb,
-                                                max_rows=kr)
-
+            fn_fixed, fmt = self._build_fixed_program(tables, consts,
+                                                      planes, n_words)
             self._state = (tables, consts, fn, fn_many,
                            fn_compact, fn_compact_many, fn_fixed, fmt)
             return True
+
+    def _build_fixed_program(self, tables, consts, planes, n_words):
+        """The fixed-slot device program: the fused Pallas chunk kernels
+        when the VMEM plan admits the tables, else the XLA body."""
+        sb, kr = self.fixed_sel_blocks, self.fixed_max_rows
+        fmt16 = n_words * 32 <= 65536
+        fmt = {"kind": "fmt16"} if fmt16 else {"kind": "fmt32"}
+        self.pallas_active = False
+        if self.use_pallas:
+            from . import sig_pallas
+            kplan = sig_pallas.plan(tables)
+            if kplan is not None:
+                fn_fixed, fmt = sig_pallas.build_fixed_fn(
+                    tables, consts, kplan, max_rows=kr)
+                self.pallas_active = True
+                return fn_fixed, fmt
+            if self.use_pallas is True:
+                raise ValueError(
+                    "use_pallas=True but tables exceed the kernel's "
+                    "VMEM plan (use 'auto' to fall back to XLA)")
+
+        @jax.jit
+        def fn_fixed(toks8, lens_enc):
+            return sig_match_fixed_body(consts, planes, toks8,
+                                        lens_enc, sel_blocks=sb,
+                                        max_rows=kr)
+        return fn_fixed, fmt
 
     @property
     def tables(self) -> SigTables:
@@ -1624,7 +1678,13 @@ class SigEngine(OverlayedEngine):
                      toks8, lens_enc) -> list[SubscriberSet]:
         """Pure host decode given flattened candidate pairs: batch
         verify + entry union (one C pass when the maxmq_decode extension
-        is active)."""
+        is active).
+
+        Result contract: returned SubscriberSets may be SHARED across
+        topics and calls (the C pass memoizes per verified row set, and
+        the broker's match cache replays results too) — treat them as
+        immutable and ``deep_copy()`` before mutating, as
+        Broker._fan_out does before its one mutating hook."""
         overlay = self.overlay_for(tables.version)
         if overlay == "resync":
             return self._resync_batch(topics)
@@ -1656,54 +1716,16 @@ class SigEngine(OverlayedEngine):
             ok = verify_pairs(tables, toks32, lengths, dollar, ti, rw)
             ti, rw = ti[ok], rw[ok]
             out = [SubscriberSet() for _ in range(batch)]
-        entries = tables.entries
-        row_entries = tables.row_entries
-        fast_cid, fast_sub = _decode_cache(tables)
-        if ti is None:                 # the C pass already did the walk
-            pass
-        elif removed is None:
-            # hot loop: verified rows only, fast-path rows are two dict
-            # ops (merge_subscription aliases the stored Subscription)
-            dicts = [s.subscriptions for s in out]
-            merge = merge_subscription
-            for t, r in zip(ti.tolist(), rw.tolist()):
-                cid = fast_cid[r]
-                if cid is not None:
-                    d = dicts[t]
-                    sub = fast_sub[r]
-                    cur = d.get(cid)
-                    d[cid] = sub if cur is None else merge(cur, sub,
-                                                           sub.filter)
-                    continue
-                result = out[t]
-                for b in row_entries[r]:
-                    entry = entries[b]
-                    if entry.group:
-                        for cid, sub in entry.candidates.items():
-                            result.add_shared(entry.group, sub.filter,
-                                              cid, sub)
-                    else:
-                        sub = entry.subscription
-                        result.add(entry.client_id, sub, sub.filter)
-        else:
-            for t, r in zip(ti.tolist(), rw.tolist()):
-                result = out[t]
-                for b in row_entries[r]:
-                    entry = entries[b]
-                    if entry.group:
-                        for cid, sub in entry.candidates.items():
-                            if (cid, sub.filter) in removed:
-                                continue
-                            result.add_shared(entry.group, sub.filter,
-                                              cid, sub)
-                    else:
-                        sub = entry.subscription
-                        if (entry.client_id, sub.filter) in removed:
-                            continue
-                        result.add(entry.client_id, sub, sub.filter)
+        if ti is not None:             # the C pass already did the walk
+            if removed is None:
+                _union_pairs(out, ti, rw, tables)
+            else:
+                _union_pairs_removed(out, ti, rw, tables, removed)
+        return self._overlay_fallback_pass(topics, out, fall, overlay)
 
-        # overlay/fallback post-pass; the overwhelmingly common case
-        # (fresh tables, no overflow) returns the union output as-is
+    def _overlay_fallback_pass(self, topics, out, fall, overlay):
+        """Overlay/fallback post-pass; the overwhelmingly common case
+        (fresh tables, no overflow) returns the union output as-is."""
         any_fall = bool(fall.any())
         if overlay is not None:
             fl = fall.tolist() if any_fall else None

@@ -34,6 +34,7 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include <algorithm>
 #include <cstddef>
 #include <cstdint>
 #include <vector>
@@ -327,9 +328,15 @@ struct DecodeTable {
                         //             (group, filter) tuple (SHARED)
   PyObject *cids;       // list len A: client-id str
   PyObject *subs;       // list len A: Subscription
+  PyObject *cache;      // verified-row-set bytes -> SubscriberSet
   std::vector<PyObject *> key, cid, sub;  // borrowed from the lists
   Py_ssize_t R, W, A;
 };
+
+// The row-set result cache is bounded; past this the whole dict is
+// dropped (the table itself rotates on every subscription change, so a
+// long-lived broker can't grow it unboundedly either way).
+constexpr Py_ssize_t kDecodeCacheCap = 1 << 17;
 
 void table_destroy(PyObject *capsule) {
   auto *t = static_cast<DecodeTable *>(
@@ -343,6 +350,7 @@ void table_destroy(PyObject *capsule) {
   Py_XDECREF(t->keys);
   Py_XDECREF(t->cids);
   Py_XDECREF(t->subs);
+  Py_XDECREF(t->cache);
   delete t;
 }
 
@@ -361,7 +369,7 @@ PyObject *table_new(PyObject *, PyObject *args) {
   auto t = new DecodeTable();
   t->tok.obj = t->min_depth.obj = t->flags.obj = nullptr;
   t->offsets.obj = t->kinds.obj = nullptr;
-  t->keys = t->cids = t->subs = nullptr;
+  t->keys = t->cids = t->subs = t->cache = nullptr;
   PyObject *capsule = PyCapsule_New(t, "maxmq_decode.table",
                                     table_destroy);
   if (!capsule) {
@@ -398,6 +406,8 @@ PyObject *table_new(PyObject *, PyObject *args) {
   t->keys = Py_NewRef(keys);
   t->cids = Py_NewRef(cids);
   t->subs = Py_NewRef(subs);
+  t->cache = PyDict_New();
+  if (!t->cache) return fail(nullptr);
   t->key.resize(t->A);
   t->cid.resize(t->A);
   t->sub.resize(t->A);
@@ -428,6 +438,107 @@ inline SubSetObject *lazy_set(PyObject *list, Py_ssize_t t) {
   if (!n) return nullptr;
   PyList_SetItem(list, t, reinterpret_cast<PyObject *>(n));  // steals
   return n;
+}
+
+// replay row r's action stream into res; -1 on python error
+int apply_row_actions(DecodeTable *t, SubSetObject *res, int64_t r) {
+  const auto *off = static_cast<const int64_t *>(t->offsets.buf);
+  const auto *kind = static_cast<const uint8_t *>(t->kinds.buf);
+  for (int64_t a = off[r]; a < off[r + 1]; a++) {
+    switch (kind[a]) {
+      case ACT_PLAIN: {
+        PyObject *cur =
+            PyDict_GetItemWithError(res->subscriptions, t->cid[a]);
+        if (!cur) {
+          if (PyErr_Occurred() ||
+              PyDict_SetItem(res->subscriptions, t->cid[a],
+                             t->sub[a]) < 0)
+            return -1;
+        } else if (cur != t->sub[a]) {  // same-client collision
+          PyObject *mg = PyObject_CallFunctionObjArgs(
+              g_merge_fn, cur, t->sub[a], t->key[a], nullptr);
+          if (!mg ||
+              PyDict_SetItem(res->subscriptions, t->cid[a], mg) < 0) {
+            Py_XDECREF(mg);
+            return -1;
+          }
+          Py_DECREF(mg);
+        }
+        break;
+      }
+      case ACT_MERGE: {  // v5 identifiers: copy semantics via python
+        PyObject *cur =
+            PyDict_GetItemWithError(res->subscriptions, t->cid[a]);
+        if (!cur && PyErr_Occurred()) return -1;
+        PyObject *mg = PyObject_CallFunctionObjArgs(
+            g_merge_fn, cur ? cur : Py_None, t->sub[a], t->key[a],
+            nullptr);
+        if (!mg ||
+            PyDict_SetItem(res->subscriptions, t->cid[a], mg) < 0) {
+          Py_XDECREF(mg);
+          return -1;
+        }
+        Py_DECREF(mg);
+        break;
+      }
+      default: {  // ACT_SHARED
+        PyObject *g = PyDict_GetItemWithError(res->shared, t->key[a]);
+        if (!g) {
+          if (PyErr_Occurred()) return -1;
+          g = PyDict_New();
+          if (!g || PyDict_SetItem(res->shared, t->key[a], g) < 0) {
+            Py_XDECREF(g);
+            return -1;
+          }
+          Py_DECREF(g);  // res->shared holds the ref now
+        }
+        if (PyDict_SetItem(g, t->cid[a], t->sub[a]) < 0) return -1;
+        break;
+      }
+    }
+  }
+  return 0;
+}
+
+// build-or-fetch the merged SubscriberSet for one verified, sorted,
+// deduped row set; returns a NEW reference (cached object shared across
+// topics — callers treat results as immutable, deep_copy before
+// mutating, the same discipline the broker's match cache imposes)
+PyObject *cached_rowset_result(DecodeTable *t, const int32_t *rows,
+                               Py_ssize_t n_rows) {
+  PyObject *key = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(rows),
+      n_rows * (Py_ssize_t)sizeof(int32_t));
+  if (!key) return nullptr;
+  PyObject *hit = PyDict_GetItemWithError(t->cache, key);
+  if (hit) {
+    Py_DECREF(key);
+    return Py_NewRef(hit);
+  }
+  if (PyErr_Occurred()) {
+    Py_DECREF(key);
+    return nullptr;
+  }
+  auto *res = subset_new_fast(nullptr, nullptr);
+  if (!res) {
+    Py_DECREF(key);
+    return nullptr;
+  }
+  for (Py_ssize_t i = 0; i < n_rows; i++) {
+    if (apply_row_actions(t, res, rows[i]) < 0) {
+      Py_DECREF(key);
+      Py_DECREF(res);
+      return nullptr;
+    }
+  }
+  if (PyDict_GET_SIZE(t->cache) >= kDecodeCacheCap) PyDict_Clear(t->cache);
+  int rc = PyDict_SetItem(t->cache, key, reinterpret_cast<PyObject *>(res));
+  Py_DECREF(key);
+  if (rc < 0) {
+    Py_DECREF(res);
+    return nullptr;
+  }
+  return reinterpret_cast<PyObject *>(res);
 }
 
 // decode_batch(table, toks, mode, pad, lens_enc, B, ti, rw)
@@ -481,8 +592,6 @@ PyObject *decode_batch(PyObject *, PyObject *args) {
   const auto *tok = static_cast<const int32_t *>(t->tok.buf);
   const auto *md = static_cast<const int32_t *>(t->min_depth.buf);
   const auto *fl = static_cast<const uint8_t *>(t->flags.buf);
-  const auto *off = static_cast<const int64_t *>(t->offsets.buf);
-  const auto *kind = static_cast<const uint8_t *>(t->kinds.buf);
   const int32_t pad = static_cast<int32_t>(pad_l);
 
   PyObject *out = PyList_New(B);
@@ -494,6 +603,12 @@ PyObject *decode_batch(PyObject *, PyObject *args) {
     return nullptr;
   };
 
+  // pass 1 — verify (pure C): token windows against the row's verify
+  // array; survivors keep their (topic, row) pair
+  std::vector<int64_t> v_tp;
+  std::vector<int32_t> v_rw;
+  v_tp.reserve(N);
+  v_rw.reserve(N);
   for (Py_ssize_t k = 0; k < N; k++) {
     const int64_t tp = ti[k], r = rw[k];
     if (tp < 0 || tp >= B || r < 0 || r >= t->R) continue;
@@ -521,65 +636,43 @@ PyObject *decode_batch(PyObject *, PyObject *args) {
       if (rv != VER_ANY && rv != VER_PLUS && rv != -1) ok = false;
     }
     if (!ok) continue;
+    v_tp.push_back(tp);
+    v_rw.push_back(static_cast<int32_t>(r));
+  }
 
-    SubSetObject *res = lazy_set(out, tp);
+  // pass 2 — counting-sort the survivors by topic (pairs may interleave
+  // device and host-probe streams), then resolve each topic's row SET
+  // through the table's result cache: topics overwhelmingly repeat a
+  // small number of row sets (shallow-'#' buckets), so the expensive
+  // union runs once per distinct set, not once per topic.
+  const Py_ssize_t M = (Py_ssize_t)v_tp.size();
+  std::vector<int64_t> t_cnt(B + 1, 0);
+  for (Py_ssize_t k = 0; k < M; k++) t_cnt[v_tp[k] + 1]++;
+  for (Py_ssize_t i = 0; i < B; i++) t_cnt[i + 1] += t_cnt[i];
+  std::vector<int32_t> sorted_rw(M);
+  {
+    std::vector<int64_t> cur(t_cnt.begin(), t_cnt.end() - 1);
+    for (Py_ssize_t k = 0; k < M; k++)
+      sorted_rw[cur[v_tp[k]]++] = v_rw[k];
+  }
+  std::vector<int32_t> rowbuf;
+  for (Py_ssize_t tp = 0; tp < B; tp++) {
+    const int64_t lo = t_cnt[tp], hi = t_cnt[tp + 1];
+    if (lo == hi) continue;
+    rowbuf.assign(sorted_rw.begin() + lo, sorted_rw.begin() + hi);
+    std::sort(rowbuf.begin(), rowbuf.end());
+    rowbuf.erase(std::unique(rowbuf.begin(), rowbuf.end()),
+                 rowbuf.end());
+    PyObject *res = cached_rowset_result(t, rowbuf.data(),
+                                         (Py_ssize_t)rowbuf.size());
     if (!res) return bail();
-    for (int64_t a = off[r]; a < off[r + 1]; a++) {
-      switch (kind[a]) {
-        case ACT_PLAIN: {
-          PyObject *cur =
-              PyDict_GetItemWithError(res->subscriptions, t->cid[a]);
-          if (!cur) {
-            if (PyErr_Occurred() ||
-                PyDict_SetItem(res->subscriptions, t->cid[a],
-                               t->sub[a]) < 0)
-              return bail();
-          } else if (cur != t->sub[a]) {  // same-client collision
-            PyObject *mg = PyObject_CallFunctionObjArgs(
-                g_merge_fn, cur, t->sub[a], t->key[a], nullptr);
-            if (!mg ||
-                PyDict_SetItem(res->subscriptions, t->cid[a], mg) < 0) {
-              Py_XDECREF(mg);
-              return bail();
-            }
-            Py_DECREF(mg);
-          }
-          break;
-        }
-        case ACT_MERGE: {  // v5 identifiers: copy semantics via python
-          PyObject *cur =
-              PyDict_GetItemWithError(res->subscriptions, t->cid[a]);
-          if (!cur && PyErr_Occurred()) return bail();
-          PyObject *mg = PyObject_CallFunctionObjArgs(
-              g_merge_fn, cur ? cur : Py_None, t->sub[a], t->key[a],
-              nullptr);
-          if (!mg ||
-              PyDict_SetItem(res->subscriptions, t->cid[a], mg) < 0) {
-            Py_XDECREF(mg);
-            return bail();
-          }
-          Py_DECREF(mg);
-          break;
-        }
-        default: {  // ACT_SHARED
-          PyObject *g = PyDict_GetItemWithError(res->shared, t->key[a]);
-          if (!g) {
-            if (PyErr_Occurred()) return bail();
-            g = PyDict_New();
-            if (!g || PyDict_SetItem(res->shared, t->key[a], g) < 0) {
-              Py_XDECREF(g);
-              return bail();
-            }
-            Py_DECREF(g);  // res->shared holds the ref now
-          }
-          if (PyDict_SetItem(g, t->cid[a], t->sub[a]) < 0) return bail();
-          break;
-        }
-      }
-    }
+    PyList_SetItem(out, tp, res);  // steals; replaces the None
   }
   // fill the untouched slots with fresh empty sets so every consumer
-  // sees a real SubscriberSet (callers may mutate their slot)
+  // sees a real SubscriberSet. NOTE: populated slots may be SHARED
+  // (cache hits alias one object across topics and calls) — callers
+  // must treat results as immutable and deep_copy() before mutating
+  // (see SigEngine.decode_pairs' contract)
   for (Py_ssize_t i = 0; i < B; i++) {
     if (PyList_GET_ITEM(out, i) != Py_None) continue;
     auto *n = subset_new_fast(nullptr, nullptr);

@@ -419,7 +419,8 @@ int64_t mq_probe_run(void* h, const void* toks, int32_t tok_mode,
         int32_t j = g.probe(sig);
         for (; j >= 0 && static_cast<size_t>(j) < g.sigs.size() &&
                g.sigs[j] == sig; ++j) {
-          emit(i, g.rows[j]);
+          ti_t.push_back(i);
+          rw_t.push_back(g.rows[j]);
         }
       }
     }
@@ -454,6 +455,8 @@ int64_t mq_probe_run(void* h, const void* toks, int32_t tok_mode,
 // Outputs: toks_out/lens_out as mq_tokenize_sig; (ti_out, row_out) hit
 // pairs in topic order (up to cap — returns the total regardless, the
 // caller re-invokes with a larger buffer when total > cap).
+}  // extern "C" (the range worker below is a C++ template)
+
 namespace {
 
 // One contiguous topic range of the fused tokenize+probe (the worker
@@ -521,8 +524,7 @@ void tokenize_probe_range(const Vocab& map, const ProbeSet* set,
         int32_t j = g.probe(sig);
         for (; j >= 0 && static_cast<size_t>(j) < g.sigs.size() &&
                g.sigs[j] == sig; ++j) {
-          ti_t.push_back(i);
-          rw_t.push_back(g.rows[j]);
+          emit(i, g.rows[j]);
         }
       }
     }
@@ -530,6 +532,8 @@ void tokenize_probe_range(const Vocab& map, const ProbeSet* set,
 }
 
 }  // namespace
+
+extern "C" {
 
 int64_t mq_tokenize_probe(void* v, void* h, const char* buf, int64_t buf_len,
                           int64_t n_topics, int64_t window, int32_t tok_mode,

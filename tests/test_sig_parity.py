@@ -406,6 +406,58 @@ def test_compact_max_rows_validated():
         SigEngine(idx, compact_max_rows=0)
 
 
+def test_decode_rowset_cache_semantics():
+    """The C decode pass memoizes results per verified row SET: topics
+    with identical matched rows share one SubscriberSet object (the
+    broker's own match cache already imposes the treat-as-immutable /
+    deep_copy-before-mutating discipline). Parity with the trie must
+    hold on both the first (building) and second (cache-hit) pass, and
+    deep_copy must isolate."""
+    from maxmq_tpu.native import decode_module
+
+    rng = random.Random(9)
+    alphabet = [f"t{i}" for i in range(6)]     # tiny: force hot rowsets
+    idx = TopicIndex()
+    for i in range(400):
+        depth = rng.randint(1, 4)
+        levels = [rng.choice(alphabet) for _ in range(depth)]
+        r = rng.random()
+        if r < 0.3:
+            levels[rng.randrange(depth)] = "+"
+        elif r < 0.5:
+            levels = levels[: rng.randint(1, depth)] + ["#"]
+        f = "/".join(levels)
+        if rng.random() < 0.2:
+            f = f"$share/g{rng.randint(0, 2)}/{f}"
+        idx.subscribe(f"c{i}", Subscription(filter=f))
+    engine = SigEngine(idx, auto_refresh=False)
+    topics = ["/".join(rng.choice(alphabet)
+                       for _ in range(rng.randint(1, 4)))
+              for _ in range(256)]
+    topics += topics[:64]                      # literal repeats too
+
+    for _ in range(2):                         # pass 2 = pure cache hits
+        got = engine.subscribers_batch(topics)
+        for topic, g in zip(topics, got):
+            assert normalize(g) == normalize(idx.subscribers(topic)), topic
+
+    if decode_module() is None:
+        return                                 # python fallback: no cache
+    got = engine.subscribers_batch(topics)
+    by_key = {}
+    for topic, g in zip(topics, got):
+        prev = by_key.setdefault(topic, g)
+        assert prev is g or normalize(prev) == normalize(g)
+    # repeated topics share the SAME object (cache hit), and deep_copy
+    # isolates mutation
+    rich = max(got, key=lambda s: len(s.subscriptions))
+    if rich.subscriptions:
+        cp = rich.deep_copy()
+        cid = next(iter(cp.subscriptions))
+        del cp.subscriptions[cid]
+        assert cid in rich.subscriptions
+
+
 def test_decode_rate_unit_bench():
     """VERDICT r1 #6: row -> SubscriberSet decode must sustain >= 1M
     rows/s — the per-delivery half that bounds fan-out no matter how
