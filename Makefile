@@ -6,7 +6,7 @@
 
 PY ?= python
 
-.PHONY: all check lint cyclo test coverage native bench clean
+.PHONY: all check lint cyclo test coverage native bench clean hooks
 
 all: check
 
@@ -16,7 +16,7 @@ lint:
 	$(PY) tools/qa.py lint
 
 cyclo:
-	$(PY) tools/qa.py cyclo --over 24
+	$(PY) tools/qa.py cyclo --over 12
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -29,6 +29,11 @@ native:
 
 bench:
 	$(PY) bench.py
+
+hooks:
+	chmod +x scripts/githooks/*
+	git config core.hooksPath scripts/githooks
+	@echo "git hooks installed (pre-commit: lint+cyclo; pre-push: make check)"
 
 clean:
 	rm -rf .qa_coverage.json $(shell find . -name __pycache__ -type d)

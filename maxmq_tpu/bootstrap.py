@@ -154,14 +154,7 @@ async def run_server(conf: Config, logger: Logger,
     boot = logger.with_prefix("bootstrap")
     boot.debug("effective configuration", **config_as_dict(conf))
 
-    profiler = heap_tracer = None
-    if conf.profile:
-        import cProfile
-        import tracemalloc
-        profiler = cProfile.Profile()
-        profiler.enable()
-        tracemalloc.start()
-        heap_tracer = True
+    profiler = _start_profiling(conf)
 
     broker = build_broker(conf, logger)
     metrics = build_metrics(conf, broker, logger)
@@ -194,13 +187,28 @@ async def run_server(conf: Config, logger: Logger,
         if matcher is not None and hasattr(matcher, "close"):
             await matcher.close()
         if profiler is not None:
-            profiler.disable()
-            profiler.dump_stats(f"{conf.profile_path}/cpu.prof")
-            import tracemalloc
-            snap = tracemalloc.take_snapshot()
-            with open(f"{conf.profile_path}/heap.prof", "w") as f:
-                for s in snap.statistics("lineno")[:256]:
-                    f.write(str(s) + "\n")
-            tracemalloc.stop()
-            boot.info("profiles written", path=conf.profile_path)
+            _stop_profiling(profiler, conf, boot)
         boot.info("server stopped")
+
+
+def _start_profiling(conf: Config):
+    if not conf.profile:
+        return None
+    import cProfile
+    import tracemalloc
+    profiler = cProfile.Profile()
+    profiler.enable()
+    tracemalloc.start()
+    return profiler
+
+
+def _stop_profiling(profiler, conf: Config, boot) -> None:
+    import tracemalloc
+    profiler.disable()
+    profiler.dump_stats(f"{conf.profile_path}/cpu.prof")
+    snap = tracemalloc.take_snapshot()
+    with open(f"{conf.profile_path}/heap.prof", "w") as f:
+        for s in snap.statistics("lineno")[:256]:
+            f.write(str(s) + "\n")
+    tracemalloc.stop()
+    boot.info("profiles written", path=conf.profile_path)

@@ -104,15 +104,8 @@ class Client:
             # clamp to the operator limit; v5 clients learn the new value
             # via ServerKeepAlive in CONNACK [MQTT-3.1.2-21]
             self.keepalive = caps_ka
-        pr = packet.properties
         if packet.protocol_version >= 5:
-            p.session_expiry = pr.session_expiry or 0
-            p.session_expiry_set = pr.session_expiry is not None
-            p.receive_maximum = pr.receive_maximum or 0
-            p.topic_alias_maximum = pr.topic_alias_max or 0
-            p.maximum_packet_size = pr.maximum_packet_size or 0
-            if pr.request_problem_info is not None:
-                p.request_problem_info = pr.request_problem_info
+            self._absorb_v5_connect_props(packet.properties)
         caps = self.server.capabilities
         self.inflight = Inflight(
             receive_maximum=caps.receive_maximum,
@@ -122,6 +115,16 @@ class Client:
             w = packet.will
             p.will = w
             p.will_delay = w.properties.will_delay or 0
+
+    def _absorb_v5_connect_props(self, pr) -> None:
+        p = self.properties
+        p.session_expiry = pr.session_expiry or 0
+        p.session_expiry_set = pr.session_expiry is not None
+        p.receive_maximum = pr.receive_maximum or 0
+        p.topic_alias_maximum = pr.topic_alias_max or 0
+        p.maximum_packet_size = pr.maximum_packet_size or 0
+        if pr.request_problem_info is not None:
+            p.request_problem_info = pr.request_problem_info
 
     def next_packet_id(self) -> int:
         """Allocate an unused outbound packet id; raises when all 65535 are

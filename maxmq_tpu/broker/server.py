@@ -292,28 +292,34 @@ class Broker:
                         reason_code=codes.connack_for_version(
                             code, client.properties.protocol_version))
         if client.properties.protocol_version >= 5 and not code.is_error:
-            caps = self.capabilities
-            pr = packet.properties
-            pr.session_expiry = min(
-                client.properties.session_expiry,
-                caps.maximum_session_expiry_interval) \
-                if client.properties.session_expiry_set else None
-            pr.receive_maximum = caps.receive_maximum or None
-            if caps.maximum_qos < 2:
-                pr.maximum_qos = caps.maximum_qos
-            pr.retain_available = None if caps.retain_available else 0
-            if caps.maximum_packet_size:
-                pr.maximum_packet_size = caps.maximum_packet_size
-            pr.topic_alias_max = caps.topic_alias_maximum or None
-            pr.wildcard_sub_available = None if caps.wildcard_sub_available else 0
-            pr.sub_id_available = None if caps.sub_id_available else 0
-            pr.shared_sub_available = None if caps.shared_sub_available else 0
-            if getattr(client, "assigned_id", False):
-                pr.assigned_client_id = client.id
-            if (caps.maximum_keepalive
-                    and client.keepalive != client.requested_keepalive):
-                pr.server_keep_alive = client.keepalive
+            self._fill_connack_props(client, packet.properties)
         client.send_now(packet)
+
+    def _fill_connack_props(self, client: Client, pr) -> None:
+        """Advertise the server capability set on a v5 CONNACK
+        [MQTT-3.2.2]; None leaves a property off the wire."""
+        caps = self.capabilities
+        pr.session_expiry = min(
+            client.properties.session_expiry,
+            caps.maximum_session_expiry_interval) \
+            if client.properties.session_expiry_set else None
+        pr.receive_maximum = caps.receive_maximum or None
+        if caps.maximum_qos < 2:
+            pr.maximum_qos = caps.maximum_qos
+        if caps.maximum_packet_size:
+            pr.maximum_packet_size = caps.maximum_packet_size
+        pr.topic_alias_max = caps.topic_alias_maximum or None
+        for prop, available in (
+                ("retain_available", caps.retain_available),
+                ("wildcard_sub_available", caps.wildcard_sub_available),
+                ("sub_id_available", caps.sub_id_available),
+                ("shared_sub_available", caps.shared_sub_available)):
+            setattr(pr, prop, None if available else 0)
+        if getattr(client, "assigned_id", False):
+            pr.assigned_client_id = client.id
+        if (caps.maximum_keepalive
+                and client.keepalive != client.requested_keepalive):
+            pr.server_keep_alive = client.keepalive
 
     async def _detach_client(self, client: Client, err: ProtocolError | None) -> None:
         """Connection teardown: will handling, registry bookkeeping, expiry."""
@@ -362,35 +368,28 @@ class Broker:
         t = packet.type
         if t == PT.PUBLISH:
             await self.process_publish(client, packet)
-        elif t == PT.PUBACK:
-            self._process_puback(client, packet)
-        elif t == PT.PUBREC:
-            self._process_pubrec(client, packet)
-        elif t == PT.PUBREL:
-            self._process_pubrel(client, packet)
-        elif t == PT.PUBCOMP:
-            self._process_pubcomp(client, packet)
-        elif t == PT.SUBSCRIBE:
-            self._process_subscribe(client, packet)
-        elif t == PT.UNSUBSCRIBE:
-            self._process_unsubscribe(client, packet)
-        elif t == PT.PINGREQ:
-            client.send(Packet(fixed=FixedHeader(type=PT.PINGRESP),
-                               protocol_version=client.properties.protocol_version))
-        elif t == PT.DISCONNECT:
-            self._process_disconnect(client, packet)
-        elif t == PT.AUTH:
-            if not packet.reason_code_valid():
-                raise ProtocolError(codes.ErrProtocolViolation,
-                                    "invalid AUTH reason code"
-                                    )  # [MQTT-3.15.2-1]
-            self.hooks.modify("on_auth_packet", packet, client)
-        elif t == PT.CONNECT:
-            raise ProtocolError(codes.ErrProtocolViolation,
-                                "second CONNECT on live connection")
-        else:
+            return
+        handler = self._DISPATCH.get(t)
+        if handler is None:
             raise ProtocolError(codes.ErrProtocolViolation,
                                 f"unexpected packet type {t}")
+        handler(self, client, packet)
+
+    def _process_pingreq(self, client: Client, packet: Packet) -> None:
+        client.send(Packet(fixed=FixedHeader(type=PT.PINGRESP),
+                           protocol_version=client.properties.protocol_version))
+
+    def _process_auth(self, client: Client, packet: Packet) -> None:
+        if not packet.reason_code_valid():
+            raise ProtocolError(codes.ErrProtocolViolation,
+                                "invalid AUTH reason code"
+                                )  # [MQTT-3.15.2-1]
+        self.hooks.modify("on_auth_packet", packet, client)
+
+    def _process_second_connect(self, client: Client,
+                                packet: Packet) -> None:
+        raise ProtocolError(codes.ErrProtocolViolation,
+                            "second CONNECT on live connection")
 
     def _process_disconnect(self, client: Client, packet: Packet) -> None:
         if (packet.protocol_version >= 5
@@ -419,34 +418,15 @@ class Broker:
         packet.origin = client.id
         packet.created = time.time()
 
-        # inbound topic alias resolution (v5)
-        if client.properties.protocol_version >= 5 and client.aliases is not None:
-            alias = packet.properties.topic_alias
-            if alias is not None:
-                resolved = client.aliases.resolve_inbound(packet.topic, alias)
-                if resolved is None:
-                    raise ProtocolError(codes.ErrTopicAliasInvalid)
-                packet.topic = resolved
-                packet.properties.topic_alias = None
+        self._resolve_inbound_alias(client, packet)
         if packet.topic.startswith("$") and not client.inline:
             return  # clients may not publish into reserved $ topics
         if not self.hooks.any_allow("on_acl_check", client, packet.topic, True):
             # [MQTT-3.3.5-2]: ack but do not deliver
             self._ack_publish(client, packet, success=False)
             return
-        if packet.fixed.qos > self.capabilities.maximum_qos:
-            raise ProtocolError(codes.ErrQosNotSupported)
-        if packet.fixed.retain and not self.capabilities.retain_available:
-            raise ProtocolError(codes.ErrRetainNotSupported)
-
-        # QoS2 dedup: a repeated packet id re-acks without re-delivery
-        if packet.fixed.qos == 2 and packet.packet_id in client.pubrec_inbound:
-            client.send(Packet(fixed=FixedHeader(type=PT.PUBREC),
-                               protocol_version=packet.protocol_version,
-                               packet_id=packet.packet_id))
-            return
-        if packet.fixed.qos > 0 and not client.inflight.take_receive_quota():
-            raise ProtocolError(codes.ErrReceiveMaximumExceeded)
+        if not self._check_publish_qos(client, packet):
+            return  # QoS2 dedup re-acked without re-delivery
 
         try:
             packet = self.hooks.modify("on_publish", packet, client)
@@ -463,6 +443,37 @@ class Broker:
         else:
             await self.publish_to_subscribers(packet)
         self.hooks.notify("on_published", client, packet)
+
+    @staticmethod
+    def _resolve_inbound_alias(client: Client, packet: Packet) -> None:
+        """Inbound v5 topic-alias resolution [MQTT-3.3.2-7..12]."""
+        if client.properties.protocol_version < 5 or client.aliases is None:
+            return
+        alias = packet.properties.topic_alias
+        if alias is None:
+            return
+        resolved = client.aliases.resolve_inbound(packet.topic, alias)
+        if resolved is None:
+            raise ProtocolError(codes.ErrTopicAliasInvalid)
+        packet.topic = resolved
+        packet.properties.topic_alias = None
+
+    def _check_publish_qos(self, client: Client, packet: Packet) -> bool:
+        """Capability limits + QoS2 dedup + receive quota; False means
+        the packet was already re-acked (repeated QoS2 id)."""
+        if packet.fixed.qos > self.capabilities.maximum_qos:
+            raise ProtocolError(codes.ErrQosNotSupported)
+        if packet.fixed.retain and not self.capabilities.retain_available:
+            raise ProtocolError(codes.ErrRetainNotSupported)
+        # QoS2 dedup: a repeated packet id re-acks without re-delivery
+        if packet.fixed.qos == 2 and packet.packet_id in client.pubrec_inbound:
+            client.send(Packet(fixed=FixedHeader(type=PT.PUBREC),
+                               protocol_version=packet.protocol_version,
+                               packet_id=packet.packet_id))
+            return False
+        if packet.fixed.qos > 0 and not client.inflight.take_receive_quota():
+            raise ProtocolError(codes.ErrReceiveMaximumExceeded)
+        return True
 
     def _match_cached(self, topic: str) -> SubscriberSet:
         # safe even with on_select_subscribers hooks installed: _fan_out
@@ -647,48 +658,11 @@ class Broker:
             self._send_fast_qos0(client, packet)
             return
 
-        out = packet.copy()
-        out.protocol_version = client.properties.protocol_version
-        out.fixed.qos = min(packet.fixed.qos, sub.qos,
-                            self.capabilities.maximum_qos)
-        out.fixed.dup = False
-        if not sub.retain_as_published:
-            out.fixed.retain = False
-        if client.properties.protocol_version >= 5:
-            ids = sorted(set(sub.identifiers.values())
-                         or ({sub.identifier} if sub.identifier else set()))
-            out.properties.subscription_ids = ids
-            out.properties.topic_alias = None
-            if client.aliases is not None and client.properties.topic_alias_maximum:
-                alias, first = client.aliases.assign_outbound(out.topic)
-                if alias and not first:
-                    out.properties.topic_alias = alias
-                    out.topic = ""
-                elif alias:
-                    out.properties.topic_alias = alias
-        else:
-            out.properties = type(out.properties)()
-
+        out = self._build_outbound(client, sub, packet)
         if client.closed and out.fixed.qos == 0:
             return  # QoS0 is not queued for offline clients
-        if out.fixed.qos > 0:
-            if len(client.inflight) >= self.capabilities.maximum_inflight:
-                self.info.inflight_dropped += 1
-                self.hooks.notify("on_qos_dropped", client, out)
-                return
-            try:
-                out.packet_id = client.next_packet_id()
-            except PacketIDExhausted:
-                self.hooks.notify("on_packet_id_exhausted", client, out)
-                return
-            out.created = time.time()
-            client.inflight.set(out.copy())
-            self.info.inflight += 1
-            if not client.inflight.take_send_quota():
-                # park until an ack returns quota (_release_held)
-                client.held_pids.append(out.packet_id)
-                return
-            self.hooks.notify("on_qos_publish", client, out, out.created, 0)
+        if out.fixed.qos > 0 and not self._enqueue_qos(client, out):
+            return  # dropped, exhausted, or parked on send quota
         if client.closed:
             return  # queued in inflight for session resume
         if not client.send(out):
@@ -698,6 +672,56 @@ class Broker:
                 client.inflight.delete(out.packet_id)
                 client.inflight.return_send_quota()
                 self.info.inflight -= 1
+
+    def _build_outbound(self, client: Client, sub: Subscription,
+                        packet: Packet) -> Packet:
+        """Shape the delivery copy for one subscriber: effective QoS,
+        retain-as-published, and the v5 property set (subscription ids,
+        outbound topic alias)."""
+        out = packet.copy()
+        out.protocol_version = client.properties.protocol_version
+        out.fixed.qos = min(packet.fixed.qos, sub.qos,
+                            self.capabilities.maximum_qos)
+        out.fixed.dup = False
+        if not sub.retain_as_published:
+            out.fixed.retain = False
+        if client.properties.protocol_version < 5:
+            out.properties = type(out.properties)()
+            return out
+        ids = sorted(set(sub.identifiers.values())
+                     or ({sub.identifier} if sub.identifier else set()))
+        out.properties.subscription_ids = ids
+        out.properties.topic_alias = None
+        if client.aliases is not None and client.properties.topic_alias_maximum:
+            alias, first = client.aliases.assign_outbound(out.topic)
+            if alias and not first:
+                out.properties.topic_alias = alias
+                out.topic = ""
+            elif alias:
+                out.properties.topic_alias = alias
+        return out
+
+    def _enqueue_qos(self, client: Client, out: Packet) -> bool:
+        """QoS>0 inflight bookkeeping; returns False when the message
+        was dropped (cap), exhausted (no free packet id), or parked
+        until an ack returns send quota (_release_held)."""
+        if len(client.inflight) >= self.capabilities.maximum_inflight:
+            self.info.inflight_dropped += 1
+            self.hooks.notify("on_qos_dropped", client, out)
+            return False
+        try:
+            out.packet_id = client.next_packet_id()
+        except PacketIDExhausted:
+            self.hooks.notify("on_packet_id_exhausted", client, out)
+            return False
+        out.created = time.time()
+        client.inflight.set(out.copy())
+        self.info.inflight += 1
+        if not client.inflight.take_send_quota():
+            client.held_pids.append(out.packet_id)
+            return False
+        self.hooks.notify("on_qos_publish", client, out, out.created, 0)
+        return True
 
     # ------------------------------------------------------------------
     # QoS acknowledgement state machines (v2/server.go:909-987)
@@ -850,32 +874,35 @@ class Broker:
         now = time.time()
         maxexp = self.capabilities.maximum_message_expiry_interval
         for msg in self.topics.retained_for(sub.filter):
-            if self._message_expired(msg, now, maxexp):
-                continue
-            out = msg.copy()
-            out.protocol_version = client.properties.protocol_version
-            out.fixed.retain = True
-            out.fixed.qos = min(out.fixed.qos, sub.qos)
-            out.fixed.dup = False
-            if out.protocol_version < 5:
-                out.properties = type(out.properties)()
-            if out.fixed.qos > 0:
-                if len(client.inflight) >= self.capabilities.maximum_inflight:
-                    self.info.inflight_dropped += 1
-                    continue
-                try:
-                    out.packet_id = client.next_packet_id()
-                except PacketIDExhausted:
-                    continue
-                out.created = now
-                client.inflight.set(out.copy())
-                self.info.inflight += 1
-                if not client.inflight.take_send_quota():
-                    # respect the client's receive maximum [MQTT-3.3.4-9]
-                    client.held_pids.append(out.packet_id)
-                    continue
-            if client.send(out):
-                self.hooks.notify("on_retain_published", client, out)
+            if not self._message_expired(msg, now, maxexp):
+                self._send_retained(client, sub, msg, now)
+
+    def _send_retained(self, client: Client, sub: Subscription,
+                       msg: Packet, now: float) -> None:
+        out = msg.copy()
+        out.protocol_version = client.properties.protocol_version
+        out.fixed.retain = True
+        out.fixed.qos = min(out.fixed.qos, sub.qos)
+        out.fixed.dup = False
+        if out.protocol_version < 5:
+            out.properties = type(out.properties)()
+        if out.fixed.qos > 0:
+            if len(client.inflight) >= self.capabilities.maximum_inflight:
+                self.info.inflight_dropped += 1
+                return
+            try:
+                out.packet_id = client.next_packet_id()
+            except PacketIDExhausted:
+                return
+            out.created = now
+            client.inflight.set(out.copy())
+            self.info.inflight += 1
+            if not client.inflight.take_send_quota():
+                # respect the client's receive maximum [MQTT-3.3.4-9]
+                client.held_pids.append(out.packet_id)
+                return
+        if client.send(out):
+            self.hooks.notify("on_retain_published", client, out)
 
     def _process_unsubscribe(self, client: Client, packet: Packet) -> None:
         packet = self.hooks.modify("on_unsubscribe", packet, client)
@@ -1145,3 +1172,18 @@ class Broker:
                       "messages_sent", "messages_dropped", "packets_received",
                       "packets_sent", "clients_maximum", "clients_total"):
                 setattr(self.info, k, getattr(stored_info, k, 0))
+
+    # non-PUBLISH packet dispatch (PUBLISH stays inline in
+    # _process_packet: it is the only async handler and the hot path)
+    _DISPATCH = {
+        PT.PUBACK: _process_puback,
+        PT.PUBREC: _process_pubrec,
+        PT.PUBREL: _process_pubrel,
+        PT.PUBCOMP: _process_pubcomp,
+        PT.SUBSCRIBE: _process_subscribe,
+        PT.UNSUBSCRIBE: _process_unsubscribe,
+        PT.PINGREQ: _process_pingreq,
+        PT.DISCONNECT: _process_disconnect,
+        PT.AUTH: _process_auth,
+        PT.CONNECT: _process_second_connect,
+    }

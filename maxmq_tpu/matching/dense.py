@@ -101,8 +101,15 @@ def compile_dense_subscriptions(subs, version: int = 0,
     builder = EntryBuilder()
     if vocab is None:
         vocab = {}
-    root = _Node()
+    root = _build_filter_trie(subs, vocab, builder)
+    levels, rows = _bfs_levels(root, vocab)
+    return DenseTables(levels=levels, row_entries=rows,
+                       entries=builder.entries, vocab=vocab,
+                       n_rows=len(rows), version=version)
 
+
+def _build_filter_trie(subs, vocab, builder) -> "_Node":
+    root = _Node()
     for filt, client_id, sub, group in subs:
         # `filt` is the trie path: already '$share'-stripped for shared subs
         node = root
@@ -116,25 +123,25 @@ def compile_dense_subscriptions(subs, version: int = 0,
         bit = builder.add(filt, client_id, sub, group)
         if bit is not None:
             node.bits.append(bit)
-    entries = builder.entries
+    return root
 
-    # ---- BFS levels: slots = children of previous level -------------------
-    # Subscriber-carrying slots are ordered FIRST within each level, so the
-    # kernel's emission is a free prefix slice instead of a column gather
-    # (dynamic-looking gathers are the enemy on TPU even with static
-    # indices — measured ~30ms/batch for the gather form).
+
+def _bfs_levels(root, vocab):
+    """BFS levels: slots = children of previous level. Subscriber-
+    carrying slots are ordered FIRST within each level, so the kernel's
+    emission is a free prefix slice instead of a column gather
+    (dynamic-looking gathers are the enemy on TPU even with static
+    indices — measured ~30ms/batch for the gather form)."""
     levels: list[LevelArrays] = []
     rows: list[tuple[int, ...]] = []
     frontier: list[_Node] = [root]
     while True:
+        wild_toks = {"+": PLUS, "#": HASH}
         triples = []     # (emit_key, tok, parent, node, is_hash)
         for p, node in enumerate(frontier):
             for key, child in node.children.items():
-                if key == "+":
-                    tok = PLUS
-                elif key == "#":
-                    tok = HASH
-                else:
+                tok = wild_toks.get(key)
+                if tok is None:
                     tok = vocab[key]
                 triples.append((0 if child.bits else 1, tok, p, child,
                                 key == "#"))
@@ -143,7 +150,6 @@ def compile_dense_subscriptions(subs, version: int = 0,
         triples.sort(key=lambda t: t[0])   # stable: emitters first
         child_tok = np.asarray([t[1] for t in triples], dtype=np.int32)
         parent_idx = np.asarray([t[2] for t in triples], dtype=np.int32)
-        nodes = [t[3] for t in triples]
         emit_exact: list[bool] = []
         for emit, _tok, _p, child, hashy in triples:
             if emit == 0:
@@ -154,10 +160,8 @@ def compile_dense_subscriptions(subs, version: int = 0,
             parent_idx=parent_idx,
             emit_exact=np.asarray(emit_exact, dtype=bool),
         ))
-        frontier = nodes
-
-    return DenseTables(levels=levels, row_entries=rows, entries=entries,
-                       vocab=vocab, n_rows=len(rows), version=version)
+        frontier = [t[3] for t in triples]
+    return levels, rows
 
 
 def dense_match_body(level_consts, toks, lengths, dollar, n_rows: int,

@@ -229,6 +229,60 @@ def _run_chunk_select(kern, sig, flag, grp_c, planes_c, tb, g_pad, chunk,
     )(sig, flag, grp_c, planes_c)
 
 
+def _bake_chunk_constants(tables, g_pad, chunk, n_chunks, n_words,
+                          select_expand):
+    """Per-chunk kernel operands, padded to the full chunk grid
+    (n_chunks * chunk >= w_pad): every BlockSpec-visible column must
+    carry the poison scheme (no group / zero one-hot => sig_exp 0,
+    plane 0xFFFFFFFF => never equal), so the last chunk's padding can
+    never produce phantom bits."""
+    w_full = n_chunks * chunk
+    grp_sizes = [int(w) for w in tables.group_words]
+    onehot = np.zeros((g_pad, w_full), dtype=np.float32)
+    grp_of_word = np.full((1, w_full), -1, dtype=np.int32)
+    w0 = 0
+    for g, w in enumerate(grp_sizes):
+        onehot[g, w0:w0 + w] = 1.0
+        grp_of_word[0, w0:w0 + w] = g
+        w0 += w
+    planes = np.full((32, w_full), 0xFFFFFFFF, dtype=np.uint32)
+    if tables.n_rows:
+        planes[:, :n_words] = tables.row_sig.reshape(n_words, 32).T
+    expand_src = grp_of_word if select_expand else onehot
+    expand_c = [jax.device_put(jnp.asarray(
+        expand_src[:, c * chunk:(c + 1) * chunk]))
+        for c in range(n_chunks)]
+    planes_c = [jax.device_put(jnp.asarray(
+        planes[:, c * chunk:(c + 1) * chunk])) for c in range(n_chunks)]
+    return expand_c, planes_c
+
+
+def _merge_chunk_outputs(outs, max_rows):
+    """Fold per-chunk (count | sorted slots) outputs into one sorted row
+    set. Merge-by-min-extract: per-chunk slots are already sorted and
+    the concat is narrow (NC * max_rows), so max_rows min+mask passes
+    beat a full XLA sort."""
+    if len(outs) == 1:
+        cnt0 = outs[0][:, 0]
+        rows_sorted = outs[0][:, 1:]
+        overflow = cnt0 == 0xF
+        counts = jnp.where(overflow, 0, cnt0).astype(jnp.int32)
+        return counts, overflow, rows_sorted
+    cnts = jnp.stack([o[:, 0] for o in outs], axis=1)  # [B, NC]
+    overflow = (cnts == 0xF).any(axis=1)
+    counts = jnp.where(cnts == 0xF, 0,
+                       cnts.astype(jnp.int32)).sum(axis=1)
+    overflow = overflow | (counts > max_rows)
+    cand = jnp.concatenate([o[:, 1:] for o in outs], axis=1)
+    merged = []
+    for _ in range(max_rows):
+        m = cand.min(axis=1)
+        merged.append(m)
+        cand = jnp.where(cand == m[:, None],
+                         jnp.uint32(0xFFFFFFFF), cand)
+    return counts, overflow, jnp.stack(merged, axis=1)
+
+
 def build_fixed_fn(tables: SigTables, consts: dict, kplan: dict,
                    max_rows: int):
     """(jit(toks8, lens_enc) -> (counts_u8, row stream), format
@@ -249,33 +303,10 @@ def build_fixed_fn(tables: SigTables, consts: dict, kplan: dict,
     # when the bound is a power of two
     enc_bits = (n_chunks * chunk * 32).bit_length()
 
-    # constants padded to the full chunk grid (n_chunks * chunk >= w_pad):
-    # every BlockSpec-visible column must carry the poison scheme (no
-    # group / zero one-hot => sig_exp 0, plane 0xFFFFFFFF => never
-    # equal), so the last chunk's padding can never produce phantom bits
-    w_full = n_chunks * chunk
     n_groups = len(tables.groups)
     select_expand = n_groups <= SELECT_EXPAND_MAX
-    grp_sizes = [int(w) for w in tables.group_words]
-    onehot = np.zeros((g_pad, w_full), dtype=np.float32)
-    grp_of_word = np.full((1, w_full), -1, dtype=np.int32)
-    w0 = 0
-    for g, w in enumerate(grp_sizes):
-        onehot[g, w0:w0 + w] = 1.0
-        grp_of_word[0, w0:w0 + w] = g
-        w0 += w
-    planes = np.full((32, w_full), 0xFFFFFFFF, dtype=np.uint32)
-    if tables.n_rows:
-        planes[:, :n_words] = tables.row_sig.reshape(n_words, 32).T
-    if select_expand:
-        expand_c = [jax.device_put(jnp.asarray(
-            grp_of_word[:, c * chunk:(c + 1) * chunk]))
-            for c in range(n_chunks)]
-    else:
-        expand_c = [jax.device_put(jnp.asarray(
-            onehot[:, c * chunk:(c + 1) * chunk])) for c in range(n_chunks)]
-    planes_c = [jax.device_put(jnp.asarray(
-        planes[:, c * chunk:(c + 1) * chunk])) for c in range(n_chunks)]
+    expand_c, planes_c = _bake_chunk_constants(
+        tables, g_pad, chunk, n_chunks, n_words, select_expand)
 
     # CPU backend (tests) runs the kernel in the Pallas interpreter
     interpret = jax.default_backend() != "tpu"
@@ -318,28 +349,8 @@ def build_fixed_fn(tables: SigTables, consts: dict, kplan: dict,
                                    interpret)
                     for c in range(n_chunks)]
 
-        if n_chunks == 1:
-            cnt0 = outs[0][:, 0]
-            rows_sorted = outs[0][:, 1:]
-            overflow = cnt0 == 0xF
-            counts = jnp.where(overflow, 0, cnt0).astype(jnp.int32)
-        else:
-            cnts = jnp.stack([o[:, 0] for o in outs], axis=1)  # [B, NC]
-            overflow = (cnts == 0xF).any(axis=1)
-            counts = jnp.where(cnts == 0xF, 0,
-                               cnts.astype(jnp.int32)).sum(axis=1)
-            overflow = overflow | (counts > max_rows)
-            cand = jnp.concatenate([o[:, 1:] for o in outs], axis=1)
-            # merge-by-min-extract: per-chunk slots are already sorted
-            # and the concat is narrow (NC * max_rows), so max_rows
-            # min+mask passes beat a full XLA sort
-            merged = []
-            for _ in range(max_rows):
-                m = cand.min(axis=1)
-                merged.append(m)
-                cand = jnp.where(cand == m[:, None],
-                                 jnp.uint32(0xFFFFFFFF), cand)
-            rows_sorted = jnp.stack(merged, axis=1)
+        counts, overflow, rows_sorted = _merge_chunk_outputs(outs,
+                                                             max_rows)
 
         # stream compaction: the fetch crosses a narrow host link (and a
         # ~60ms-latency tunnel in this rig), so the wire format is ONE

@@ -26,21 +26,27 @@ def parse_share(filter_: str) -> tuple[str, str]:
     return group, inner
 
 
+def _strip_valid_share(filter_: str, shared_allowed: bool) -> str | None:
+    """For `$share/{group}/{filter}`, validate the share envelope
+    [MQTT-4.8.2-1/2] and return the inner filter; None = invalid."""
+    if not filter_.startswith(SHARE_PREFIX + "/"):
+        return filter_
+    if not shared_allowed:
+        return None
+    group, inner = parse_share(filter_)
+    if group == "" or "+" in group or "#" in group:
+        return None
+    return inner or None
+
+
 def valid_filter(filter_: str, shared_allowed: bool = True,
                  wildcards_allowed: bool = True) -> bool:
     """MQTT 4.7.1 filter validity, incl. `$share/{group}/{filter}` rules."""
     if filter_ == "":
         return False  # [MQTT-4.7.3-1]
-    group, inner = parse_share(filter_)
-    if filter_.startswith(SHARE_PREFIX + "/"):
-        if not shared_allowed:
-            return False
-        # group must be non-empty and wildcard-free [MQTT-4.8.2-1/2]
-        if group == "" or "+" in group or "#" in group:
-            return False
-        if inner == "":
-            return False
-        filter_ = inner
+    filter_ = _strip_valid_share(filter_, shared_allowed)
+    if filter_ is None:
+        return False
     levels = split_levels(filter_)
     for i, level in enumerate(levels):
         if "#" in level:

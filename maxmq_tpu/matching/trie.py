@@ -394,16 +394,7 @@ class TopicIndex:
             return
         level = levels[depth]
         if level == "#":
-            # matches the parent level itself and every descendant
-            stack = [(node, depth == 0)]
-            while stack:
-                n, top = stack.pop()
-                if n.retained is not None:
-                    out.append(n.retained)
-                for name, child in n.children.items():
-                    if top and name.startswith("$"):
-                        continue
-                    stack.append((child, False))
+            self._collect_subtree_retained(node, depth == 0, out)
             return
         if level == "+":
             for name, child in node.children.items():
@@ -414,6 +405,21 @@ class TopicIndex:
         child = node.children.get(level)
         if child is not None:
             self._scan_retained(child, levels, depth + 1, out)
+
+    @staticmethod
+    def _collect_subtree_retained(node: _Node, top: bool,
+                                  out: list[Packet]) -> None:
+        """'#' matches the parent level itself and every descendant;
+        top-level '$' children are excluded [MQTT-4.7.2-1]."""
+        stack = [(node, top)]
+        while stack:
+            n, top = stack.pop()
+            if n.retained is not None:
+                out.append(n.retained)
+            for name, child in n.children.items():
+                if top and name.startswith("$"):
+                    continue
+                stack.append((child, False))
 
     # ------------------------------------------------------------------
     # Introspection (NFA compiler input, $SYS counters)

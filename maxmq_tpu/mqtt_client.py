@@ -73,21 +73,7 @@ class MQTTClient:
                 asyncio.open_connection(host, port), timeout)
         else:
             self.reader, self.writer = reader, writer
-        packet = Packet(fixed=FixedHeader(type=PT.CONNECT),
-                        protocol_version=self.version,
-                        clean_start=self.clean_start,
-                        keepalive=self.keepalive,
-                        client_id=self.client_id,
-                        will=self.will)
-        if self.username:
-            packet.username = self.username.encode()
-            packet.username_flag = True
-        if self.password:
-            packet.password = self.password.encode()
-            packet.password_flag = True
-        if self.version >= 5 and self.session_expiry is not None:
-            packet.properties.session_expiry = self.session_expiry
-        self.writer.write(packet.encode())
+        self.writer.write(self._connect_packet().encode())
         await self.writer.drain()
 
         buf = bytearray()
@@ -109,6 +95,23 @@ class MQTTClient:
                 self._read_task = asyncio.get_running_loop().create_task(
                     self._read_loop(bytes(buf)))
                 return self.connack
+
+    def _connect_packet(self) -> Packet:
+        packet = Packet(fixed=FixedHeader(type=PT.CONNECT),
+                        protocol_version=self.version,
+                        clean_start=self.clean_start,
+                        keepalive=self.keepalive,
+                        client_id=self.client_id,
+                        will=self.will)
+        if self.username:
+            packet.username = self.username.encode()
+            packet.username_flag = True
+        if self.password:
+            packet.password = self.password.encode()
+            packet.password_flag = True
+        if self.version >= 5 and self.session_expiry is not None:
+            packet.properties.session_expiry = self.session_expiry
+        return packet
 
     async def _read_loop(self, initial: bytes = b"") -> None:
         buf = self._read_buf = bytearray(initial)

@@ -55,6 +55,63 @@ def make_mesh(shape: tuple[int, int] = None, devices=None) -> Mesh:
     return Mesh(mesh_devices, axis_names=("data", "subs"))
 
 
+def _pad_and_stack_shards(shards, sp: int) -> tuple:
+    """Pad per-shard sig tables to common shapes and stack on 'subs'.
+
+    +1 group column: padding word slots must NOT alias a real group — a
+    real group's adjusted signature can (adversarially, the hash seed is
+    deterministic) equal the 0xFFFFFFFF poison plane, emitting row ids
+    past the shard's row tables. The extra all-zero-coefficient group
+    has signature 0 for every topic (never the poison), so padding
+    words can never fire."""
+    g_real = max(max(len(t.groups), 1) for t in shards)
+    g_max = g_real + 1
+    g_pad = g_real
+    d_max = max(max(t.probe_depth, 1) for t in shards)
+    w_max = max(max(int(t.group_words.sum()), 1) for t in shards)
+
+    topo = np.zeros((sp, g_max, d_max), dtype=np.uint32)
+    dc = np.zeros((sp, g_max), dtype=np.uint32)
+    mind = np.zeros((sp, g_max), dtype=np.int32)
+    ish = np.zeros((sp, g_max), dtype=bool)
+    wild = np.zeros((sp, g_max), dtype=bool)
+    planes = np.full((sp, 32, w_max), 0xFFFFFFFF, dtype=np.uint32)
+    grp = np.full((sp, w_max), g_pad, dtype=np.int32)
+    for s, t in enumerate(shards):
+        g = len(t.groups)
+        if g:
+            topo[s, :g, :t.topo_coef.shape[1]] = t.topo_coef
+            dc[s, :g] = t.depth_coef
+            mind[s, :g] = t.min_depth
+            ish[s, :g] = t.is_hash
+            wild[s, :g] = t.wild_first
+        w = int(t.group_words.sum())
+        if w:
+            planes[s, :, :w] = t.row_sig.reshape(w, 32).T
+            grp[s, :w] = np.repeat(
+                np.arange(g, dtype=np.int32), t.group_words)
+    return (topo, dc, mind, ish, wild, planes, grp), d_max
+
+
+def _group_by_slice(devices, n_slices) -> list[list]:
+    """Group devices by hardware slice_index; a synthetic even split
+    when the platform reports one slice but n_slices is forced."""
+    groups: dict[int, list] = {}
+    for d in devices:
+        groups.setdefault(getattr(d, "slice_index", 0) or 0, []).append(d)
+    if len(groups) == 1 and n_slices and n_slices > 1:
+        per = len(devices) // n_slices
+        if per == 0:
+            raise ValueError(f"need >= {n_slices} devices for "
+                             f"{n_slices} slices, have {len(devices)}")
+        groups = {i: devices[i * per:(i + 1) * per]
+                  for i in range(n_slices)}
+    elif n_slices and n_slices != len(groups):
+        raise ValueError(f"n_slices={n_slices} but the platform reports "
+                         f"{len(groups)} hardware slice(s)")
+    return [groups[k] for k in sorted(groups)]
+
+
 def make_multislice_mesh(n_slices: int | None = None,
                          shape: tuple[int, int] | None = None,
                          devices=None) -> Mesh:
@@ -77,20 +134,7 @@ def make_multislice_mesh(n_slices: int | None = None,
 
     if devices is None:
         devices = list(jax.devices())
-    groups: dict[int, list] = {}
-    for d in devices:
-        groups.setdefault(getattr(d, "slice_index", 0) or 0, []).append(d)
-    if len(groups) == 1 and n_slices and n_slices > 1:
-        per = len(devices) // n_slices
-        if per == 0:
-            raise ValueError(f"need >= {n_slices} devices for "
-                             f"{n_slices} slices, have {len(devices)}")
-        groups = {i: devices[i * per:(i + 1) * per]
-                  for i in range(n_slices)}
-    elif n_slices and n_slices != len(groups):
-        raise ValueError(f"n_slices={n_slices} but the platform reports "
-                         f"{len(groups)} hardware slice(s)")
-    slices = [groups[k] for k in sorted(groups)]
+    slices = _group_by_slice(devices, n_slices)
     per = min(len(s) for s in slices)
     if shape is None:
         shape = (1, per)
@@ -240,46 +284,11 @@ class ShardedSigEngine(OverlayedEngine):
                                self.dp)
                 return True
 
-            # pad per-shard tables to common shapes and stack on 'subs'.
-            # +1 group column: padding word slots must NOT alias a real
-            # group — a real group's adjusted signature can (adversarially,
-            # the hash seed is deterministic) equal the 0xFFFFFFFF poison
-            # plane, emitting row ids past the shard's row tables. The
-            # extra all-zero-coefficient group has signature 0 for every
-            # topic (never the poison), so padding words can never fire.
-            g_real = max(max(len(t.groups), 1) for t in shards)
-            g_max = g_real + 1
-            g_pad = g_real
-            d_max = max(max(t.probe_depth, 1) for t in shards)
-            w_max = max(max(int(t.group_words.sum()), 1) for t in shards)
-
-            topo = np.zeros((self.sp, g_max, d_max), dtype=np.uint32)
-            dc = np.zeros((self.sp, g_max), dtype=np.uint32)
-            mind = np.zeros((self.sp, g_max), dtype=np.int32)
-            ish = np.zeros((self.sp, g_max), dtype=bool)
-            wild = np.zeros((self.sp, g_max), dtype=bool)
-            planes = np.full((self.sp, 32, w_max), 0xFFFFFFFF,
-                             dtype=np.uint32)
-            grp = np.full((self.sp, w_max), g_pad, dtype=np.int32)
-            for s, t in enumerate(shards):
-                g = len(t.groups)
-                if g:
-                    topo[s, :g, :t.topo_coef.shape[1]] = t.topo_coef
-                    dc[s, :g] = t.depth_coef
-                    mind[s, :g] = t.min_depth
-                    ish[s, :g] = t.is_hash
-                    wild[s, :g] = t.wild_first
-                w = int(t.group_words.sum())
-                if w:
-                    planes[s, :, :w] = t.row_sig.reshape(w, 32).T
-                    grp[s, :w] = np.repeat(
-                        np.arange(g, dtype=np.int32), t.group_words)
-
+            stacked, d_max = _pad_and_stack_shards(shards, self.sp)
             mesh = self.mesh
             subs_axes = self._subs_axes
             by_shard = NamedSharding(mesh, P(subs_axes))
-            dev = tuple(jax.device_put(a, by_shard)
-                        for a in (topo, dc, mind, ish, wild, planes, grp))
+            dev = tuple(jax.device_put(a, by_shard) for a in stacked)
 
             fn = jax.jit(jax.shard_map(
                 partial(_sharded_sig_match, sel_blocks=self.sel_blocks,

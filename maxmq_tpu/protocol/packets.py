@@ -235,9 +235,7 @@ class Packet:
         out.extend(body)
         return bytes(out)
 
-    def _enc_connect(self, body: bytearray) -> None:
-        write_string(body, PROTOCOL_NAMES.get(self.protocol_version, "MQTT"))
-        body.append(self.protocol_version)
+    def _connect_flags(self) -> int:
         flags = 0
         if self.clean_start:
             flags |= 0x02
@@ -249,7 +247,12 @@ class Packet:
             flags |= 0x40
         if self.username_flag:
             flags |= 0x80
-        body.append(flags)
+        return flags
+
+    def _enc_connect(self, body: bytearray) -> None:
+        write_string(body, PROTOCOL_NAMES.get(self.protocol_version, "MQTT"))
+        body.append(self.protocol_version)
+        body.append(self._connect_flags())
         write_uint16(body, self.keepalive)
         if self.v5:
             self.properties.encode(body, PT.CONNECT)
@@ -341,6 +344,22 @@ class Packet:
                                 f"unknown protocol {self.protocol_name!r} "
                                 f"v{self.protocol_version}")
         flags = body[off]; off += 1
+        will_flag = self._check_connect_flags(flags)
+        self.keepalive, off = read_uint16(body, off)
+        if self.v5:
+            self.properties, off = Properties.decode(body, off, PT.CONNECT)
+        self.client_id, off = read_string(body, off)
+        if will_flag:
+            off = self._dec_will(body, off, flags)
+        if self.username_flag:
+            self.username, off = read_binary(body, off)
+        if self.password_flag:
+            self.password, off = read_binary(body, off)
+        if off != len(body):
+            raise MalformedPacketError("trailing bytes after CONNECT payload")
+
+    def _check_connect_flags(self, flags: int) -> bool:
+        """Validate the CONNECT flags byte; returns the will flag."""
         if flags & 0x01:
             raise ProtocolError(codes.ErrProtocolViolation,
                                 "connect reserved flag set")  # [MQTT-3.1.2-3]
@@ -359,24 +378,18 @@ class Packet:
             # [MQTT-3.1.2-22]; v5 lifts this restriction.
             raise ProtocolError(codes.ErrProtocolViolation,
                                 "password flag without username flag")
-        self.keepalive, off = read_uint16(body, off)
+        return will_flag
+
+    def _dec_will(self, body: bytes, off: int, flags: int) -> int:
+        self.will = Will(qos=(flags >> 3) & 0x3,
+                         retain=bool(flags & 0x20))
         if self.v5:
-            self.properties, off = Properties.decode(body, off, PT.CONNECT)
-        self.client_id, off = read_string(body, off)
-        if will_flag:
-            self.will = Will(qos=will_qos, retain=will_retain)
-            if self.v5:
-                self.will.properties, off = Properties.decode(body, off, -1)
-            self.will.topic, off = read_string(body, off)
-            self.will.payload, off = read_binary(body, off)
-            if not self.will.topic:
-                raise ProtocolError(codes.ErrProtocolViolation, "empty will topic")
-        if self.username_flag:
-            self.username, off = read_binary(body, off)
-        if self.password_flag:
-            self.password, off = read_binary(body, off)
-        if off != len(body):
-            raise MalformedPacketError("trailing bytes after CONNECT payload")
+            self.will.properties, off = Properties.decode(body, off, -1)
+        self.will.topic, off = read_string(body, off)
+        self.will.payload, off = read_binary(body, off)
+        if not self.will.topic:
+            raise ProtocolError(codes.ErrProtocolViolation, "empty will topic")
+        return off
 
     def _dec_publish(self, body: bytes) -> None:
         off = 0
