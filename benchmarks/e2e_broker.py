@@ -123,6 +123,78 @@ async def run_fanout(host: str, port: int, subscribers: int,
             "wall_s": round(dt, 2)}
 
 
+async def run_matchbench(host: str, port: int, messages: int,
+                         real_subs: int, publishers: int) -> dict:
+    """The integrated-matcher scenario (VERDICT r2 #3): a broker whose
+    topic index also holds a large synthetic wildcard corpus, R real
+    subscribers, P publishers. Every publish pays a full corpus match
+    (trie walk or batched device match) before fan-out; deliveries and
+    publish->deliver latency are measured at the real clients."""
+    import struct
+
+    from maxmq_tpu.mqtt_client import MQTTClient
+
+    subs = []
+    for i in range(real_subs):
+        c = MQTTClient(client_id=f"mb-sub-{i}")
+        await c.connect(host, port)
+        await c.subscribe((f"mb/{i}/#", 0))
+        subs.append(c)
+
+    per_pub = messages // publishers
+    expect = {i: 0 for i in range(real_subs)}
+    for p in range(publishers):
+        for n in range(per_pub):
+            expect[(p * per_pub + n) % real_subs] += 1
+
+    lats: list[float] = []
+
+    async def drain(i: int, c: MQTTClient):
+        for _ in range(expect[i]):
+            m = await c.next_message(timeout=120)
+            lats.append(time.time() - struct.unpack(
+                "d", m.payload[:8])[0])
+
+    async def publish(p: int):
+        c = MQTTClient(client_id=f"mb-pub-{p}")
+        await c.connect(host, port)
+        for n in range(per_pub):
+            i = (p * per_pub + n) % real_subs
+            await c.publish(f"mb/{i}/x", struct.pack("d", time.time()))
+        await c.disconnect()
+
+    # warmup: trigger matcher compile/refresh outside the timed window
+    warm = MQTTClient(client_id="mb-warm")
+    await warm.connect(host, port)
+    await warm.subscribe(("mb/warm/#", 0))
+    for _ in range(3):
+        await warm.publish("mb/warm/x", b"\0" * 8)
+        try:
+            await warm.next_message(timeout=60)
+        except Exception:
+            pass
+        await asyncio.sleep(1.0)
+    await warm.disconnect()
+
+    t0 = time.perf_counter()
+    tasks = [asyncio.ensure_future(drain(i, c))
+             for i, c in enumerate(subs)]
+    await asyncio.gather(*(publish(p) for p in range(publishers)))
+    await asyncio.gather(*tasks)
+    dt = time.perf_counter() - t0
+    for c in subs:
+        await c.disconnect()
+    lats.sort()
+    n = len(lats)
+    return {
+        "deliveries": n,
+        "deliveries_per_sec": round(n / dt, 1),
+        "p50_ms": round(lats[n // 2] * 1e3, 2) if n else None,
+        "p99_ms": round(lats[(n * 99) // 100] * 1e3, 2) if n else None,
+        "wall_s": round(dt, 2),
+    }
+
+
 async def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--clients", type=int, default=2)
@@ -139,7 +211,22 @@ async def main() -> None:
     ap.add_argument("--host", default=None,
                     help="external broker host (default: in-process)")
     ap.add_argument("--port", type=int, default=1883)
+    ap.add_argument("--matchbench", type=int, default=0,
+                    help="N: corpus size for the integrated-matcher A/B "
+                         "scenario (synthetic wildcard corpus in the "
+                         "broker's index; see --matcher)")
+    ap.add_argument("--matcher", default="trie",
+                    choices=("trie", "sig"),
+                    help="matchbench broker engine: CPU trie or the "
+                         "batched signature matcher + MicroBatcher")
+    ap.add_argument("--real-subs", type=int, default=16)
+    ap.add_argument("--publishers", type=int, default=2)
     args = ap.parse_args()
+
+    if args.matchbench and args.host is not None:
+        ap.error("--matchbench requires the in-process broker (the "
+                 "synthetic corpus and matcher are preloaded into the "
+                 "spawned process); drop --host")
 
     broker = None
     host, port = args.host, args.port
@@ -148,6 +235,23 @@ async def main() -> None:
         # reference: client harness and broker do not share a scheduler)
         import subprocess
 
+        preload = ""
+        if args.matchbench:
+            preload = (
+                "    import bench as benchmod\n"
+                "    from maxmq_tpu.protocol.packets import Subscription\n"
+                f"    filters, _ = benchmod.build_corpus("
+                f"{args.matchbench})\n"
+                "    for i, f in enumerate(filters):\n"
+                "        b.topics.subscribe(f'syn-{i}', "
+                "Subscription(filter=f))\n")
+            if args.matcher == "sig":
+                preload += (
+                    "    from maxmq_tpu.matching.sig import SigEngine\n"
+                    "    from maxmq_tpu.matching.batcher import "
+                    "MicroBatcher\n"
+                    "    b.attach_matcher(MicroBatcher("
+                    "SigEngine(b.topics)))\n")
         script = (
             "import asyncio, sys\n"
             f"sys.path.insert(0, {REPO!r})\n"
@@ -158,6 +262,7 @@ async def main() -> None:
             "    b = Broker(BrokerOptions(capabilities=Capabilities("
             "sys_topic_interval=0)))\n"
             "    b.add_hook(AllowHook())\n"
+            + preload +
             "    lst = b.add_listener(TCPListener('bench', "
             "'127.0.0.1:0'))\n"
             "    await b.serve()\n"
@@ -171,6 +276,18 @@ async def main() -> None:
         port = int(broker.stdout.readline())
 
     payload = bytes(args.payload)
+    if args.matchbench:
+        mb = await run_matchbench(host, port, args.messages,
+                                  args.real_subs, args.publishers)
+        if broker is not None:
+            broker.terminate()
+            broker.wait(timeout=10)
+        print(json.dumps({
+            "metric": "e2e_broker_matchbench_deliveries_per_sec",
+            "corpus_subs": args.matchbench, "matcher": args.matcher,
+            "messages": args.messages, "real_subs": args.real_subs,
+            "publishers": args.publishers, **mb}))
+        return
     if args.fanout:
         fan = await run_fanout(host, port, args.fanout,
                                args.messages, payload)
