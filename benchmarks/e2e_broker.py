@@ -282,10 +282,11 @@ async def main() -> None:
         if broker is not None:
             broker.terminate()
             broker.wait(timeout=10)
+        sent = (args.messages // args.publishers) * args.publishers
         print(json.dumps({
             "metric": "e2e_broker_matchbench_deliveries_per_sec",
             "corpus_subs": args.matchbench, "matcher": args.matcher,
-            "messages": args.messages, "real_subs": args.real_subs,
+            "messages": sent, "real_subs": args.real_subs,
             "publishers": args.publishers, **mb}))
         return
     if args.fanout:

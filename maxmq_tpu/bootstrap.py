@@ -10,6 +10,7 @@ metrics and MQTT servers concurrently, waits for SIGINT/SIGTERM
 from __future__ import annotations
 
 import asyncio
+import os
 import signal
 
 from .broker import Broker, BrokerOptions, Capabilities, TCPListener
@@ -112,9 +113,11 @@ def build_broker(conf: Config, logger: Logger) -> Broker:
                  else SQLiteStore(conf.storage_path))
         broker.add_hook(StorageHook(store))
     if conf.mqtt_tcp_address:
-        broker.add_listener(TCPListener("tcp", conf.mqtt_tcp_address))
+        broker.add_listener(TCPListener("tcp", conf.mqtt_tcp_address,
+                                        reuse_port=conf.workers > 1))
     if conf.mqtt_ws_address:
-        broker.add_listener(WSListener("ws", conf.mqtt_ws_address))
+        broker.add_listener(WSListener("ws", conf.mqtt_ws_address,
+                                       reuse_port=conf.workers > 1))
     if conf.mqtt_unix_socket:
         broker.add_listener(UnixListener("unix", conf.mqtt_unix_socket))
     if conf.mqtt_sys_http_address:
@@ -154,6 +157,9 @@ async def run_server(conf: Config, logger: Logger,
     boot = logger.with_prefix("bootstrap")
     boot.debug("effective configuration", **config_as_dict(conf))
 
+    if await _maybe_run_pool(conf, logger, ready, stop):
+        return
+
     profiler = _start_profiling(conf)
 
     broker = build_broker(conf, logger)
@@ -189,6 +195,27 @@ async def run_server(conf: Config, logger: Logger,
         if profiler is not None:
             _stop_profiling(profiler, conf, boot)
         boot.info("server stopped")
+
+
+async def _maybe_run_pool(conf: Config, logger, ready, stop) -> bool:
+    """Delivery-worker pool (ADR 005): the parent runs the fan-out bus
+    and spawns SO_REUSEPORT workers; a worker subprocess re-enters
+    run_server with MAXMQ_WORKER_ID set and takes the worker branch."""
+    worker_id = os.environ.get("MAXMQ_WORKER_ID")
+    if worker_id is not None:
+        from .broker.workers import run_worker
+        pool_conf = os.environ.get("MAXMQ_POOL_CONF")
+        if pool_conf:
+            import json
+            conf = Config(**json.loads(pool_conf))
+        await run_worker(conf, logger, int(worker_id),
+                         os.environ["MAXMQ_BUS"], ready=ready, stop=stop)
+        return True
+    if conf.workers > 1:
+        from .broker.workers import run_pool
+        await run_pool(conf, logger, ready=ready, stop=stop)
+        return True
+    return False
 
 
 def _start_profiling(conf: Config):

@@ -52,9 +52,13 @@ class Listener:
 
 class TCPListener(Listener):
     def __init__(self, id_: str, address: str,
-                 tls: ssl_module.SSLContext | None = None) -> None:
+                 tls: ssl_module.SSLContext | None = None,
+                 reuse_port: bool = False) -> None:
         super().__init__(id_, address)
         self.tls = tls
+        # SO_REUSEPORT: the delivery-worker pool binds N processes to
+        # one port and lets the kernel shard accepts (ADR 005)
+        self.reuse_port = reuse_port
 
     @property
     def protocol(self) -> str:
@@ -68,7 +72,8 @@ class TCPListener(Listener):
             await establish(self.id, reader, writer)
 
         self._server = await asyncio.start_server(
-            handler, host or "0.0.0.0", int(port), ssl=self.tls)
+            handler, host or "0.0.0.0", int(port), ssl=self.tls,
+            reuse_port=self.reuse_port or None)
 
 
 class UnixListener(Listener):
@@ -154,9 +159,11 @@ class WSListener(Listener):
     bridges binary frames to the broker as a plain byte stream."""
 
     def __init__(self, id_: str, address: str,
-                 tls: ssl_module.SSLContext | None = None) -> None:
+                 tls: ssl_module.SSLContext | None = None,
+                 reuse_port: bool = False) -> None:
         super().__init__(id_, address)
         self.tls = tls
+        self.reuse_port = reuse_port   # worker-pool accept sharding
 
     @property
     def protocol(self) -> str:
@@ -184,7 +191,8 @@ class WSListener(Listener):
                 pump.cancel()
 
         self._server = await asyncio.start_server(
-            handler, host or "0.0.0.0", int(port), ssl=self.tls)
+            handler, host or "0.0.0.0", int(port), ssl=self.tls,
+            reuse_port=self.reuse_port or None)
 
     async def _handshake(self, reader, writer) -> str | None:
         request = await asyncio.wait_for(reader.readuntil(b"\r\n\r\n"), 10)

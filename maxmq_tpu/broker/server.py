@@ -458,6 +458,28 @@ class Broker:
         packet.topic = resolved
         packet.properties.topic_alias = None
 
+    def _select_subscribers(self, subscribers: SubscriberSet,
+                            packet: Packet) -> SubscriberSet:
+        """Run the on_select_subscribers modify chain without exposing
+        the (possibly cached) matched set to mutation. Hooks declaring
+        ``select_subscribers_shared_only`` (e.g. the worker-pool $share
+        ownership filter) only drop keys from the OUTER shared dict, so
+        shared-free publishes skip the per-record deep copy entirely and
+        shared ones get a shallow re-wrap."""
+        shared_only = all(
+            getattr(h, "select_subscribers_shared_only", False)
+            for h in self.hooks._overriders("on_select_subscribers"))
+        if shared_only and not subscribers.shared:
+            return subscribers
+        if shared_only:
+            sel = type(subscribers)(subscribers.subscriptions,
+                                    dict(subscribers.shared))
+            return self.hooks.modify("on_select_subscribers", sel, packet)
+        # matchers alias live Subscription records for speed; a hook may
+        # mutate both the set and its records, so it gets copies
+        return self.hooks.modify("on_select_subscribers",
+                                 subscribers.deep_copy(), packet)
+
     def _check_publish_qos(self, client: Client, packet: Packet) -> bool:
         """Capability limits + QoS2 dedup + receive quota; False means
         the packet was already re-acked (repeated QoS2 id)."""
@@ -549,10 +571,7 @@ class Broker:
         subscriber delivery. The trie path calls it directly so a QoS0
         publish costs no extra coroutine hop."""
         if self.hooks.overrides("on_select_subscribers"):
-            # matchers alias live Subscription records for speed; a hook
-            # may mutate both the set and its records, so it gets copies
-            subscribers = self.hooks.modify(
-                "on_select_subscribers", subscribers.deep_copy(), packet)
+            subscribers = self._select_subscribers(subscribers, packet)
 
         # $share: pick one member per (group, filter), merging per client
         selected: dict[str, Subscription] = {}

@@ -1,0 +1,471 @@
+"""Multi-core delivery: a pool of broker worker processes on one port.
+
+The reference gets per-connection parallelism for free — one goroutine
+per client spread over every host core (vendor/github.com/mochi-co/
+mqtt/v2/clients.go:190-202, server.go:221). An asyncio broker caps
+per-message work (decode, QoS bookkeeping, encode, socket writes) on a
+single core. This module is the goroutine answer (ADR 005):
+
+* N worker processes each run the FULL broker (codec, QoS state, fan-
+  out, matcher) for the connections the kernel hands them —
+  ``SO_REUSEPORT`` shards accepts across workers with no parent in the
+  accept path.
+* A loopback fan-out bus (unix domain stream hub, length-prefixed
+  frames) broadcasts every locally-published message to the other
+  workers, which deliver to THEIR local subscribers through their own
+  matcher. Retained messages ride the same frames, so every worker's
+  retained store converges (same-origin ordering is preserved by the
+  per-connection serialization, as in the single-process broker).
+* ``$share`` groups spanning workers stay exactly-once via membership
+  gossip: each worker broadcasts its (group, filter) local-member
+  counts on change; for every publish, the lowest-numbered worker with
+  members owns the pick (documented fairness trade in ADR 005).
+
+Scaling expectation: near-linear in delivery-bound workloads up to the
+host's core count (this dev box has ONE core, so the functional tests
+assert cross-worker semantics, not speedup — see ADR 005's measured
+section).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import struct
+import subprocess
+import sys
+import time
+
+from ..hooks.base import Hook
+from ..protocol.packets import Packet, parse_stream
+
+FRAME_PUBLISH = 1       # worker_id u8 + encoded v5 PUBLISH wire
+FRAME_MEMBERSHIP = 2    # json {w, members: [[group, filter, n], ...]}
+FRAME_TAKEOVER = 3      # json {w, cid}: session established elsewhere
+
+BUS_CLIENT_ID = "@bus"  # origin id carried by bus-injected publishes
+
+
+def _frame(ftype: int, payload: bytes) -> bytes:
+    return struct.pack(">IB", len(payload) + 1, ftype) + payload
+
+
+async def _read_frame(reader) -> tuple[int, bytes] | None:
+    try:
+        head = await reader.readexactly(5)
+    except (asyncio.IncompleteReadError, ConnectionError):
+        return None
+    length, ftype = struct.unpack(">IB", head)
+    try:
+        payload = await reader.readexactly(length - 1)
+    except (asyncio.IncompleteReadError, ConnectionError):
+        return None
+    return ftype, payload
+
+
+class FanoutBus:
+    """The hub: accepts worker connections on a unix socket and
+    broadcasts every frame to all OTHER workers. The hub carries only
+    already-encoded bytes — it never parses MQTT.
+
+    A peer whose transport buffer exceeds ``high_water`` is evicted
+    (its worker reconnects on its own schedule): a wedged worker must
+    not grow the hub's memory by the whole publish stream."""
+
+    def __init__(self, path: str, high_water: int = 8 << 20) -> None:
+        self.path = path
+        self.high_water = high_water
+        self._server = None
+        self._peers: dict[object, asyncio.StreamWriter] = {}
+
+    async def start(self) -> None:
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+        self._server = await asyncio.start_unix_server(self._serve,
+                                                       self.path)
+
+    async def _serve(self, reader, writer) -> None:
+        key = object()
+        self._peers[key] = writer
+        try:
+            while True:
+                frame = await _read_frame(reader)
+                if frame is None:
+                    break
+                ftype, payload = frame
+                data = _frame(ftype, payload)
+                for k, w in list(self._peers.items()):
+                    if k is key:
+                        continue
+                    try:
+                        if (w.transport.get_write_buffer_size()
+                                > self.high_water):
+                            raise BufferError("peer stalled")
+                        w.write(data)
+                    except Exception:
+                        self._peers.pop(k, None)
+                        try:
+                            w.close()
+                        except Exception:
+                            pass
+        finally:
+            self._peers.pop(key, None)
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for w in self._peers.values():
+            try:
+                w.close()
+            except Exception:
+                pass
+        self._peers.clear()
+
+
+class BusHook(Hook):
+    """Worker-side bus endpoint, wired into the broker's hook chain.
+
+    Outbound: every locally-published message (and every will/retained
+    publish, which flow through the same fan-out) is forwarded once.
+    Inbound: frames are injected through the broker's inline-client
+    path, so retained storage, expiry, and local fan-out behave exactly
+    as for a locally received publish.
+    """
+
+    id = "bus"
+
+    def __init__(self, worker_id: int, bus_path: str) -> None:
+        self.worker_id = worker_id
+        self.bus_path = bus_path
+        self.broker = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._reader_task: asyncio.Task | None = None
+        # (group, filter) -> {worker_id: count}; local counts gossiped
+        self.members: dict[tuple[str, str], dict[int, int]] = {}
+        self._local: dict[tuple[str, str], int] = {}
+        # client id -> its live $share keys (incremental maintenance)
+        self._contrib: dict[str, set[tuple[str, str]]] = {}
+        self.on_bus_lost = None      # callback: bus EOF -> shut down
+
+    # -- lifecycle ----------------------------------------------------
+
+    async def attach(self, broker) -> None:
+        self.broker = broker
+        reader, self._writer = await asyncio.open_unix_connection(
+            self.bus_path)
+        self._bus_client = broker.new_inline_client(BUS_CLIENT_ID)
+        self._reader_task = asyncio.get_running_loop().create_task(
+            self._drain(reader))
+
+    def announce(self) -> None:
+        """Initial gossip after the broker is serving (storage restore
+        may have loaded sessions): peers learn our state — possibly
+        empty, which clears anything stale from a previous incarnation
+        of this worker id."""
+        for client in self.broker.clients.connected():
+            self._update_contrib(client)
+        self._gossip()
+
+    def stop(self) -> None:
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+
+    async def _drain(self, reader) -> None:
+        while True:
+            frame = await _read_frame(reader)
+            if frame is None:
+                # bus gone (parent died or evicted us): a worker serving
+                # without the bus is split-brained — shut down so the
+                # supervisor restarts the pool coherently
+                if self.on_bus_lost is not None:
+                    self.on_bus_lost()
+                return
+            ftype, payload = frame
+            try:
+                if ftype == FRAME_PUBLISH:
+                    await self._inject_publish(payload)
+                elif ftype == FRAME_MEMBERSHIP:
+                    self._absorb_membership(payload)
+                elif ftype == FRAME_TAKEOVER:
+                    await self._absorb_takeover(payload)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # one bad frame must not kill the bus
+                log = getattr(self.broker, "log", None)
+                if log is not None:
+                    log.with_prefix("bus").error("bus frame failed",
+                                                 error=repr(exc))
+
+    # -- publish forwarding -------------------------------------------
+
+    def on_published(self, client, packet: Packet) -> None:
+        if client is not None and client.id == BUS_CLIENT_ID:
+            return                       # arrived from the bus: no loop
+        self._forward(packet)
+
+    def on_will_sent(self, client, packet: Packet) -> None:
+        self._forward(packet)            # wills fan out pool-wide too
+
+    def _forward(self, packet: Packet) -> None:
+        if self._writer is None or packet.topic.startswith("$"):
+            return                       # $SYS stays per-worker (ADR 005)
+        wire = self._encode_for_bus(packet)
+        self._writer.write(_frame(
+            FRAME_PUBLISH, bytes([self.worker_id]) + wire))
+
+    @staticmethod
+    def _encode_for_bus(packet: Packet) -> bytes:
+        out = packet.copy()
+        out.protocol_version = 5
+        # a qos>0 wire needs a nonzero pid; the receiving workers
+        # allocate real per-client pids at delivery, this one is unused
+        out.packet_id = 1 if packet.fixed.qos else 0
+        out.fixed.dup = False
+        return out.encode()
+
+    async def _inject_publish(self, payload: bytes) -> None:
+        buf = bytearray(payload[1:])
+        for fh, body in parse_stream(buf):
+            packet = Packet.decode(fh, body, 5)
+            # inline clients skip the per-client QoS inbound machinery;
+            # delivery QoS still derives from min(sub.qos, msg qos)
+            packet.origin = BUS_CLIENT_ID
+            packet.created = time.time()
+            if packet.fixed.retain:
+                self.broker.retain_message(self._bus_client, packet)
+            await self.broker.publish_to_subscribers(packet)
+
+    # -- $share ownership gossip --------------------------------------
+    #
+    # counts track LIVE members only (a worker whose members are all
+    # offline must not own the pick — the alive-filter would drop the
+    # message pool-wide), maintained incrementally per client event:
+    # each event re-derives only THAT client's contribution, O(its
+    # subscriptions), never a full index scan.
+
+    def on_subscribed(self, client, packet, reason_codes, counts) -> None:
+        self._update_contrib(client)
+
+    def on_unsubscribed(self, client, packet) -> None:
+        self._update_contrib(client)
+
+    def on_disconnect(self, client, err, expire: bool) -> None:
+        self._update_contrib(client, live=False)
+
+    def on_session_established(self, client, packet) -> None:
+        # resumed sessions restore their subscriptions (live again); a
+        # fresh session contributes nothing yet, but the takeover frame
+        # must fire either way so no other worker keeps the old live
+        # session for this id
+        self._update_contrib(client)
+        if self._writer is not None:
+            self._writer.write(_frame(FRAME_TAKEOVER, json.dumps({
+                "w": self.worker_id, "cid": client.id}).encode()))
+
+    @staticmethod
+    def _client_shared(client) -> set[tuple[str, str]]:
+        out = set()
+        for filt in client.subscriptions:
+            if filt.startswith("$share/"):
+                _, group, _ = (filt.split("/", 2) + [""])[:3]
+                out.add((group, filt))
+        return out
+
+    def _update_contrib(self, client, live: bool = True) -> None:
+        if client is None or client.id == BUS_CLIENT_ID:
+            return
+        new = self._client_shared(client) if live else set()
+        old = self._contrib.get(client.id, set())
+        if new == old:
+            return
+        if new:
+            self._contrib[client.id] = new
+        else:
+            self._contrib.pop(client.id, None)
+        for key in old - new:
+            n = self._local.get(key, 0) - 1
+            if n > 0:
+                self._local[key] = n
+            else:
+                self._local.pop(key, None)
+        for key in new - old:
+            self._local[key] = self._local.get(key, 0) + 1
+        self._gossip()
+
+    def _gossip(self) -> None:
+        if self._writer is None:
+            return
+        # keep our own view coherent too (we never hear our own gossip)
+        for key in set(self._local) | {k for k, v in self.members.items()
+                                       if self.worker_id in v}:
+            self.members.setdefault(key, {})[self.worker_id] = \
+                self._local.get(key, 0)
+        self._writer.write(_frame(FRAME_MEMBERSHIP, json.dumps({
+            "w": self.worker_id,
+            "members": [[g, f, n] for (g, f), n in self._local.items()],
+        }).encode()))
+
+    async def _absorb_takeover(self, payload: bytes) -> None:
+        """Another worker established a session for this client id: any
+        live local session with that id is taken over [MQTT-3.1.4-2]."""
+        from ..protocol import codes
+        from ..protocol.packets import ProtocolError
+
+        msg = json.loads(payload)
+        client = self.broker.clients.get(msg["cid"])
+        if client is None or client.closed:
+            return
+        client.taken_over = True
+        self.broker.disconnect_client(client, codes.ErrSessionTakenOver)
+        await client.stop(ProtocolError(codes.ErrSessionTakenOver))
+
+    def _absorb_membership(self, payload: bytes) -> None:
+        msg = json.loads(payload)
+        w = int(msg["w"])
+        seen = set()
+        for g, f, n in msg["members"]:
+            self.members.setdefault((g, f), {})[w] = int(n)
+            seen.add((g, f))
+        for key, per in self.members.items():
+            if key not in seen:
+                per.pop(w, None)
+
+    def _owns(self, group: str, filt: str) -> bool:
+        per = self.members.get((group, filt))
+        workers = sorted(w for w, n in (per or {}).items() if n > 0)
+        if not workers:
+            # no gossip yet: the origin worker delivers (safe default —
+            # at worst a short double-delivery window at startup)
+            return True
+        return workers[0] == self.worker_id
+
+    # declares that on_select_subscribers only drops keys from the
+    # outer ``shared`` dict, letting the broker skip the per-record
+    # deep copy on shared-free publishes (the hot path)
+    select_subscribers_shared_only = True
+
+    def on_select_subscribers(self, subscribers, packet):
+        if not subscribers.shared:
+            return subscribers
+        drop = [key for key in subscribers.shared
+                if not self._owns(*key)]
+        if drop:
+            for key in drop:
+                del subscribers.shared[key]
+        return subscribers
+
+
+async def run_worker(conf, logger, worker_id: int, bus_path: str,
+                     ready: asyncio.Event | None = None,
+                     stop: asyncio.Event | None = None) -> None:
+    """One pool worker: the standard bootstrap broker + BusHook, with
+    the TCP listener bound SO_REUSEPORT (build_broker does that when
+    conf.workers > 1)."""
+    import dataclasses
+
+    from ..bootstrap import build_broker, build_metrics
+
+    if worker_id != 0:
+        # SO_REUSEPORT shards the TCP/WS listeners; the unix-socket and
+        # $SYS-HTTP listeners (and metrics) cannot share an address, so
+        # worker 0 owns them
+        conf = dataclasses.replace(conf, mqtt_unix_socket="",
+                                   mqtt_sys_http_address="")
+    broker = build_broker(conf, logger)
+    hook = BusHook(worker_id, bus_path)
+    broker.add_hook(hook)
+    metrics = build_metrics(conf, broker, logger) if worker_id == 0 else None
+    # bus first, listeners second: a client accepted before the bus is
+    # connected would publish into a void
+    await hook.attach(broker)
+    await broker.serve()
+    hook.announce()
+    if metrics is not None:
+        metrics.start()
+    logger.with_prefix("worker").info("pool worker started",
+                                      worker=worker_id)
+    if ready is not None:
+        ready.set()
+    if stop is None:
+        stop = asyncio.Event()
+        import signal
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:
+                pass
+    hook.on_bus_lost = stop.set      # parent died: don't serve split-brained
+    try:
+        await stop.wait()
+    finally:
+        hook.stop()
+        await broker.close()
+        if metrics is not None:
+            metrics.stop()
+
+
+async def run_pool(conf, logger, ready: asyncio.Event | None = None,
+                   stop: asyncio.Event | None = None) -> None:
+    """The pool parent: fan-out bus + N worker subprocesses. The parent
+    never touches a client socket — the kernel (SO_REUSEPORT) shards
+    accepts directly onto the workers."""
+    from ..utils.config import config_as_dict
+
+    boot = logger.with_prefix("pool")
+    bus_path = f"/tmp/maxmq-bus-{os.getpid()}.sock"
+    bus = FanoutBus(bus_path)
+    await bus.start()
+
+    env = dict(os.environ)
+    env["MAXMQ_BUS"] = bus_path
+    env["MAXMQ_POOL_CONF"] = json.dumps(config_as_dict(conf))
+    procs = []
+    for i in range(conf.workers):
+        wenv = dict(env)
+        wenv["MAXMQ_WORKER_ID"] = str(i)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "maxmq_tpu", "start", "--no-banner"],
+            env=wenv))
+    boot.info("worker pool started", workers=conf.workers,
+              bus=bus_path, tcp=conf.mqtt_tcp_address)
+    if ready is not None:
+        ready.set()
+    if stop is None:
+        stop = asyncio.Event()
+        import signal
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:
+                pass
+    try:
+        await stop.wait()
+    finally:
+        boot.info("shutting down worker pool")
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        await bus.close()
+        try:
+            os.unlink(bus_path)
+        except FileNotFoundError:
+            pass

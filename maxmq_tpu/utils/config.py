@@ -30,6 +30,8 @@ class Config:
     metrics_profiling: bool = False
 
     # -- broker listeners ---------------------------------------------------
+    workers: int = 0                    # >1: SO_REUSEPORT delivery-worker
+                                        # pool + fan-out bus (ADR 005)
     mqtt_tcp_address: str = ":1883"
     mqtt_ws_address: str = ""           # optional websocket listener
     mqtt_unix_socket: str = ""          # optional unix-socket listener

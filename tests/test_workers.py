@@ -1,0 +1,166 @@
+"""Delivery-worker pool (ADR 005): cross-worker semantics.
+
+This box has one core, so these tests assert CORRECTNESS of the
+SO_REUSEPORT pool + fan-out bus (cross-worker delivery, retained
+convergence, $share exactly-once), not speedup. The pool runs
+in-process here: two Broker instances on distinct loopback ports wired
+to one FanoutBus — the same objects the subprocess pool runs, minus the
+process boundary, which only the kernel's accept sharding cares about.
+"""
+
+import asyncio
+import contextlib
+import os
+
+from maxmq_tpu.broker import Broker, BrokerOptions, Capabilities, TCPListener
+from maxmq_tpu.broker.workers import BusHook, FanoutBus
+from maxmq_tpu.hooks import AllowHook
+from maxmq_tpu.mqtt_client import MQTTClient
+
+
+@contextlib.asynccontextmanager
+async def running_pool(n: int = 2):
+    bus_path = f"/tmp/maxmq-test-bus-{os.getpid()}.sock"
+    bus = FanoutBus(bus_path)
+    await bus.start()
+    brokers, hooks, ports = [], [], []
+    for i in range(n):
+        b = Broker(BrokerOptions(capabilities=Capabilities(
+            sys_topic_interval=0)))
+        b.add_hook(AllowHook())
+        hook = BusHook(i, bus_path)
+        b.add_hook(hook)
+        lst = b.add_listener(TCPListener(f"tcp{i}", "127.0.0.1:0"))
+        await b.serve()
+        await hook.attach(b)
+        brokers.append(b)
+        hooks.append(hook)
+        ports.append(lst._server.sockets[0].getsockname()[1])
+    try:
+        yield brokers, ports
+    finally:
+        for h in hooks:
+            h.stop()
+        for b in brokers:
+            await b.close()
+        await bus.close()
+        with contextlib.suppress(FileNotFoundError):
+            os.unlink(bus_path)
+
+
+async def test_cross_worker_delivery():
+    async with running_pool(2) as (_brokers, ports):
+        sub = MQTTClient("w-sub")
+        await sub.connect("127.0.0.1", ports[0])
+        await sub.subscribe("pool/+/x")
+        pub = MQTTClient("w-pub")
+        await pub.connect("127.0.0.1", ports[1])   # OTHER worker
+        await pub.publish("pool/a/x", b"crossed")
+        m = await sub.next_message(5)
+        assert m.payload == b"crossed"
+        # reverse direction too
+        sub2 = MQTTClient("w-sub2")
+        await sub2.connect("127.0.0.1", ports[1])
+        await sub2.subscribe("pool/#")
+        pub2 = MQTTClient("w-pub2")
+        await pub2.connect("127.0.0.1", ports[0])
+        await pub2.publish("pool/b/x", b"back")
+        m = await sub2.next_message(5)
+        assert m.payload == b"back"
+        for c in (sub, sub2, pub, pub2):
+            await c.disconnect()
+
+
+async def test_retained_converges_across_workers():
+    async with running_pool(2) as (_brokers, ports):
+        pub = MQTTClient("r-pub")
+        await pub.connect("127.0.0.1", ports[0])
+        await pub.publish("pool/ret/x", b"kept", retain=True)
+        await asyncio.sleep(0.1)       # bus propagation
+        fresh = MQTTClient("r-fresh")
+        await fresh.connect("127.0.0.1", ports[1])   # OTHER worker
+        await fresh.subscribe("pool/ret/#")
+        m = await fresh.next_message(5)
+        assert m.payload == b"kept" and m.retain
+        await pub.disconnect()
+        await fresh.disconnect()
+
+
+async def test_shared_group_exactly_once_across_workers():
+    async with running_pool(2) as (_brokers, ports):
+        m0 = MQTTClient("s-m0")
+        await m0.connect("127.0.0.1", ports[0])
+        await m0.subscribe("$share/g/pool/sh", qos=0)
+        m1 = MQTTClient("s-m1")
+        await m1.connect("127.0.0.1", ports[1])
+        await m1.subscribe("$share/g/pool/sh", qos=0)
+        await asyncio.sleep(0.15)      # membership gossip settles
+        pub = MQTTClient("s-pub")
+        await pub.connect("127.0.0.1", ports[1])
+        n = 10
+        for i in range(n):
+            await pub.publish("pool/sh", f"m{i}".encode())
+        await asyncio.sleep(0.5)
+        got0, got1 = m0.messages.qsize(), m1.messages.qsize()
+        # exactly-once globally: every message delivered to exactly one
+        # group member across the whole pool
+        assert got0 + got1 == n, (got0, got1)
+        for c in (m0, m1, pub):
+            await c.disconnect()
+
+
+async def test_cross_worker_takeover():
+    # [MQTT-3.1.4-2]: a session established on worker 1 must terminate
+    # a live session with the same client id on worker 0
+    async with running_pool(2) as (brokers, ports):
+        first = MQTTClient("dup-id")
+        await first.connect("127.0.0.1", ports[0])
+        second = MQTTClient("dup-id")
+        await second.connect("127.0.0.1", ports[1])
+        await first.wait_closed(timeout=5)   # old session taken over
+        old = brokers[0].clients.get("dup-id")
+        assert old is None or old.closed or old.taken_over
+        await second.ping()                  # new session healthy
+        await second.disconnect()
+
+
+async def test_shared_owner_skips_offline_members():
+    # a worker whose only group member went offline must cede ownership
+    # so the live member on the other worker still receives
+    async with running_pool(2) as (_brokers, ports):
+        m0 = MQTTClient("so-m0", clean_start=False, session_expiry=300,
+                        version=5)
+        await m0.connect("127.0.0.1", ports[0])
+        await m0.subscribe("$share/g/pool/so", qos=0)
+        m1 = MQTTClient("so-m1")
+        await m1.connect("127.0.0.1", ports[1])
+        await m1.subscribe("$share/g/pool/so", qos=0)
+        await asyncio.sleep(0.15)
+        await m0.close()                     # offline; session persists
+        await asyncio.sleep(0.15)            # liveness gossip settles
+        pub = MQTTClient("so-pub")
+        await pub.connect("127.0.0.1", ports[0])
+        for i in range(5):
+            await pub.publish("pool/so", f"m{i}".encode())
+        got = 0
+        for _ in range(5):
+            await m1.next_message(5)
+            got += 1
+        assert got == 5                      # live member got them all
+        await m1.disconnect()
+        await pub.disconnect()
+
+
+async def test_qos1_delivery_across_workers():
+    async with running_pool(2) as (_brokers, ports):
+        sub = MQTTClient("q-sub")
+        await sub.connect("127.0.0.1", ports[0])
+        await sub.subscribe(("pool/q1", 1))
+        pub = MQTTClient("q-pub")
+        await pub.connect("127.0.0.1", ports[1])
+        await pub.publish("pool/q1", b"ackd", qos=1)
+        m = await sub.next_message(5)
+        assert m.payload == b"ackd"
+        assert m.qos == 1
+        await sub.disconnect()
+        await pub.disconnect()
