@@ -506,14 +506,29 @@ def main() -> None:
         sys.stdout.flush()
         os._exit(2)
 
+    subproc_child = os.environ.get("MAXMQ_BENCH_SUBPROC") == "1"
     if want != "cpu":
-        attempts = int(os.environ.get("MAXMQ_BENCH_RETRIES", "3"))
+        attempts = (1 if subproc_child else
+                    int(os.environ.get("MAXMQ_BENCH_RETRIES", "3")))
         backend, err = probe_backend(
             attempts, backend_timeout,
             wait_s=float(os.environ.get("MAXMQ_BENCH_RETRY_WAIT", "60")))
         if backend is None:
             log("[probe] giving up; capturing CPU sanity rows")
-            fail({"error": err, "cpu_sanity": cpu_sanity_rows()})
+            fail({"error": err,
+                  **({} if subproc_child else
+                     {"cpu_sanity": cpu_sanity_rows()})})
+
+    supervise = ((want != "cpu" and len(which) > 1)
+                 or os.environ.get("MAXMQ_BENCH_SUPERVISE") == "1")
+    if supervise and not subproc_child:
+        # supervisor mode: the tunnel is known to wedge MID-RUN, not
+        # just at init (second r03 capture died inside config 4 after
+        # three good rows) — so every config runs in its own subprocess
+        # with its own deadline, and a wedge costs ONE row, never the
+        # whole artifact
+        run_supervised(which)
+        return
 
     ready = threading.Event()
     init_error: list = []
@@ -591,6 +606,12 @@ def main() -> None:
     link = link_box[0] if link_box else {"error":
                                          "link probe timed out (60s)"}
 
+    print(json.dumps(assemble_result(
+        configs, link, jax.default_backend(), len(jax.devices()))))
+
+
+def assemble_result(configs: list, link: dict, backend_name: str,
+                    n_devices: int) -> dict:
     headline = next((c for c in configs
                      if c.get("config") == "iot_1m_share"
                      and "matches_per_sec" in c), None)
@@ -598,7 +619,7 @@ def main() -> None:
         headline = next((c for c in configs
                          if "matches_per_sec" in c), {})
     rate = headline.get("matches_per_sec", 0.0)
-    result = {
+    return {
         "metric": "wildcard_topic_matches_per_sec_"
                   + headline.get("config", "none"),
         "value": rate,
@@ -613,16 +634,83 @@ def main() -> None:
                     "subs-sharding scales ~linearly; measured "
                     "multi-device parity runs on the CPU mesh "
                     "(config 5)"}
-               if jax.default_backend() == "tpu" else {}),
-            "backend": jax.default_backend(),
-            "devices": len(jax.devices()),
+               if backend_name == "tpu" else {}),
+            "backend": backend_name,
+            "devices": n_devices,
             "link": link,
             "boundary": "decode-inclusive (merged SubscriberSets, the "
                         "reference's Subscribers() boundary)",
             "configs": configs,
         },
     }
-    print(json.dumps(result))
+
+
+# per-config wall-clock deadlines for supervisor mode (seconds):
+# corpus build + compile + measurement, with generous headroom — a
+# config that blows its deadline is recorded as wedged, not waited on
+CONFIG_DEADLINES = {"1": 900, "2": 900, "3": 1200, "4": 2400,
+                    "lat": 900, "5": 1200}
+
+
+def run_supervised(which: list[str]) -> None:
+    configs: list = []
+    backend_name = None        # only what a child actually reported
+    n_devices = 0
+    keys = [k for k in which if k]
+    log(f"[supervisor] per-config subprocess isolation: {keys}")
+    for key in keys:
+        deadline = float(os.environ.get(
+            "MAXMQ_BENCH_CONFIG_TIMEOUT", CONFIG_DEADLINES.get(key, 1200)))
+        log(f"[supervisor] config {key} (deadline {deadline:.0f}s)")
+        env = dict(os.environ)
+        env.update(MAXMQ_BENCH_CONFIGS=key, MAXMQ_BENCH_SUBPROC="1")
+        t0 = time.perf_counter()
+        try:
+            p = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                               env=env, capture_output=True, text=True,
+                               timeout=deadline)
+            sys.stderr.write(p.stderr)
+            child = json.loads(p.stdout.strip().splitlines()[-1])
+            rows = child.get("detail", {}).get("configs", [])
+            backend_name = child.get("detail", {}).get("backend",
+                                                       backend_name)
+            n_devices = max(n_devices,
+                            child.get("detail", {}).get("devices", 0))
+            if rows:
+                configs.extend(rows)
+            else:
+                configs.append({"config": key,
+                                "error": child.get("detail", {}).get(
+                                    "error", "no rows")[:300]})
+        except subprocess.TimeoutExpired as exc:
+            # a mid-run tunnel wedge: record it, keep the other rows
+            tail = (exc.stderr or b"")
+            if isinstance(tail, bytes):
+                tail = tail.decode(errors="replace")
+            log(f"[supervisor] config {key} wedged after "
+                f"{time.perf_counter() - t0:.0f}s; continuing")
+            configs.append({
+                "config": key,
+                "error": f"config subprocess exceeded {deadline:.0f}s "
+                         "(accelerator wedge?); partial stderr: "
+                         + tail[-200:]})
+        except Exception as exc:
+            configs.append({"config": key, "error": repr(exc)[:300]})
+
+    # link probe in a deadline-bounded subprocess too
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import json, bench; print(json.dumps(bench.link_probe()))"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=120)
+        link = json.loads(p.stdout.strip().splitlines()[-1])
+    except Exception as exc:
+        link = {"error": f"link probe subprocess: {exc!r}"[:300]}
+
+    print(json.dumps(assemble_result(
+        configs, link, backend_name or "unreported",
+        n_devices or 1)))
 
 
 if __name__ == "__main__":

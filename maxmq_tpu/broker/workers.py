@@ -69,9 +69,10 @@ class FanoutBus:
     broadcasts every frame to all OTHER workers. The hub carries only
     already-encoded bytes — it never parses MQTT.
 
-    A peer whose transport buffer exceeds ``high_water`` is evicted
-    (its worker reconnects on its own schedule): a wedged worker must
-    not grow the hub's memory by the whole publish stream."""
+    A peer whose transport buffer exceeds ``high_water`` is evicted — a
+    wedged worker must not grow the hub's memory by the whole publish
+    stream. The evicted worker sees bus EOF, exits (split-brain guard),
+    and the pool parent's supervision loop respawns it."""
 
     def __init__(self, path: str, high_water: int = 8 << 20) -> None:
         self.path = path
@@ -154,6 +155,7 @@ class BusHook(Hook):
         # client id -> its live $share keys (incremental maintenance)
         self._contrib: dict[str, set[tuple[str, str]]] = {}
         self.on_bus_lost = None      # callback: bus EOF -> shut down
+        self.bus_lost = False        # latched for pre-wiring EOFs
 
     # -- lifecycle ----------------------------------------------------
 
@@ -189,7 +191,10 @@ class BusHook(Hook):
             if frame is None:
                 # bus gone (parent died or evicted us): a worker serving
                 # without the bus is split-brained — shut down so the
-                # supervisor restarts the pool coherently
+                # parent restarts us coherently. Latched so an EOF that
+                # lands before run_worker wires the callback still stops
+                # the worker.
+                self.bus_lost = True
                 if self.on_bus_lost is not None:
                     self.on_bus_lost()
                 return
@@ -317,6 +322,9 @@ class BusHook(Hook):
             "w": self.worker_id,
             "members": [[g, f, n] for (g, f), n in self._local.items()],
         }).encode()))
+        for key in [k for k, per in self.members.items()
+                    if not any(per.values())]:
+            del self.members[key]
 
     async def _absorb_takeover(self, payload: bytes) -> None:
         """Another worker established a session for this client id: any
@@ -339,9 +347,14 @@ class BusHook(Hook):
         for g, f, n in msg["members"]:
             self.members.setdefault((g, f), {})[w] = int(n)
             seen.add((g, f))
+        dead = []
         for key, per in self.members.items():
             if key not in seen:
                 per.pop(w, None)
+            if not per or not any(per.values()):
+                dead.append(key)       # churned-away groups must not
+        for key in dead:               # accumulate forever
+            del self.members[key]
 
     def _owns(self, group: str, filt: str) -> bool:
         per = self.members.get((group, filt))
@@ -409,6 +422,8 @@ async def run_worker(conf, logger, worker_id: int, bus_path: str,
             except NotImplementedError:
                 pass
     hook.on_bus_lost = stop.set      # parent died: don't serve split-brained
+    if hook.bus_lost:
+        stop.set()                   # EOF landed before the wiring
     try:
         await stop.wait()
     finally:
@@ -416,6 +431,26 @@ async def run_worker(conf, logger, worker_id: int, bus_path: str,
         await broker.close()
         if metrics is not None:
             metrics.stop()
+
+
+async def _supervise_workers(procs, spawn, boot) -> None:
+    """A worker that dies (crash, bus eviction, OOM kill) is logged and
+    respawned — the pool must not silently degrade to N-1. Throttled
+    per slot so a crash loop can't fork-bomb the host."""
+    last_spawn = [0.0] * len(procs)
+    while True:
+        await asyncio.sleep(2.0)
+        for i, p in enumerate(procs):
+            rc = p.poll()
+            if rc is None:
+                continue
+            wait = max(0.0, 5.0 - (time.monotonic() - last_spawn[i]))
+            boot.error("pool worker exited; restarting", worker=i,
+                       rc=rc, backoff_s=round(wait, 1))
+            if wait:
+                await asyncio.sleep(wait)
+            last_spawn[i] = time.monotonic()
+            procs[i] = spawn(i)
 
 
 async def run_pool(conf, logger, ready: asyncio.Event | None = None,
@@ -433,13 +468,15 @@ async def run_pool(conf, logger, ready: asyncio.Event | None = None,
     env = dict(os.environ)
     env["MAXMQ_BUS"] = bus_path
     env["MAXMQ_POOL_CONF"] = json.dumps(config_as_dict(conf))
-    procs = []
-    for i in range(conf.workers):
+
+    def spawn(i: int):
         wenv = dict(env)
         wenv["MAXMQ_WORKER_ID"] = str(i)
-        procs.append(subprocess.Popen(
+        return subprocess.Popen(
             [sys.executable, "-m", "maxmq_tpu", "start", "--no-banner"],
-            env=wenv))
+            env=wenv)
+
+    procs = [spawn(i) for i in range(conf.workers)]
     boot.info("worker pool started", workers=conf.workers,
               bus=bus_path, tcp=conf.mqtt_tcp_address)
     if ready is not None:
@@ -453,9 +490,13 @@ async def run_pool(conf, logger, ready: asyncio.Event | None = None,
                 loop.add_signal_handler(sig, stop.set)
             except NotImplementedError:
                 pass
+
+    watcher = asyncio.get_running_loop().create_task(
+        _supervise_workers(procs, spawn, boot))
     try:
         await stop.wait()
     finally:
+        watcher.cancel()
         boot.info("shutting down worker pool")
         for p in procs:
             p.terminate()
