@@ -130,15 +130,27 @@ async def run_matchbench(host: str, port: int, messages: int,
     subscribers, P publishers. Every publish pays a full corpus match
     (trie walk or batched device match) before fan-out; deliveries and
     publish->deliver latency are measured at the real clients."""
+    import random
     import struct
 
     from maxmq_tpu.mqtt_client import MQTTClient
+
+    # publish topics live in the synthetic corpus's OWN alphabet (the
+    # bench.build_corpus symbol set), with distinct publish topics, so
+    # every publish pays a real full-corpus match — a disjoint topic
+    # prefix would let the trie prune at the root and measure nothing
+    alphabet = [f"{c}{i}" for c in "abcdefgh" for i in range(12)]
+    rng = random.Random(17)
+
+    def topic_for(i: int) -> str:
+        return "/".join([alphabet[i % len(alphabet)]] + [
+            rng.choice(alphabet) for _ in range(rng.randint(2, 6))])
 
     subs = []
     for i in range(real_subs):
         c = MQTTClient(client_id=f"mb-sub-{i}")
         await c.connect(host, port)
-        await c.subscribe((f"mb/{i}/#", 0))
+        await c.subscribe((f"{alphabet[i % len(alphabet)]}/#", 0))
         subs.append(c)
 
     per_pub = messages // publishers
@@ -160,15 +172,15 @@ async def run_matchbench(host: str, port: int, messages: int,
         await c.connect(host, port)
         for n in range(per_pub):
             i = (p * per_pub + n) % real_subs
-            await c.publish(f"mb/{i}/x", struct.pack("d", time.time()))
+            await c.publish(topic_for(i), struct.pack("d", time.time()))
         await c.disconnect()
 
     # warmup: trigger matcher compile/refresh outside the timed window
     warm = MQTTClient(client_id="mb-warm")
     await warm.connect(host, port)
-    await warm.subscribe(("mb/warm/#", 0))
+    await warm.subscribe((f"{alphabet[0]}/#", 0))
     for _ in range(3):
-        await warm.publish("mb/warm/x", b"\0" * 8)
+        await warm.publish(topic_for(0), b"\0" * 8)
         try:
             await warm.next_message(timeout=60)
         except Exception:
