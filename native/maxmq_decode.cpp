@@ -329,14 +329,16 @@ struct DecodeTable {
   PyObject *cids;       // list len A: client-id str
   PyObject *subs;       // list len A: Subscription
   PyObject *cache;      // verified-row-set bytes -> SubscriberSet
+  Py_ssize_t cache_pairs = 0;  // total subscriber entries cached
   std::vector<PyObject *> key, cid, sub;  // borrowed from the lists
   Py_ssize_t R, W, A;
 };
 
-// The row-set result cache is bounded; past this the whole dict is
-// dropped (the table itself rotates on every subscription change, so a
-// long-lived broker can't grow it unboundedly either way).
-constexpr Py_ssize_t kDecodeCacheCap = 1 << 17;
+// The row-set result cache is bounded by the TOTAL subscriber entries
+// it holds (hot corpora cache few, fat sets — a per-key cap would let
+// 100K x 400-entry sets grow to GBs); past this the whole dict is
+// dropped. The table rotates on every subscription change anyway.
+constexpr Py_ssize_t kDecodeCachePairsCap = 4 << 20;
 
 void table_destroy(PyObject *capsule) {
   auto *t = static_cast<DecodeTable *>(
@@ -531,13 +533,21 @@ PyObject *cached_rowset_result(DecodeTable *t, const int32_t *rows,
       return nullptr;
     }
   }
-  if (PyDict_GET_SIZE(t->cache) >= kDecodeCacheCap) PyDict_Clear(t->cache);
+  Py_ssize_t pairs = PyDict_GET_SIZE(res->subscriptions);
+  PyObject *gk, *gv;
+  for (Py_ssize_t pos = 0; PyDict_Next(res->shared, &pos, &gk, &gv);)
+    pairs += PyDict_GET_SIZE(gv);
+  if (t->cache_pairs + pairs > kDecodeCachePairsCap) {
+    PyDict_Clear(t->cache);
+    t->cache_pairs = 0;
+  }
   int rc = PyDict_SetItem(t->cache, key, reinterpret_cast<PyObject *>(res));
   Py_DECREF(key);
   if (rc < 0) {
     Py_DECREF(res);
     return nullptr;
   }
+  t->cache_pairs += pairs;
   return reinterpret_cast<PyObject *>(res);
 }
 
