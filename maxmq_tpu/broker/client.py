@@ -163,7 +163,8 @@ class Client:
                 if self.closed:
                     return
             try:
-                chunk = await self.reader.read(65536)
+                chunk = await self.reader.read(
+                    self.server.capabilities.buffer_size)
             except (ConnectionError, asyncio.CancelledError, OSError):
                 return
             if not chunk:

@@ -29,6 +29,26 @@ from .sys_info import SysInfo
 __version__ = "0.1.0"
 
 
+def _current_rss_bytes() -> int:
+    """Current resident set size. /proc on linux; best-effort elsewhere
+    (a failed probe reports 0 — the $SYS tick must never die over it)."""
+    try:
+        with open("/proc/self/status", encoding="ascii") as fh:
+            for line in fh:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    try:
+        import resource
+        import sys as _sys
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return rss if _sys.platform == "darwin" else rss * 1024
+    except Exception:
+        return 0
+    return 0
+
+
 @dataclass
 class Capabilities:
     """Feature flags/limits advertised to v5 clients and enforced for all.
@@ -51,6 +71,8 @@ class Capabilities:
     maximum_keepalive: int = 0  # 0 = unlimited; else clamp + v5 ServerKeepAlive
     maximum_client_writes_pending: int = 1024 * 8
     maximum_inflight: int = 1024 * 8
+    buffer_size: int = 65536          # per-connection read-chunk bytes
+    shutdown_timeout: float = 15.0    # graceful-close deadline, seconds
     sys_topic_interval: float = 30.0  # seconds; 0 disables
     keepalive_grace: float = 1.5      # deadline = keepalive * grace
 
@@ -141,9 +163,18 @@ class Broker:
             if task is not None:
                 task.cancel()
         self.listeners.stop_accepting_all()
+        stops = []
         for client in self.clients.connected():
             self.disconnect_client(client, codes.ErrServerShuttingDown)
-            await client.stop(ProtocolError(codes.ErrServerShuttingDown))
+            stops.append(asyncio.ensure_future(
+                client.stop(ProtocolError(codes.ErrServerShuttingDown))))
+        if stops:
+            # one shared graceful deadline for ALL clients; stragglers
+            # are cancelled, not waited on sequentially
+            _done, pending = await asyncio.wait(
+                stops, timeout=self.capabilities.shutdown_timeout)
+            for p in pending:
+                p.cancel()
         await self.listeners.close_all()
         self.hooks.notify("on_stopped")
         self.hooks.stop_all()
@@ -222,8 +253,9 @@ class Broker:
             if timeout <= 0:
                 raise ProtocolError(codes.ErrKeepAliveTimeout)
             try:
-                chunk = await asyncio.wait_for(client.reader.read(65536),
-                                               timeout)
+                chunk = await asyncio.wait_for(
+                    client.reader.read(self.capabilities.buffer_size),
+                    timeout)
             except asyncio.TimeoutError:
                 raise ProtocolError(codes.ErrKeepAliveTimeout) from None
             if not chunk:
@@ -1119,6 +1151,9 @@ class Broker:
         info.uptime = info.time - info.started
         info.retained = self.topics.retained_count
         info.subscriptions = self.topics.subscription_count
+        import threading
+        info.memory_alloc = _current_rss_bytes()
+        info.threads = threading.active_count()
         self.hooks.notify("on_sys_info_tick", info)
         entries = {
             "$SYS/broker/version": info.version,
@@ -1135,10 +1170,16 @@ class Broker:
             "$SYS/broker/messages/sent": info.messages_sent,
             "$SYS/broker/messages/dropped": info.messages_dropped,
             "$SYS/broker/messages/inflight": info.inflight,
+            # reference spellings (server.go:1214-1216) + our older
+            # /count aliases, kept for consumers already scraping them
+            "$SYS/broker/retained": info.retained,
+            "$SYS/broker/subscriptions": info.subscriptions,
             "$SYS/broker/messages/retained/count": info.retained,
             "$SYS/broker/subscriptions/count": info.subscriptions,
             "$SYS/broker/packets/received": info.packets_received,
             "$SYS/broker/packets/sent": info.packets_sent,
+            "$SYS/broker/system/memory": info.memory_alloc,
+            "$SYS/broker/system/threads": info.threads,
         }
         for topic, value in entries.items():
             packet = Packet(fixed=FixedHeader(type=PT.PUBLISH, retain=True),

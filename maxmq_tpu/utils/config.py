@@ -39,6 +39,13 @@ class Config:
 
     # -- broker capabilities (internal/mqtt/config.go fields → mochi
     #    Capabilities, server.go:76-91) --------------------------------------
+    mqtt_shutdown_timeout: int = 15     # graceful-close deadline, seconds
+    # per-connection read-chunk bytes. The reference's default (2048) is
+    # a Go bufio size; asyncio pays a coroutine round-trip per read, so
+    # the default stays at the historical 64KiB chunk — set explicitly
+    # to bound per-connection buffering
+    mqtt_buffer_size: int = 65536
+    mqtt_min_protocol_version: int = 3
     mqtt_max_keep_alive: int = 7200
     mqtt_session_expiry_interval: int = 0xFFFFFFFF
     mqtt_max_message_expiry_interval: int = 0xFFFFFFFF
@@ -106,11 +113,26 @@ def _coerce(value, typ):
     return str(value)
 
 
+# the reference spells a few keys differently (internal/config/
+# config.go:27-94); accept its names verbatim so a maxmq.conf written
+# for the reference drops in unchanged
+_REFERENCE_ALIASES = {
+    "mqtt_max_session_expiry_interval": "mqtt_session_expiry_interval",
+    "mqtt_max_outbound_messages": "mqtt_max_outbound_queue",
+    "mqtt_subscription_identifier_available":
+        "mqtt_subscription_id_available",
+    "mqtt_sys_topic_update_interval": "mqtt_sys_topic_interval",
+}
+
+
 def load_config(path: str | None = None,
                 env: dict[str, str] | None = None) -> Config:
     """defaults ← TOML file ← MAXMQ_* env, in increasing precedence."""
     env = os.environ if env is None else env
     data = read_config_file(path)
+    for ref_key, our_key in _REFERENCE_ALIASES.items():
+        if ref_key in data and our_key not in data:
+            data[our_key] = data[ref_key]
     conf = Config()
     defaults = Config()
     for f in fields(Config):
@@ -120,6 +142,11 @@ def load_config(path: str | None = None,
         env_key = "MAXMQ_" + f.name.upper()
         if env_key in env:
             setattr(conf, f.name, _coerce(env[env_key], typ))
+    for ref_key, our_key in _REFERENCE_ALIASES.items():
+        env_key = "MAXMQ_" + ref_key.upper()
+        if env_key in env and "MAXMQ_" + our_key.upper() not in env:
+            typ = type(getattr(defaults, our_key))
+            setattr(conf, our_key, _coerce(env[env_key], typ))
     return conf
 
 

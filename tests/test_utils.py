@@ -152,6 +152,31 @@ class TestConfig:
         assert conf.metrics_profiling is True
         assert conf.mqtt_retain_available is False
 
+    def test_reference_key_aliases(self, tmp_path):
+        # a maxmq.conf written for the reference drops in unchanged
+        # (internal/config/config.go:27-94 spellings)
+        p = tmp_path / "maxmq.conf"
+        p.write_text(
+            "mqtt_max_session_expiry_interval = 7200\n"
+            "mqtt_max_outbound_messages = 4096\n"
+            "mqtt_subscription_identifier_available = false\n"
+            "mqtt_sys_topic_update_interval = 9\n"
+            "mqtt_shutdown_timeout = 7\n"
+            "mqtt_buffer_size = 2048\n"
+            "mqtt_min_protocol_version = 4\n")
+        conf = load_config(path=str(p), env={})
+        assert conf.mqtt_session_expiry_interval == 7200
+        assert conf.mqtt_max_outbound_queue == 4096
+        assert conf.mqtt_subscription_id_available is False
+        assert conf.mqtt_sys_topic_interval == 9
+        assert conf.mqtt_shutdown_timeout == 7
+        assert conf.mqtt_buffer_size == 2048
+        assert conf.mqtt_min_protocol_version == 4
+        # env spelling aliases too
+        conf = load_config(path=str(p), env={
+            "MAXMQ_MQTT_SYS_TOPIC_UPDATE_INTERVAL": "3"})
+        assert conf.mqtt_sys_topic_interval == 3
+
     def test_missing_file_ok(self, tmp_path, monkeypatch):
         monkeypatch.chdir(tmp_path)
         assert read_config_file() == {}
