@@ -48,7 +48,7 @@ def capabilities_from_config(conf: Config) -> Capabilities:
         sub_id_available=conf.mqtt_subscription_id_available,
         shared_sub_available=conf.mqtt_shared_subscription_available,
         minimum_protocol_version=conf.mqtt_min_protocol_version,
-        buffer_size=max(conf.mqtt_buffer_size, 1024),
+        buffer_size=conf.mqtt_buffer_size,    # clamped in Capabilities
         shutdown_timeout=float(conf.mqtt_shutdown_timeout),
         maximum_keepalive=conf.mqtt_max_keep_alive,
         maximum_client_writes_pending=conf.mqtt_max_outbound_queue,

@@ -2,13 +2,14 @@
 
 from .client import Client, ClientRegistry, PacketIDExhausted
 from .inflight import Inflight
-from .listeners import (Listener, Listeners, MockListener, TCPListener,
-                        UnixListener, WSListener)
+from .listeners import (Listener, Listeners, MockListener, SocketListener,
+                        TCPListener, UnixListener, WSListener)
 from .server import Broker, BrokerOptions, Capabilities
 from .sys_info import SysInfo
 
 __all__ = [
     "Client", "ClientRegistry", "PacketIDExhausted", "Inflight",
-    "Listener", "Listeners", "MockListener", "TCPListener", "UnixListener",
-    "WSListener", "Broker", "BrokerOptions", "Capabilities", "SysInfo",
+    "Listener", "Listeners", "MockListener", "SocketListener",
+    "TCPListener", "UnixListener", "WSListener", "Broker",
+    "BrokerOptions", "Capabilities", "SysInfo",
 ]

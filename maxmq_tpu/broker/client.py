@@ -203,9 +203,11 @@ class Client:
 
     def _write_packet(self, packet: Packet) -> None:
         packet = self.server.hooks.modify("on_packet_encode", packet, self)
-        wire = packet.encode()
-        maxsize = self.properties.maximum_packet_size
-        if maxsize and len(wire) > maxsize:
+        # oversize outbound packets first shed their optional problem-
+        # info properties [MQTT-3.2.2-19/20]; still-oversize ones drop
+        # [MQTT-3.1.2-25]
+        wire = packet.encode_under(self.properties.maximum_packet_size)
+        if wire is None:
             self.server.info.messages_dropped += 1
             return
         assert self.writer is not None

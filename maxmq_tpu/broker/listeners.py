@@ -88,6 +88,34 @@ class UnixListener(Listener):
         self._server = await asyncio.start_unix_server(handler, path=self.address)
 
 
+class SocketListener(Listener):
+    """Serve an externally created, already-bound socket — the analog
+    of the reference's bring-your-own net.Listener (listeners/net.go):
+    callers doing their own bind dance (fd passing, systemd socket
+    activation, exotic socket options) hand the socket over and the
+    broker just accepts on it."""
+
+    def __init__(self, id_: str, sock) -> None:
+        try:
+            addr = sock.getsockname()
+            address = (addr if isinstance(addr, str)
+                       else f"{addr[0]}:{addr[1]}")
+        except OSError:
+            address = "?"
+        super().__init__(id_, address)
+        self.sock = sock
+
+    @property
+    def protocol(self) -> str:
+        return "sock"
+
+    async def serve(self, establish) -> None:
+        async def handler(reader, writer):
+            await establish(self.id, reader, writer)
+
+        self._server = await asyncio.start_server(handler, sock=self.sock)
+
+
 class MockListener(Listener):
     """In-process listener for tests: ``connect()`` returns the client-side
     (reader, writer) of a paired in-memory stream."""

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import heapq
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -37,7 +38,7 @@ def _current_rss_bytes() -> int:
             for line in fh:
                 if line.startswith("VmRSS:"):
                     return int(line.split()[1]) * 1024
-    except OSError:
+    except (OSError, ValueError, IndexError):
         pass
     try:
         import resource
@@ -46,7 +47,6 @@ def _current_rss_bytes() -> int:
         return rss if _sys.platform == "darwin" else rss * 1024
     except Exception:
         return 0
-    return 0
 
 
 @dataclass
@@ -73,6 +73,12 @@ class Capabilities:
     maximum_inflight: int = 1024 * 8
     buffer_size: int = 65536          # per-connection read-chunk bytes
     shutdown_timeout: float = 15.0    # graceful-close deadline, seconds
+
+    def __post_init__(self) -> None:
+        # read(0) returns b'' and reads as EOF, killing every
+        # connection at the first loop turn — clamp on the field so
+        # direct Capabilities(...) construction is as safe as config
+        self.buffer_size = max(self.buffer_size, 1024)
     sys_topic_interval: float = 30.0  # seconds; 0 disables
     keepalive_grace: float = 1.5      # deadline = keepalive * grace
 
@@ -1151,7 +1157,6 @@ class Broker:
         info.uptime = info.time - info.started
         info.retained = self.topics.retained_count
         info.subscriptions = self.topics.subscription_count
-        import threading
         info.memory_alloc = _current_rss_bytes()
         info.threads = threading.active_count()
         self.hooks.notify("on_sys_info_tick", info)

@@ -467,6 +467,41 @@ class Packet:
         if not valid_utf8_string(self.topic.encode("utf-8")):
             raise ProtocolError(codes.ErrTopicNameInvalid)
 
+    def encode_under(self, max_size: int) -> bytes | None:
+        """Encode within ``max_size`` bytes, discarding the optional
+        problem-info properties (reason string, then user properties)
+        when they don't fit — [MQTT-3.2.2-19/20] and siblings; the
+        reference includes each iff the packet stays under the cap
+        (properties.go:290-296, 323-334). None = still oversize after
+        dropping everything droppable (the caller drops the packet,
+        [MQTT-3.1.2-25])."""
+        wire = self.encode()
+        if not max_size or len(wire) <= max_size:
+            return wire
+        if not self.v5:
+            return None
+        p = self.copy()
+        rs = p.properties.reason_string
+        up = p.properties.user_properties
+        p.properties.reason_string = ""
+        p.properties.user_properties = []
+        wire = p.encode()
+        if len(wire) > max_size:
+            return None
+        if rs:                       # re-admit what still fits, in the
+            p.properties.reason_string = rs      # reference's order
+            trial = p.encode()
+            if len(trial) <= max_size:
+                wire = trial
+            else:
+                p.properties.reason_string = ""
+        if up:
+            p.properties.user_properties = up
+            trial = p.encode()
+            if len(trial) <= max_size:
+                wire = trial
+        return wire
+
     def reason_code_valid(self) -> bool:
         """Whether the reason code is one the spec allows for this packet
         type (reference parity surface: ReasonCodeValid,

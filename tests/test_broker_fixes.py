@@ -165,3 +165,34 @@ async def test_spawn_logs_background_failures():
     assert log.errors
     assert log.errors[0][1]["task"] == "test-task"
     assert "kaput" in log.errors[0][1]["error"]
+
+
+async def test_socket_listener_serves_prebound_socket():
+    # the bring-your-own-listener analog (reference listeners/net.go):
+    # an externally bound socket handed to the broker just accepts
+    import socket
+
+    from maxmq_tpu.broker import (Broker, BrokerOptions, Capabilities,
+                                  SocketListener)
+    from maxmq_tpu.hooks import AllowHook
+    from maxmq_tpu.mqtt_client import MQTTClient
+
+    sock = socket.socket()
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    b = Broker(BrokerOptions(capabilities=Capabilities(
+        sys_topic_interval=0)))
+    b.add_hook(AllowHook())
+    b.add_listener(SocketListener("byo", sock))
+    await b.serve()
+    try:
+        c = MQTTClient("byo-c")
+        await c.connect("127.0.0.1", port)
+        await c.subscribe("byo/#")
+        await c.publish("byo/x", b"via-prebound")
+        m = await c.next_message(5)
+        assert m.payload == b"via-prebound"
+        await c.disconnect()
+    finally:
+        await b.close()

@@ -348,6 +348,45 @@ async def test_subscribe_invalid_shared_filter():
         await c.disconnect()
 
 
+# --- encode-direction: oversize property dropping ----------------------
+
+def test_encode_under_drops_optional_properties():
+    # TConnackDropProperties / TConnackDropPropertiesPartial /
+    # TDisconnectDropProperties semantics [MQTT-3.2.2-19/20]: reason
+    # string and user properties are shed, in order, when the client's
+    # maximum packet size would be exceeded; other properties survive
+    p = Packet(fixed=FixedHeader(type=PT.CONNACK), protocol_version=5,
+               reason_code=0)
+    p.properties.reason_string = "reason"
+    p.properties.user_properties = [("hello", "world")]
+    p.properties.server_reference = "mochi-2"
+    full = p.encode()
+    # generous cap: everything stays
+    assert p.encode_under(len(full)) == full
+    # partial: user properties no longer fit, reason string does
+    partial = p.encode_under(len(full) - 5)
+    assert partial is not None and len(partial) < len(full)
+    from maxmq_tpu.protocol.packets import parse_stream
+    [(fh, body)] = list(parse_stream(bytearray(partial)))
+    got = Packet.decode(fh, body, 5)
+    assert got.properties.reason_string == "reason"
+    assert got.properties.user_properties == []
+    assert got.properties.server_reference == "mochi-2"
+    # tiny cap: both dropped, the rest survives
+    tiny = p.encode_under(len(partial) - 5)
+    [(fh, body)] = list(parse_stream(bytearray(tiny)))
+    got = Packet.decode(fh, body, 5)
+    assert got.properties.reason_string == ""
+    assert got.properties.user_properties == []
+    assert got.properties.server_reference == "mochi-2"
+    # undroppable overflow: caller must drop the packet
+    assert p.encode_under(4) is None
+    # TPublishDropOversize: payload can't be shed
+    pub = Packet(fixed=FixedHeader(type=PT.PUBLISH), protocol_version=5,
+                 topic="a/b", payload=b"x" * 100)
+    assert pub.encode_under(50) is None
+
+
 # --- TDisconnect* encode cases (tpackets.go fail-state section) --------
 
 def test_disconnect_reason_codes_roundtrip():
