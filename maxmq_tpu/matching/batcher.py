@@ -38,14 +38,22 @@ class MicroBatcher:
     """
 
     def __init__(self, engine, window_us: int = 200,
-                 max_batch: int = 256) -> None:
+                 max_batch: int = 256, pipeline_depth: int = 3) -> None:
         self.engine = engine
         self.window_us = window_us
         self.max_batch = max_batch
+        # batches allowed in flight at once. On a high-latency link a
+        # single serialized batch makes every queued request wait out
+        # the full round trip of the one before it; the sig engine's
+        # dispatch/collect split lets batch N+1's upload ride the link
+        # while batch N decodes (same depth the bench pipelines at).
+        self.pipeline_depth = max(1, pipeline_depth)
         self._pending: list[tuple[str, asyncio.Future]] = []
         self._wakeup: asyncio.Event | None = None
         self._dispatcher: asyncio.Task | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
+        self._inflight: asyncio.Semaphore | None = None
+        self._collects: set[asyncio.Task] = set()
         self._lock = threading.Lock()
         # stats (scraped by the metrics bridge)
         self.batches = 0
@@ -96,6 +104,7 @@ class MicroBatcher:
     def _start(self, loop: asyncio.AbstractEventLoop) -> None:
         self._loop = loop
         self._wakeup = asyncio.Event()
+        self._inflight = asyncio.Semaphore(self.pipeline_depth)
         self._dispatcher = loop.create_task(self._run(), name="match-batcher")
 
     async def close(self) -> None:
@@ -106,6 +115,14 @@ class MicroBatcher:
             except (asyncio.CancelledError, Exception):
                 pass
             self._dispatcher = None
+        for task in list(self._collects):
+            task.cancel()
+        for task in list(self._collects):
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._collects.clear()
         for _, fut in self._pending:
             if not fut.done():
                 fut.cancel()
@@ -118,6 +135,12 @@ class MicroBatcher:
 
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
+        # pipelined mode needs the engine's dispatch/collect split
+        # (SigEngine's fixed path); other engines run one batch at a
+        # time through their whole-batch function
+        split = (hasattr(self.engine, "dispatch_fixed")
+                 and hasattr(self.engine, "collect_fixed")
+                 and self.pipeline_depth > 1)
         while True:
             await self._wakeup.wait()
             self._wakeup.clear()
@@ -134,15 +157,71 @@ class MicroBatcher:
             self.batches += 1
             self.batched_topics += len(batch)
             self.largest_batch = max(self.largest_batch, len(batch))
-            try:
-                # worker thread: overlap device time with the event loop
-                results = await loop.run_in_executor(
-                    None, self._batch_fn, topics)
-            except Exception as exc:  # engine failure → fail the callers
-                for _, fut in batch:
-                    if not fut.done():
-                        fut.set_exception(exc)
-                continue
-            for (_, fut), result in zip(batch, results):
+            if split:
+                await self._dispatch_pipelined(loop, batch, topics)
+            else:
+                await self._run_whole_batch(loop, batch, topics)
+
+    async def _run_whole_batch(self, loop, batch, topics) -> None:
+        try:
+            # worker thread: overlap device time with the event loop
+            results = await loop.run_in_executor(
+                None, self._batch_fn, topics)
+        except Exception as exc:  # engine failure → fail the callers
+            for _, fut in batch:
                 if not fut.done():
-                    fut.set_result(result)
+                    fut.set_exception(exc)
+            return
+        for (_, fut), result in zip(batch, results):
+            if not fut.done():
+                fut.set_result(result)
+
+    async def _dispatch_pipelined(self, loop, batch, topics) -> None:
+        """Dispatch now, collect in a bounded background task: up to
+        ``pipeline_depth`` batches ride the device/link concurrently, so
+        a queued request no longer waits out the FULL round trip of the
+        batch ahead of it."""
+        await self._inflight.acquire()
+        try:
+            ctx = await loop.run_in_executor(
+                None, self.engine.dispatch_fixed, topics)
+        except asyncio.CancelledError:
+            self._inflight.release()
+            self._cancel_futures(batch)
+            raise
+        except Exception:
+            # dispatch refused (device matching disabled for this
+            # corpus, resync, table swap): the whole-batch path keeps
+            # its CPU-trie fallback semantics — never fail the callers
+            # for a condition the engine degrades through
+            self._inflight.release()
+            await self._run_whole_batch(loop, batch, topics)
+            return
+        task = loop.create_task(self._collect(loop, batch, topics, ctx))
+        self._collects.add(task)
+        task.add_done_callback(self._collects.discard)
+
+    async def _collect(self, loop, batch, topics, ctx) -> None:
+        try:
+            results = await loop.run_in_executor(
+                None, self.engine.collect_fixed, topics, ctx)
+        except asyncio.CancelledError:
+            self._cancel_futures(batch)
+            raise
+        except Exception:
+            # same degradation contract as dispatch failures
+            results = None
+        finally:
+            self._inflight.release()
+        if results is None:
+            await self._run_whole_batch(loop, batch, topics)
+            return
+        for (_, fut), result in zip(batch, results):
+            if not fut.done():
+                fut.set_result(result)
+
+    @staticmethod
+    def _cancel_futures(batch) -> None:
+        for _, fut in batch:
+            if not fut.done():
+                fut.cancel()

@@ -110,3 +110,110 @@ def test_batcher_delegates_sync_surface():
     assert batcher.subscribers("a") == "result:a"
     assert batcher.refresh() is False
     assert batcher.index is eng.index
+
+
+class SplitEngine:
+    """Dispatch/collect split with a slow collect: lets the pipelining
+    test observe multiple batches in flight."""
+
+    def __init__(self, collect_s: float = 0.05) -> None:
+        import threading
+        import time as _time
+
+        self.index = TopicIndex()
+        self.collect_s = collect_s
+        self.concurrent = 0
+        self.max_concurrent = 0
+        self._lk = threading.Lock()
+        self._time = _time
+
+    def dispatch_fixed(self, topics):
+        return ("ctx", list(topics))
+
+    def collect_fixed(self, topics, ctx):
+        with self._lk:
+            self.concurrent += 1
+            self.max_concurrent = max(self.max_concurrent,
+                                      self.concurrent)
+        self._time.sleep(self.collect_s)   # the "link round trip"
+        with self._lk:
+            self.concurrent -= 1
+        assert ctx == ("ctx", list(topics))
+        return [f"r:{t}" for t in topics]
+
+    def subscribers_batch(self, topics):
+        return self.collect_fixed(topics, self.dispatch_fixed(topics))
+
+    def refresh(self, force=False):
+        return False
+
+
+async def test_pipelined_batches_overlap():
+    # with the dispatch/collect split, queued batches must not serialize
+    # behind the round trip of the batch ahead of them
+    eng = SplitEngine()
+    batcher = MicroBatcher(eng, window_us=0, max_batch=2,
+                           pipeline_depth=3)
+    try:
+        results = await asyncio.gather(
+            *[batcher.subscribers_async(f"p/{i}") for i in range(12)])
+        assert sorted(results) == sorted(f"r:p/{i}" for i in range(12))
+        assert eng.max_concurrent >= 2, eng.max_concurrent
+    finally:
+        await batcher.close()
+
+
+async def test_pipeline_depth_one_still_serializes():
+    eng = SplitEngine(collect_s=0.01)
+    batcher = MicroBatcher(eng, window_us=0, max_batch=2,
+                           pipeline_depth=1)
+    try:
+        results = await asyncio.gather(
+            *[batcher.subscribers_async(f"q/{i}") for i in range(8)])
+        assert sorted(results) == sorted(f"r:q/{i}" for i in range(8))
+        assert eng.max_concurrent == 1
+    finally:
+        await batcher.close()
+
+
+async def test_pipelined_collect_failure_fails_only_its_batch():
+    class Flaky(SplitEngine):
+        def collect_fixed(self, topics, ctx):
+            if any(t.endswith("boom") for t in topics):
+                raise RuntimeError("device fell over")
+            return super().collect_fixed(topics, ctx)
+
+    eng = Flaky(collect_s=0.005)
+    batcher = MicroBatcher(eng, window_us=0, max_batch=1,
+                           pipeline_depth=2)
+    try:
+        ok_futs = [batcher.subscribers_async(f"z/{i}") for i in range(3)]
+        bad = batcher.subscribers_async("z/boom")
+        ok = await asyncio.gather(*ok_futs)
+        assert sorted(ok) == sorted(f"r:z/{i}" for i in range(3))
+        with pytest.raises(RuntimeError):
+            await bad
+    finally:
+        await batcher.close()
+
+
+async def test_pipelined_dispatch_refusal_falls_back_to_whole_batch():
+    # a corpus the device path declines (sig.py: > MAX_GROUPS) raises
+    # from dispatch_fixed; the batcher must degrade to the whole-batch
+    # function (which carries the CPU-trie fallback), never fail callers
+    class TrieOnly(SplitEngine):
+        def dispatch_fixed(self, topics):
+            raise RuntimeError("device matching disabled for this corpus")
+
+        def subscribers_batch(self, topics):
+            return [f"trie:{t}" for t in topics]
+
+    eng = TrieOnly()
+    batcher = MicroBatcher(eng, window_us=0, max_batch=4,
+                           pipeline_depth=3)
+    try:
+        results = await asyncio.gather(
+            *[batcher.subscribers_async(f"f/{i}") for i in range(6)])
+        assert sorted(results) == sorted(f"trie:f/{i}" for i in range(6))
+    finally:
+        await batcher.close()
