@@ -20,6 +20,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import statistics
 import sys
 import time
@@ -233,6 +234,10 @@ async def main() -> None:
                          "batched signature matcher + MicroBatcher")
     ap.add_argument("--real-subs", type=int, default=16)
     ap.add_argument("--publishers", type=int, default=2)
+    ap.add_argument("--workers", type=int, default=0,
+                    help="N>1: run the broker as an ADR-005 worker pool "
+                         "(SO_REUSEPORT + fan-out bus) instead of one "
+                         "process")
     args = ap.parse_args()
 
     if args.matchbench and args.host is not None:
@@ -282,10 +287,34 @@ async def main() -> None:
             "flush=True)\n"
             "    await asyncio.Event().wait()\n"
             "asyncio.run(main())\n")
-        broker = subprocess.Popen([sys.executable, "-c", script],
-                                  stdout=subprocess.PIPE, text=True)
-        host = "127.0.0.1"
-        port = int(broker.stdout.readline())
+        if args.workers > 1:
+            if args.matchbench:
+                ap.error("--workers does not combine with --matchbench "
+                         "(the corpus preload is single-process)")
+            # ADR-005 pool: drive through the real CLI bootstrap
+            import tempfile
+            import time as _time
+
+            port = 18883 + (os.getpid() % 1000)
+            conf = tempfile.NamedTemporaryFile(
+                "w", suffix=".conf", delete=False)
+            conf.write(f'workers = {args.workers}\n'
+                       f'mqtt_tcp_address = "127.0.0.1:{port}"\n'
+                       'metrics_enabled = false\n'
+                       'matcher = "trie"\n'
+                       'mqtt_sys_topic_interval = 0\n')
+            conf.close()
+            broker = subprocess.Popen(
+                [sys.executable, "-m", "maxmq_tpu", "start",
+                 "--config", conf.name, "--no-banner"],
+                cwd=REPO, env={**os.environ, "PYTHONPATH": REPO})
+            host = "127.0.0.1"
+            _time.sleep(6.0)          # pool parent + workers boot
+        else:
+            broker = subprocess.Popen([sys.executable, "-c", script],
+                                      stdout=subprocess.PIPE, text=True)
+            host = "127.0.0.1"
+            port = int(broker.stdout.readline())
 
     payload = bytes(args.payload)
     if args.matchbench:
