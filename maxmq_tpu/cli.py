@@ -81,5 +81,10 @@ def main(argv: list[str] | None = None) -> int:
     return 0
 
 
+def main_entry() -> None:
+    """console_scripts entry point (pyproject.toml: `maxmq`)."""
+    sys.exit(main())
+
+
 if __name__ == "__main__":
     sys.exit(main())
