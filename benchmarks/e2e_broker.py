@@ -270,8 +270,19 @@ async def main() -> None:
                     "    b.attach_matcher(MicroBatcher("
                     "SigEngine(b.topics)))\n")
         script = (
-            "import asyncio, sys\n"
+            "import asyncio, os, sys\n"
             f"sys.path.insert(0, {REPO!r})\n"
+            # the image's sitecustomize pins jax_platforms to the
+            # hardware backend, overriding the env var — honor an
+            # explicit JAX_PLATFORMS so --matcher sig can be exercised
+            # on the CPU backend (and can't hang on a wedged tunnel)
+            "want = os.environ.get('JAX_PLATFORMS')\n"
+            "if want:\n"
+            "    import jax\n"
+            "    try:\n"
+            "        jax.config.update('jax_platforms', want)\n"
+            "    except RuntimeError:\n"
+            "        pass\n"
             "from maxmq_tpu.broker import Broker, BrokerOptions, "
             "Capabilities, TCPListener\n"
             "from maxmq_tpu.hooks import AllowHook\n"

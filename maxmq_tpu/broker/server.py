@@ -111,6 +111,11 @@ class Broker:
         # heavily, and a trie walk costs ~20us; entries self-invalidate
         # on any subscription change (version check), FIFO-bounded
         self._match_cache: dict[str, tuple[int, SubscriberSet]] = {}
+        # matcher-mode publish pipeline: (match future, origin, packet)
+        # consumed in arrival order, so per-publisher delivery order holds
+        # [MQTT-4.6.0] while many publishes ride the device concurrently
+        self._pub_queue: asyncio.Queue | None = None
+        self._pub_consumer: asyncio.Task | None = None
         self._running = False
         self.loop: asyncio.AbstractEventLoop | None = None
 
@@ -181,6 +186,21 @@ class Broker:
                 stops, timeout=self.capabilities.shutdown_timeout)
             for p in pending:
                 p.cancel()
+        if self._pub_consumer is not None:
+            # intake is stopped (listeners + read loops), so the queue
+            # can only shrink: give the backlog a bounded drain (inline
+            # clients may still take delivery; closed ones no-op), then
+            # stop the consumer and reset so a re-serve()d broker
+            # lazily recreates both
+            try:
+                await asyncio.wait_for(
+                    self._pub_queue.join(),
+                    timeout=self.capabilities.shutdown_timeout)
+            except (asyncio.TimeoutError, TimeoutError):
+                pass
+            self._pub_consumer.cancel()
+            self._pub_consumer = None
+            self._pub_queue = None
         await self.listeners.close_all()
         self.hooks.notify("on_stopped")
         self.hooks.stop_all()
@@ -478,9 +498,14 @@ class Broker:
         self._ack_publish(client, packet, success=True)
         if self.matcher is None:
             self._fan_out(self._match_cached(packet.topic), packet)
+            self.hooks.notify("on_published", client, packet)
         else:
-            await self.publish_to_subscribers(packet)
-        self.hooks.notify("on_published", client, packet)
+            # pipelined: dispatch the match NOW, fan out in arrival order
+            # from the consumer task. The read loop returns immediately,
+            # so a single connection can keep thousands of publishes in
+            # flight — that in-flight depth is what lets the MicroBatcher
+            # form device-sized batches instead of per-connection pairs.
+            await self._enqueue_publish(client, packet)
 
     @staticmethod
     def _resolve_inbound_alias(client: Client, packet: Packet) -> None:
@@ -594,11 +619,89 @@ class Broker:
     # PUBLISH fan-out — the hot loop the TPU matcher accelerates
     # ------------------------------------------------------------------
 
+    # bound on publishes awaiting fan-out; a full queue backpressures the
+    # offending connection's read loop instead of growing without limit
+    PUB_PIPELINE_BOUND = 8192
+
+    async def _enqueue_publish(self, client: Client, packet: Packet) -> None:
+        """Matcher-mode publish path: start the match immediately (the
+        batcher coalesces concurrent ones into device batches) and queue
+        the (future, packet) pair for the in-order fan-out consumer."""
+        if self._pub_consumer is None:
+            if not self._running:
+                # late publish after close() tore the pipeline down (the
+                # queue is already drained, so order can't be violated):
+                # serve it synchronously off the CPU trie
+                self._fan_out(self.topics.subscribers(packet.topic), packet)
+                self.hooks.notify("on_published", client, packet)
+                return
+            self._pub_queue = asyncio.Queue(maxsize=self.PUB_PIPELINE_BOUND)
+            self._pub_consumer = self.loop.create_task(
+                self._pub_pipeline_loop(), name="publish-pipeline")
+        await self._pub_queue.put((self._dispatch_match(packet.topic),
+                                   client, packet))
+
+    def _dispatch_match(self, topic: str) -> asyncio.Future:
+        enq = getattr(self.matcher, "enqueue", None)
+        if enq is not None:
+            return enq(topic)
+        return asyncio.ensure_future(self._match_async(topic))
+
+    async def _pub_pipeline_loop(self) -> None:
+        """Drain the publish pipeline in arrival order: await each match
+        result, fan out, fire on_published. A matcher failure degrades
+        that one publish to the CPU trie — delivery never silently drops."""
+        while True:
+            fut, client, packet = await self._pub_queue.get()
+            try:
+                try:
+                    subscribers = await fut
+                except asyncio.CancelledError:
+                    # CancelledError is a BaseException: catch it
+                    # explicitly or a batcher-close cancelling a MATCH
+                    # future kills the consumer. cancelling() (3.11+)
+                    # distinguishes "we are being cancelled" from "only
+                    # the future was"; without it, stay conservative.
+                    me = asyncio.current_task()
+                    cancelling = getattr(me, "cancelling", None)
+                    if cancelling is None or cancelling():
+                        raise
+                    subscribers = self.topics.subscribers(packet.topic)
+                except Exception as exc:
+                    if self.log is not None:
+                        self.log.with_prefix("broker").error(
+                            "matcher failed; trie fallback",
+                            topic=packet.topic, error=repr(exc))
+                    subscribers = self.topics.subscribers(packet.topic)
+                try:
+                    self._fan_out(subscribers, packet)
+                    if client is not None:
+                        self.hooks.notify("on_published", client, packet)
+                except Exception as exc:
+                    # a raising hook must cost this publish, not the
+                    # consumer: a dead consumer would wedge every
+                    # matcher-mode publisher behind a full queue
+                    if self.log is not None:
+                        self.log.with_prefix("broker").error(
+                            "publish fan-out failed", topic=packet.topic,
+                            error=repr(exc))
+            finally:
+                self._pub_queue.task_done()
+
     async def publish_to_subscribers(self, packet: Packet) -> None:
         """Parity: v2/server.go:766-868. Matching goes through the pluggable
         matcher (TPU NFA) when attached, else the CPU trie; hooks may then
-        override via on_select_subscribers, mirroring the reference."""
+        override via on_select_subscribers, mirroring the reference.
+
+        When the publish pipeline is active, out-of-band producers (wills,
+        $SYS, inline/injected publishes) enqueue behind it rather than
+        fanning out directly — a will must not overtake its own client's
+        still-queued publishes."""
         if self.matcher is not None:
+            if self._pub_consumer is not None:
+                await self._pub_queue.put(
+                    (self._dispatch_match(packet.topic), None, packet))
+                return
             subscribers = await self._match_async(packet.topic)
         else:
             subscribers = self.topics.subscribers(packet.topic)

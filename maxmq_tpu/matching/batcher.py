@@ -91,15 +91,23 @@ class MicroBatcher:
 
     # ------------------------------------------------------------------
 
-    async def subscribers_async(self, topic: str) -> "SubscriberSet":
-        """Queue one match; resolves when its micro-batch returns."""
+    def enqueue(self, topic: str) -> asyncio.Future:
+        """Queue one match WITHOUT awaiting it: returns the future that
+        resolves when its micro-batch comes back. The broker's publish
+        pipeline uses this to keep hundreds of publishes in flight from
+        one connection's read loop — in-flight count, not connection
+        count, is what sizes the device batches."""
         loop = asyncio.get_running_loop()
         if self._dispatcher is None or self._loop is not loop:
             self._start(loop)
         fut: asyncio.Future = loop.create_future()
         self._pending.append((topic, fut))
         self._wakeup.set()
-        return await fut
+        return fut
+
+    async def subscribers_async(self, topic: str) -> "SubscriberSet":
+        """Queue one match; resolves when its micro-batch returns."""
+        return await self.enqueue(topic)
 
     def _start(self, loop: asyncio.AbstractEventLoop) -> None:
         self._loop = loop

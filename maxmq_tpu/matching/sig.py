@@ -1583,6 +1583,32 @@ class SigEngine(OverlayedEngine):
                 "APIs, which fall back to the CPU trie")
         tables, fn_fixed, fmt = state[0], state[6], state[7]
         toks8, lens_enc, hostrows = prepare_batch(tables, topics)
+        # Bucket the batch axis to powers of two: fn_fixed is jitted, so
+        # every DISTINCT batch shape costs a full XLA compile (seconds) —
+        # fatal for the MicroBatcher, whose batch sizes vary per window.
+        # Pad rows are depth-1 '$'-topics of all-pad tokens: '$' excludes
+        # every wildcard-first group [MQTT-4.7.1-1/2] and no literal level
+        # can equal the reserved pad token, so pads match nothing and add
+        # nothing to the row stream (which is topic-sorted anyway).
+        b = len(topics)
+        if b <= 16:
+            bucket = 16
+        elif b <= 4096:
+            # powers of FOUR here: each bucket shape costs one XLA
+            # compile per table version, and broker micro-batches vary —
+            # a sparser ladder trades ≤3x padding for 3 compiles total
+            n = (b - 1).bit_length()
+            bucket = 1 << (n + (n & 1))
+        else:
+            bucket = 1 << (b - 1).bit_length()
+        if bucket != b:
+            _dt, padval = _compact_dtype(tables)
+            tp = np.full((bucket, *toks8.shape[1:]), padval,
+                         dtype=toks8.dtype)
+            tp[:b] = toks8
+            lp = np.full(bucket, -1, dtype=lens_enc.dtype)
+            lp[:b] = lens_enc
+            toks8, lens_enc = tp, lp
         # both fixed-path programs are jitted and device_put numpy inputs
         out = fn_fixed(toks8, lens_enc)
         if fmt["kind"] == "stream":
@@ -1649,6 +1675,8 @@ class SigEngine(OverlayedEngine):
                 return self._resync_batch(topics)   # skip the flatten
             cnt, real, flat = self._fetch_stream(out)
             batch = len(topics)
+            if len(cnt) > batch:        # bucket-padded dispatch: pads
+                cnt, real = cnt[:batch], real[:batch]   # carry no rows
             fall = cnt == 15
             ti_dev = np.repeat(np.arange(batch), real)
             rw_dev = (flat.astype(np.int64) if flat is not None
@@ -1668,6 +1696,8 @@ class SigEngine(OverlayedEngine):
         collect_fixed so harnesses can time this stage in isolation."""
         if self.overlay_for(tables.version) == "resync":
             return self._resync_batch(topics)       # skip the flatten
+        if len(cnt) > len(topics):      # bucket-padded dispatch
+            cnt, rows = cnt[:len(topics)], rows[:len(topics)]
         fall = cnt == 15
         ti, rw = _candidate_pairs(len(topics), cnt, rows, hostrows, fall,
                                   tables)
@@ -1692,6 +1722,11 @@ class SigEngine(OverlayedEngine):
 
         batch = len(topics)
         self.matches += batch
+        if len(lens_enc) > batch:
+            # bucket-padded dispatch: the C decode pass derives the token
+            # matrix width from len/batch, so hand it exactly [batch, W]
+            # (leading-axis slices of C-contiguous arrays stay contiguous)
+            toks8, lens_enc = toks8[:batch], lens_enc[:batch]
 
         nd = _native_decode(tables) if removed is None else None
         if nd is not None:

@@ -196,3 +196,65 @@ async def test_socket_listener_serves_prebound_socket():
         await c.disconnect()
     finally:
         await b.close()
+
+
+class _TrieMatcher:
+    """Minimal pluggable matcher: trie semantics behind the async
+    matcher surface, so tests exercise the publish pipeline."""
+
+    def __init__(self, index):
+        self.index = index
+
+    async def subscribers_async(self, topic):
+        return self.index.subscribers(topic)
+
+
+async def test_publish_pipeline_survives_raising_hook():
+    """A hook raising during fan-out must cost that one publish, not the
+    pipeline consumer — a dead consumer wedges every matcher-mode
+    publisher behind a full queue (review finding, round 3)."""
+    from test_broker_system import connect, running_broker
+
+    from maxmq_tpu.hooks.base import Hook
+
+    class Boom(Hook):
+        def __init__(self):
+            self.fired = 0
+
+        def on_published(self, client, packet):
+            self.fired += 1
+            if self.fired == 1:
+                raise RuntimeError("hook kaput")
+
+    async with running_broker() as broker:
+        boom = broker.add_hook(Boom())
+        broker.attach_matcher(_TrieMatcher(broker.topics))
+        sub = await connect(broker, "pl-sub")
+        await sub.subscribe(("t/#", 0))
+        pub = await connect(broker, "pl-pub")
+        await pub.publish("t/1", b"a")        # hook raises on this one
+        await pub.publish("t/2", b"b")        # must still deliver
+        m1 = await sub.next_message(timeout=5)
+        m2 = await sub.next_message(timeout=5)
+        assert {m1.topic, m2.topic} == {"t/1", "t/2"}
+        assert boom.fired == 2
+        assert not broker._pub_consumer.done()
+        await sub.disconnect()
+        await pub.disconnect()
+
+
+async def test_publish_pipeline_resets_on_close():
+    """close() must reset the pipeline so a re-serve()d broker lazily
+    recreates the consumer (review finding, round 3)."""
+    from test_broker_system import connect, running_broker
+
+    async with running_broker() as broker:
+        broker.attach_matcher(_TrieMatcher(broker.topics))
+        sub = await connect(broker, "rs-sub")
+        await sub.subscribe(("r/#", 0))
+        pub = await connect(broker, "rs-pub")
+        await pub.publish("r/1", b"x")
+        m = await sub.next_message(timeout=5)
+        assert m.topic == "r/1"
+        assert broker._pub_consumer is not None
+    assert broker._pub_consumer is None and broker._pub_queue is None
