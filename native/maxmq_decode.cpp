@@ -329,6 +329,7 @@ struct DecodeTable {
   PyObject *cids;       // list len A: client-id str
   PyObject *subs;       // list len A: Subscription
   PyObject *cache;      // verified-row-set bytes -> SubscriberSet
+  PyObject *frag;       // row int -> single-row SubscriberSet fragment
   Py_ssize_t cache_pairs = 0;  // total subscriber entries cached
   std::vector<PyObject *> key, cid, sub;  // borrowed from the lists
   Py_ssize_t R, W, A;
@@ -353,6 +354,7 @@ void table_destroy(PyObject *capsule) {
   Py_XDECREF(t->cids);
   Py_XDECREF(t->subs);
   Py_XDECREF(t->cache);
+  Py_XDECREF(t->frag);
   delete t;
 }
 
@@ -371,7 +373,7 @@ PyObject *table_new(PyObject *, PyObject *args) {
   auto t = new DecodeTable();
   t->tok.obj = t->min_depth.obj = t->flags.obj = nullptr;
   t->offsets.obj = t->kinds.obj = nullptr;
-  t->keys = t->cids = t->subs = t->cache = nullptr;
+  t->keys = t->cids = t->subs = t->cache = t->frag = nullptr;
   PyObject *capsule = PyCapsule_New(t, "maxmq_decode.table",
                                     table_destroy);
   if (!capsule) {
@@ -409,7 +411,8 @@ PyObject *table_new(PyObject *, PyObject *args) {
   t->cids = Py_NewRef(cids);
   t->subs = Py_NewRef(subs);
   t->cache = PyDict_New();
-  if (!t->cache) return fail(nullptr);
+  t->frag = PyDict_New();
+  if (!t->cache || !t->frag) return fail(nullptr);
   t->key.resize(t->A);
   t->cid.resize(t->A);
   t->sub.resize(t->A);
@@ -502,6 +505,52 @@ int apply_row_actions(DecodeTable *t, SubSetObject *res, int64_t r) {
   return 0;
 }
 
+// pairs held by one set (for the cache budget)
+Py_ssize_t subset_pairs(SubSetObject *res) {
+  Py_ssize_t pairs = PyDict_GET_SIZE(res->subscriptions);
+  PyObject *gk, *gv;
+  for (Py_ssize_t pos = 0; PyDict_Next(res->shared, &pos, &gk, &gv);)
+    pairs += PyDict_GET_SIZE(gv);
+  return pairs;
+}
+
+// build-or-fetch the single-row fragment for row r; BORROWED reference
+// (owned by t->frag). Fragments are reused across topics even when
+// their row-set combinations differ, so a multi-row cache miss costs a
+// dict copy + the smaller rows' inserts instead of a full rebuild.
+SubSetObject *fragment_for_row(DecodeTable *t, int32_t r) {
+  PyObject *rk = PyLong_FromLong(r);
+  if (!rk) return nullptr;
+  PyObject *hit = PyDict_GetItemWithError(t->frag, rk);
+  if (hit) {
+    Py_DECREF(rk);
+    return reinterpret_cast<SubSetObject *>(hit);
+  }
+  if (PyErr_Occurred()) {
+    Py_DECREF(rk);
+    return nullptr;
+  }
+  auto *res = subset_new_fast(nullptr, nullptr);
+  if (!res || apply_row_actions(t, res, r) < 0) {
+    Py_DECREF(rk);
+    Py_XDECREF(res);
+    return nullptr;
+  }
+  const Py_ssize_t pairs = subset_pairs(res);
+  if (t->cache_pairs + pairs > kDecodeCachePairsCap) {
+    PyDict_Clear(t->cache);
+    PyDict_Clear(t->frag);
+    t->cache_pairs = 0;
+  }
+  const int rc = PyDict_SetItem(t->frag, rk,
+                                reinterpret_cast<PyObject *>(res));
+  Py_DECREF(rk);
+  Py_DECREF(res);  // t->frag holds the ref; borrowed below
+  if (rc < 0) return nullptr;
+  t->cache_pairs += pairs;
+  return res;
+}
+
 // build-or-fetch the merged SubscriberSet for one verified, sorted,
 // deduped row set; returns a NEW reference (cached object shared across
 // topics — callers treat results as immutable, deep_copy before
@@ -521,24 +570,82 @@ PyObject *cached_rowset_result(DecodeTable *t, const int32_t *rows,
     Py_DECREF(key);
     return nullptr;
   }
-  auto *res = subset_new_fast(nullptr, nullptr);
-  if (!res) {
+  // base the union on the FATTEST row: its fragment is bulk-copied
+  // (PyDict_Copy clones the hash table without re-hashing) while the
+  // other rows replay per-entry. On fan-out-heavy corpora one shallow
+  // '#'-bucket row carries hundreds of entries and the rest a handful,
+  // so base choice is the difference between a memcpy-ish copy and
+  // hundreds of dict inserts per topic. Merge-order effects are
+  // confined to which overlapping filter donates the RAP/RH flags —
+  // arbitrary in the reference too (its trie iteration order); qos is
+  // max and identifier union is commutative.
+  const auto *off_b = static_cast<const int64_t *>(t->offsets.buf);
+  Py_ssize_t bi = 0;
+  for (Py_ssize_t i = 1; i < n_rows; i++)
+    if (off_b[rows[i] + 1] - off_b[rows[i]] >
+        off_b[rows[bi] + 1] - off_b[rows[bi]])
+      bi = i;
+  SubSetObject *res;
+  SubSetObject *base = fragment_for_row(t, rows[bi]);
+  if (!base) {
     Py_DECREF(key);
     return nullptr;
   }
-  for (Py_ssize_t i = 0; i < n_rows; i++) {
-    if (apply_row_actions(t, res, rows[i]) < 0) {
+  if (n_rows == 1) {
+    res = reinterpret_cast<SubSetObject *>(
+        Py_NewRef(reinterpret_cast<PyObject *>(base)));
+  } else {
+    // union = copy of the base fragment + the remaining rows' action
+    // streams. Inner shared-group dicts must be copied too —
+    // apply_row_actions mutates them on group collisions and
+    // fragments are shared.
+    PyObject *subs = PyDict_Copy(base->subscriptions);
+    PyObject *shared =
+        PyDict_GET_SIZE(base->shared) ? PyDict_Copy(base->shared)
+                                      : nullptr;
+    if (!subs || (PyDict_GET_SIZE(base->shared) && !shared)) {
+      Py_XDECREF(subs);
+      Py_XDECREF(shared);
       Py_DECREF(key);
-      Py_DECREF(res);
       return nullptr;
     }
+    if (shared) {
+      PyObject *gk, *gv;
+      for (Py_ssize_t pos = 0; PyDict_Next(shared, &pos, &gk, &gv);) {
+        PyObject *cp = PyDict_Copy(gv);
+        if (!cp || PyDict_SetItem(shared, gk, cp) < 0) {
+          Py_XDECREF(cp);
+          Py_DECREF(subs);
+          Py_DECREF(shared);
+          Py_DECREF(key);
+          return nullptr;
+        }
+        Py_DECREF(cp);
+      }
+    }
+    res = subset_new_fast(subs, shared);
+    Py_DECREF(subs);
+    Py_XDECREF(shared);
+    if (!res) {
+      Py_DECREF(key);
+      return nullptr;
+    }
+    for (Py_ssize_t i = 0; i < n_rows; i++) {
+      if (i == bi) continue;  // the base fragment already carries it
+      if (apply_row_actions(t, res, rows[i]) < 0) {
+        Py_DECREF(key);
+        Py_DECREF(res);
+        return nullptr;
+      }
+    }
   }
-  Py_ssize_t pairs = PyDict_GET_SIZE(res->subscriptions);
-  PyObject *gk, *gv;
-  for (Py_ssize_t pos = 0; PyDict_Next(res->shared, &pos, &gk, &gv);)
-    pairs += PyDict_GET_SIZE(gv);
+  // a single-row result ALIASES its fragment, whose pairs were already
+  // charged by fragment_for_row — charging again would burn the budget
+  // at half rate and evict the fragment the moment it was built
+  const Py_ssize_t pairs = n_rows == 1 ? 0 : subset_pairs(res);
   if (t->cache_pairs + pairs > kDecodeCachePairsCap) {
     PyDict_Clear(t->cache);
+    PyDict_Clear(t->frag);
     t->cache_pairs = 0;
   }
   int rc = PyDict_SetItem(t->cache, key, reinterpret_cast<PyObject *>(res));
