@@ -532,3 +532,26 @@ def test_retained_churn_never_recompiles():
     assert idx.sub_version == v          # retained does not bump
     engine.refresh()
     assert engine.tables.version == v    # and never forces a recompile
+
+
+def test_fixed_path_bucket_ladder_parity():
+    """dispatch_fixed pads the batch axis to a sparse bucket ladder (16,
+    powers of 4 to 4096, powers of 2 beyond). Batch sizes straddling the
+    ladder edges must decode identically to the trie — pad rows are
+    depth-1 '$'-topics that may match nothing (round-3 bucketing)."""
+    rng = random.Random(11)
+    filters, _ = rand_corpus(rng, 300, 40)
+    idx = TopicIndex()
+    for i, f in enumerate(filters):
+        idx.subscribe(f"cl-{i % 40}", Subscription(filter=f, qos=i % 3))
+    engine = SigEngine(idx, auto_refresh=False)
+    alphabet = [f"t{i}" for i in range(8)]
+    for size in (1, 15, 16, 17, 63, 64, 65, 255, 257):
+        topics = ["/".join(rng.choice(alphabet)
+                           for _ in range(rng.randint(1, 5)))
+                  for _ in range(size)]
+        got = engine.subscribers_fixed_batch(topics)
+        assert len(got) == size
+        for topic, result in zip(topics, got):
+            want = idx.subscribers(topic)
+            assert normalize(result) == normalize(want), (size, topic)
