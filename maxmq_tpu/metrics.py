@@ -257,3 +257,21 @@ def register_broker_metrics(registry: Registry, broker) -> None:
             "maxmq_matcher_fallbacks_total",
             "Topic matches that overflowed to the CPU trie fallback",
             lambda: matcher.fallbacks)
+        if hasattr(matcher, "batches"):
+            registry.counter_func(
+                "maxmq_matcher_batches_total",
+                "Device micro-batches dispatched",
+                lambda: matcher.batches)
+            registry.gauge_func(
+                "maxmq_matcher_largest_batch",
+                "Largest micro-batch formed since start",
+                lambda: matcher.largest_batch)
+    if matcher is not None:
+        # ANY attached matcher drives the ADR-006 pipeline; scrapes run
+        # on the metrics thread while close() may null the queue on the
+        # event loop, so bind the queue reference exactly once per read
+        registry.gauge_func(
+            "maxmq_broker_publish_pipeline_depth",
+            "Publishes queued awaiting in-order fan-out (ADR 006)",
+            lambda: (q.qsize()
+                     if (q := broker._pub_queue) is not None else 0))
