@@ -267,8 +267,9 @@ async def main() -> None:
                     "    from maxmq_tpu.matching.sig import SigEngine\n"
                     "    from maxmq_tpu.matching.batcher import "
                     "MicroBatcher\n"
-                    "    b.attach_matcher(MicroBatcher("
-                    "SigEngine(b.topics)))\n")
+                    "    eng = SigEngine(b.topics)\n"
+                    "    eng.warm_buckets(256, background=False)\n"
+                    "    b.attach_matcher(MicroBatcher(eng))\n")
         script = (
             "import asyncio, os, sys\n"
             f"sys.path.insert(0, {REPO!r})\n"

@@ -97,6 +97,9 @@ def build_matcher(conf: Config, broker: Broker):
                            window_us=conf.matcher_batch_window_us,
                            max_batch=conf.matcher_max_batch)
     broker.attach_matcher(batcher)
+    warm = getattr(engine, "warm_buckets", None)
+    if warm is not None:
+        warm(conf.matcher_max_batch)    # background bucket precompile
     return batcher
 
 

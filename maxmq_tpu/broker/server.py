@@ -107,10 +107,10 @@ class Broker:
         self._sys_task: asyncio.Task | None = None
         self._will_delays: dict[str, tuple[float, Packet]] = {}
         self._retained_expiry: list[tuple[float, str]] = []
-        # topic -> (sub_version, SubscriberSet): publish topics repeat
-        # heavily, and a trie walk costs ~20us; entries self-invalidate
-        # on any subscription change (version check), FIFO-bounded
-        self._match_cache: dict[str, tuple[int, SubscriberSet]] = {}
+        # publish topics repeat heavily, and a trie walk costs ~20us;
+        # entries self-invalidate on any subscription change
+        from ..matching.trie import VersionedTopicCache
+        self._match_cache = VersionedTopicCache()
         # matcher-mode publish pipeline: (match future, origin, packet)
         # consumed in arrival order, so per-publisher delivery order holds
         # [MQTT-4.6.0] while many publishes ride the device concurrently
@@ -564,14 +564,11 @@ class Broker:
         # safe even with on_select_subscribers hooks installed: _fan_out
         # deep-copies the set before the only mutating hook sees it
         version = self.topics.sub_version
-        hit = self._match_cache.get(topic)
-        if hit is not None and hit[0] == version:
-            return hit[1]
+        hit = self._match_cache.get(topic, version)
+        if hit is not None:
+            return hit
         result = self.topics.subscribers(topic)
-        cache = self._match_cache
-        if len(cache) >= 8192:
-            cache.pop(next(iter(cache)))
-        cache[topic] = (version, result)
+        self._match_cache.put(topic, version, result)
         return result
 
     def _ack_publish(self, client: Client, packet: Packet, success: bool) -> None:

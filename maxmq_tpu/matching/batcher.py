@@ -49,6 +49,12 @@ class MicroBatcher:
         # while batch N decodes (same depth the bench pipelines at).
         self.pipeline_depth = max(1, pipeline_depth)
         self._pending: list[tuple[str, asyncio.Future]] = []
+        # the matcher-mode analog of the broker's trie-path match cache:
+        # hot topics repeat, and a version-keyed hit skips tokenize +
+        # device round trip entirely
+        from .trie import VersionedTopicCache
+        self._cache = VersionedTopicCache()
+        self.cache_hits = 0
         self._wakeup: asyncio.Event | None = None
         self._dispatcher: asyncio.Task | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
@@ -101,9 +107,23 @@ class MicroBatcher:
         if self._dispatcher is None or self._loop is not loop:
             self._start(loop)
         fut: asyncio.Future = loop.create_future()
+        hit = self._cache.get(topic, self._subs_version())
+        if hit is not None:
+            self.cache_hits += 1
+            fut.set_result(hit)
+            return fut
         self._pending.append((topic, fut))
         self._wakeup.set()
         return fut
+
+    def _subs_version(self) -> int:
+        from .trie import subs_version
+
+        return subs_version(self.engine.index)
+
+    def _fill_cache(self, version: int, batch, results) -> None:
+        for (topic, _), result in zip(batch, results):
+            self._cache.put(topic, version, result)
 
     async def subscribers_async(self, topic: str) -> "SubscriberSet":
         """Queue one match; resolves when its micro-batch returns."""
@@ -165,12 +185,13 @@ class MicroBatcher:
             self.batches += 1
             self.batched_topics += len(batch)
             self.largest_batch = max(self.largest_batch, len(batch))
+            ver = self._subs_version()   # results valid as-of dispatch
             if split:
-                await self._dispatch_pipelined(loop, batch, topics)
+                await self._dispatch_pipelined(loop, batch, topics, ver)
             else:
-                await self._run_whole_batch(loop, batch, topics)
+                await self._run_whole_batch(loop, batch, topics, ver)
 
-    async def _run_whole_batch(self, loop, batch, topics) -> None:
+    async def _run_whole_batch(self, loop, batch, topics, ver) -> None:
         try:
             # worker thread: overlap device time with the event loop
             results = await loop.run_in_executor(
@@ -180,11 +201,12 @@ class MicroBatcher:
                 if not fut.done():
                     fut.set_exception(exc)
             return
+        self._fill_cache(ver, batch, results)
         for (_, fut), result in zip(batch, results):
             if not fut.done():
                 fut.set_result(result)
 
-    async def _dispatch_pipelined(self, loop, batch, topics) -> None:
+    async def _dispatch_pipelined(self, loop, batch, topics, ver) -> None:
         """Dispatch now, collect in a bounded background task: up to
         ``pipeline_depth`` batches ride the device/link concurrently, so
         a queued request no longer waits out the FULL round trip of the
@@ -203,13 +225,14 @@ class MicroBatcher:
             # its CPU-trie fallback semantics — never fail the callers
             # for a condition the engine degrades through
             self._inflight.release()
-            await self._run_whole_batch(loop, batch, topics)
+            await self._run_whole_batch(loop, batch, topics, ver)
             return
-        task = loop.create_task(self._collect(loop, batch, topics, ctx))
+        task = loop.create_task(
+            self._collect(loop, batch, topics, ctx, ver))
         self._collects.add(task)
         task.add_done_callback(self._collects.discard)
 
-    async def _collect(self, loop, batch, topics, ctx) -> None:
+    async def _collect(self, loop, batch, topics, ctx, ver) -> None:
         try:
             results = await loop.run_in_executor(
                 None, self.engine.collect_fixed, topics, ctx)
@@ -222,8 +245,9 @@ class MicroBatcher:
         finally:
             self._inflight.release()
         if results is None:
-            await self._run_whole_batch(loop, batch, topics)
+            await self._run_whole_batch(loop, batch, topics, ver)
             return
+        self._fill_cache(ver, batch, results)
         for (_, fut), result in zip(batch, results):
             if not fut.done():
                 fut.set_result(result)

@@ -892,6 +892,20 @@ def prepare_batch(tables, topics: list[str]):
     return toks, lens_enc, hostrows
 
 
+def _batch_bucket(b: int) -> int:
+    """Batch-axis bucket ladder (ADR 006): 16, powers of FOUR to 4096,
+    powers of two beyond. Each bucket shape costs one XLA compile per
+    table version and broker micro-batches vary, so the sparse ladder
+    trades ≤3x padding for ~3 compiles total. warm_buckets MUST walk
+    the same ladder — keep both on this one function."""
+    if b <= 16:
+        return 16
+    n = (b - 1).bit_length()
+    if b <= 4096:
+        return 1 << (n + (n & 1))
+    return 1 << n
+
+
 _STREAM_CHUNK = 1 << 19    # rows per stream-slice fetch (2 MB of uint32).
                            # Slice bounds are static multiples of this, so
                            # every slice shape compiles exactly once and
@@ -1197,6 +1211,12 @@ class OverlayedEngine:
     def _bg_refresh(self) -> None:
         try:
             self.refresh()
+            # a rotation swaps in a fresh jitted program: re-warm the
+            # bucket ladder (still on this background thread) so the
+            # next real batches don't pay the per-shape compiles again
+            warm_max = getattr(self, "_warm_max", None)
+            if warm_max:
+                self.warm_buckets(warm_max, background=False)
         except Exception:
             self.bg_refresh_errors += 1
         finally:
@@ -1591,16 +1611,7 @@ class SigEngine(OverlayedEngine):
         # can equal the reserved pad token, so pads match nothing and add
         # nothing to the row stream (which is topic-sorted anyway).
         b = len(topics)
-        if b <= 16:
-            bucket = 16
-        elif b <= 4096:
-            # powers of FOUR here: each bucket shape costs one XLA
-            # compile per table version, and broker micro-batches vary —
-            # a sparser ladder trades ≤3x padding for 3 compiles total
-            n = (b - 1).bit_length()
-            bucket = 1 << (n + (n & 1))
-        else:
-            bucket = 1 << (b - 1).bit_length()
+        bucket = _batch_bucket(b)
         if bucket != b:
             _dt, padval = _compact_dtype(tables)
             tp = np.full((bucket, *toks8.shape[1:]), padval,
@@ -1856,6 +1867,35 @@ class SigEngine(OverlayedEngine):
 
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(None, self.subscribers, topic)
+
+    def warm_buckets(self, max_batch: int = 4096,
+                     background: bool = True) -> None:
+        """Precompile the fixed program at the broker-relevant bucket
+        shapes (the dispatch_fixed ladder up to ``max_batch``), so the
+        first real publishes never pay a multi-second XLA compile. The
+        warm topic is a '$'-prefixed dummy that matches nothing."""
+        self._warm_max = max_batch      # re-warmed after each rotation
+        sizes, b = [], 16
+        while b < max_batch:
+            sizes.append(b)
+            b = _batch_bucket(b + 1)    # the exact dispatch ladder
+        sizes.append(_batch_bucket(max_batch))
+
+        def _warm():
+            hint = self._stream_rows_hint   # zero-match warm batches
+            for size in sizes:              # must not poison the EMA
+                try:
+                    ctx = self.dispatch_fixed(["$maxmq/warm"] * size)
+                    self.match_fixed([], out=ctx)   # block until compiled
+                except Exception:
+                    return              # trie-only corpus / shutdown race
+                finally:
+                    self._stream_rows_hint = hint
+        if background:
+            threading.Thread(target=_warm, daemon=True,
+                             name="sig-warm").start()
+        else:
+            _warm()
 
     @staticmethod
     def _add_row(result: SubscriberSet, row: int, tables: SigTables,

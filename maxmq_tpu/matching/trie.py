@@ -28,6 +28,36 @@ def subs_version(index) -> int:
     return v if v is not None else getattr(index, "version", 0)
 
 
+class VersionedTopicCache:
+    """FIFO-bounded topic -> result cache keyed on a subscription
+    version: any subscribe/unsubscribe bumps the version and silently
+    invalidates every entry. Shared by the broker's trie-path match
+    cache and the MicroBatcher's matcher-mode cache — cached results
+    are SHARED objects; consumers must treat them as immutable and
+    deep_copy before mutating."""
+
+    __slots__ = ("_cache", "maxsize")
+
+    def __init__(self, maxsize: int = 8192) -> None:
+        self._cache: dict[str, tuple[int, object]] = {}
+        self.maxsize = maxsize
+
+    def get(self, topic: str, version: int):
+        hit = self._cache.get(topic)
+        if hit is not None and hit[0] == version:
+            return hit[1]
+        return None
+
+    def put(self, topic: str, version: int, result) -> None:
+        cache = self._cache
+        if topic not in cache and len(cache) >= self.maxsize:
+            cache.pop(next(iter(cache)))
+        cache[topic] = (version, result)
+
+    def __len__(self) -> int:
+        return len(self._cache)
+
+
 def merge_subscription(base: Subscription | None, new: Subscription,
                        filter_: str) -> Subscription:
     """Merge overlapping matching filters for one client: max QoS wins, v5

@@ -266,6 +266,11 @@ def register_broker_metrics(registry: Registry, broker) -> None:
                 "maxmq_matcher_largest_batch",
                 "Largest micro-batch formed since start",
                 lambda: matcher.largest_batch)
+        if hasattr(matcher, "cache_hits"):
+            registry.counter_func(
+                "maxmq_matcher_cache_hits_total",
+                "Matches served from the version-keyed topic cache",
+                lambda: matcher.cache_hits)
     if matcher is not None:
         # ANY attached matcher drives the ADR-006 pipeline; scrapes run
         # on the metrics thread while close() may null the queue on the

@@ -217,3 +217,34 @@ async def test_pipelined_dispatch_refusal_falls_back_to_whole_batch():
         assert sorted(results) == sorted(f"trie:f/{i}" for i in range(6))
     finally:
         await batcher.close()
+
+
+async def test_enqueue_cache_hits_and_version_invalidation():
+    """Matcher-mode match cache: repeated topics resolve without a
+    device round trip; any subscription change (sub_version bump)
+    invalidates (ADR 006 observability: cache_hits)."""
+    from maxmq_tpu.protocol import Subscription
+
+    class Counting(SplitEngine):
+        def __init__(self):
+            super().__init__(collect_s=0.0)
+            self.dispatched = 0
+
+        def dispatch_fixed(self, topics):
+            self.dispatched += len(topics)
+            return ("ctx", list(topics))
+
+    eng = Counting()
+    batcher = MicroBatcher(eng, window_us=0, max_batch=8)
+    try:
+        r1 = await batcher.subscribers_async("hot/a")
+        r2 = await batcher.subscribers_async("hot/a")   # cache hit
+        assert r1 == r2 == "r:hot/a"
+        assert batcher.cache_hits == 1
+        assert eng.dispatched == 1
+        # a subscription change must invalidate the cached result
+        eng.index.subscribe("c1", Subscription(filter="hot/a"))
+        await batcher.subscribers_async("hot/a")
+        assert eng.dispatched == 2
+    finally:
+        await batcher.close()
