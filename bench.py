@@ -104,10 +104,13 @@ def run_sig(engine, batches, depth: int):
     def drain_one():
         nonlocal matched, overflow
         out = pending.popleft()
-        cnt, _rows, hostrows, _t = engine.match_fixed([], out=out)
+        cnt, hostrows, _t = engine.counts_fixed(out)
         ovf = cnt == 15
         overflow += int(ovf.sum())
-        matched += int(cnt[~ovf].sum()) + sum(len(h) for h in hostrows)
+        off = getattr(hostrows, "offsets", None)   # CSR fast path: the
+        n_host = (int(off[-1]) if off is not None  # per-topic iteration
+                  else sum(len(h) for h in hostrows))   # costs ~1us/topic
+        matched += int(cnt[~ovf].sum()) + n_host
 
     for topics in batches:
         pending.append(engine.dispatch_fixed(topics))

@@ -18,7 +18,8 @@ from dataclasses import dataclass, field
 
 from ..hooks.base import Hook, Hooks, RejectPacket
 from ..matching.topics import valid_filter, valid_topic_name
-from ..matching.trie import SubscriberSet, TopicIndex
+from ..matching.trie import (SubscriberSet, TopicIndex,
+                             VersionedTopicCache)
 from ..protocol import codes
 from ..protocol.codec import (FixedHeader, MalformedPacketError,
                               PacketType as PT, write_varint)
@@ -109,7 +110,6 @@ class Broker:
         self._retained_expiry: list[tuple[float, str]] = []
         # publish topics repeat heavily, and a trie walk costs ~20us;
         # entries self-invalidate on any subscription change
-        from ..matching.trie import VersionedTopicCache
         self._match_cache = VersionedTopicCache()
         # matcher-mode publish pipeline: (match future, origin, packet)
         # consumed in arrival order, so per-publisher delivery order holds

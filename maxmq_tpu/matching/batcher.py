@@ -26,6 +26,8 @@ import asyncio
 import threading
 from typing import TYPE_CHECKING
 
+from .trie import VersionedTopicCache, subs_version
+
 if TYPE_CHECKING:
     from .trie import SubscriberSet
 
@@ -52,7 +54,6 @@ class MicroBatcher:
         # the matcher-mode analog of the broker's trie-path match cache:
         # hot topics repeat, and a version-keyed hit skips tokenize +
         # device round trip entirely
-        from .trie import VersionedTopicCache
         self._cache = VersionedTopicCache()
         self.cache_hits = 0
         self._wakeup: asyncio.Event | None = None
@@ -117,8 +118,6 @@ class MicroBatcher:
         return fut
 
     def _subs_version(self) -> int:
-        from .trie import subs_version
-
         return subs_version(self.engine.index)
 
     def _fill_cache(self, version: int, batch, results) -> None:

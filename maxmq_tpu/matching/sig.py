@@ -1201,12 +1201,13 @@ class OverlayedEngine:
             self.index.sub_version
 
     def close(self, timeout: float = 30.0) -> None:
-        """Wait for an in-flight background recompile. Killing the
-        interpreter while a compile runs inside the runtime library
-        aborts the process; joining here keeps shutdown clean."""
-        t = self._bg_thread
-        if t is not None and t.is_alive():
-            t.join(timeout)
+        """Wait for in-flight background compiles (refresh AND bucket
+        warm). Killing the interpreter while a compile runs inside the
+        runtime library aborts the process; joining here keeps shutdown
+        clean."""
+        for t in (self._bg_thread, getattr(self, "_warm_thread", None)):
+            if t is not None and t.is_alive():
+                t.join(timeout)
 
     def _bg_refresh(self) -> None:
         try:
@@ -1560,6 +1561,23 @@ class SigEngine(OverlayedEngine):
             rows = o[:, 1:1 + self.fixed_max_rows]
         return cnt, rows, hostrows, tables
 
+    def counts_fixed(self, out):
+        """Counts + host CSR of a dispatched fixed batch WITHOUT
+        materializing the [B, max_rows] row matrix (pipelined raw
+        consumers count matches; only decode needs rows). The stream
+        format still fetches the full row stream — the honest link
+        cost — it just skips the 15MB-per-batch matrix scatter."""
+        out, hostrows, tables, fmt = out[:4]
+        if fmt["kind"] == "stream":
+            cnt, _real, _flat = self._fetch_stream(out)
+            return cnt, hostrows, tables
+        o = np.asarray(out)
+        if fmt["kind"] == "fmt16":
+            cnt = (o[:, 0] >> 28).astype(np.int32)
+        else:
+            cnt = o[:, 0].astype(np.int32)
+        return cnt, hostrows, tables
+
     def _fetch_stream(self, out):
         """Fetch the stream wire format to host: (cnt int32[B] with 15 =
         overflow, real int64[B] true per-topic counts, flat uint32[total]
@@ -1882,18 +1900,22 @@ class SigEngine(OverlayedEngine):
         sizes.append(_batch_bucket(max_batch))
 
         def _warm():
-            hint = self._stream_rows_hint   # zero-match warm batches
-            for size in sizes:              # must not poison the EMA
+            for size in sizes:
                 try:
                     ctx = self.dispatch_fixed(["$maxmq/warm"] * size)
-                    self.match_fixed([], out=ctx)   # block until compiled
+                    # block on the raw device output directly — going
+                    # through _fetch_stream would fold this zero-match
+                    # batch into the stream-prefetch EMA hint
+                    out = ctx[0]
+                    head = out[0] if isinstance(out, tuple) else out
+                    np.asarray(head)
                 except Exception:
                     return              # trie-only corpus / shutdown race
-                finally:
-                    self._stream_rows_hint = hint
         if background:
-            threading.Thread(target=_warm, daemon=True,
-                             name="sig-warm").start()
+            t = threading.Thread(target=_warm, daemon=True,
+                                 name="sig-warm")
+            self._warm_thread = t
+            t.start()
         else:
             _warm()
 
