@@ -258,3 +258,36 @@ async def test_publish_pipeline_resets_on_close():
         assert m.topic == "r/1"
         assert broker._pub_consumer is not None
     assert broker._pub_consumer is None and broker._pub_queue is None
+
+
+class _ScrambledMatcher:
+    """Matcher whose results resolve in RANDOM order: the publish
+    pipeline must still fan out in arrival order [MQTT-4.6.0]."""
+
+    def __init__(self, index):
+        self.index = index
+        import random
+        self._rng = random.Random(3)
+
+    async def subscribers_async(self, topic):
+        import asyncio
+        await asyncio.sleep(self._rng.random() * 0.02)
+        return self.index.subscribers(topic)
+
+
+async def test_publish_pipeline_preserves_publish_order():
+    from test_broker_system import connect, running_broker
+
+    async with running_broker() as broker:
+        broker.attach_matcher(_ScrambledMatcher(broker.topics))
+        sub = await connect(broker, "ord-sub")
+        await sub.subscribe(("seq/#", 0))
+        pub = await connect(broker, "ord-pub")
+        n = 40
+        for i in range(n):
+            await pub.publish(f"seq/{i}", str(i).encode())
+        got = [await sub.next_message(timeout=10) for _ in range(n)]
+        assert [int(m.payload) for m in got] == list(range(n)), \
+            "deliveries out of publish order"
+        await sub.disconnect()
+        await pub.disconnect()
