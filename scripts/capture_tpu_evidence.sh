@@ -1,0 +1,27 @@
+#!/bin/sh
+# One-shot TPU evidence capture for round 3 (run when the tunnel is alive):
+#   1. integrated broker A/B at 100K subs (trie, then sig+MicroBatcher)
+#   2. the 1M-sub headline config with a wider batch (device-only focus)
+# Appends raw JSON lines to /tmp/capture_r03.out; the caller curates into
+# BASELINE-COMPARE.md / BENCH_SELF_r03*.json.
+set -x
+cd "$(dirname "$0")/.." || exit 1
+OUT=/tmp/capture_r03.out
+: > "$OUT"
+
+timeout 60 python -c "import jax.numpy as j; print(j.arange(8).sum())" || {
+    echo '{"error": "tunnel wedged at capture start"}' >> "$OUT"; exit 2; }
+
+echo "=== matchbench trie ===" >> "$OUT"
+timeout 900 python benchmarks/e2e_broker.py --matchbench 100000 \
+    --matcher trie >> "$OUT" 2>/tmp/cap_trie.err
+
+echo "=== matchbench sig ===" >> "$OUT"
+timeout 1800 python benchmarks/e2e_broker.py --matchbench 100000 \
+    --matcher sig >> "$OUT" 2>/tmp/cap_sig.err
+
+echo "=== 1M config, batch 524288 ===" >> "$OUT"
+MAXMQ_BENCH_CONFIGS=4 MAXMQ_BENCH_BATCH=524288 MAXMQ_BENCH_ITERS=3 \
+    timeout 2400 python bench.py >> "$OUT" 2>/tmp/cap_1m.err
+
+tail -c 2000 "$OUT"
