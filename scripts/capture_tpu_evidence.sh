@@ -22,6 +22,6 @@ timeout 1800 python benchmarks/e2e_broker.py --matchbench 100000 \
 
 echo "=== 1M config, batch 524288 ===" >> "$OUT"
 MAXMQ_BENCH_CONFIGS=4 MAXMQ_BENCH_BATCH=524288 MAXMQ_BENCH_ITERS=3 \
-    timeout 2400 python bench.py >> "$OUT" 2>/tmp/cap_1m.err
+    timeout 3100 python bench.py >> "$OUT" 2>/tmp/cap_1m.err
 
 tail -c 2000 "$OUT"
