@@ -360,10 +360,15 @@ class DenseEngine:
             self.refresh()
         tables, _consts, fn, _fn_many = self._state
         toks, lengths, dollar = tables.tokenize(topics, self.max_levels)
+        # bucket the batch axis: one XLA compile per ladder shape, not
+        # per distinct micro-batch size; per-topic outputs trim clean
+        from .topics import pad_topic_batch
+        b = len(topics)
+        toks, lengths, dollar = pad_topic_batch(toks, lengths, dollar)
         word_idx, word_val, overflow = fn(
             jnp.asarray(toks), jnp.asarray(lengths), jnp.asarray(dollar))
-        return (np.asarray(word_idx), np.asarray(word_val),
-                np.asarray(overflow), tables)
+        return (np.asarray(word_idx)[:b], np.asarray(word_val)[:b],
+                np.asarray(overflow)[:b], tables)
 
     def match_raw_many(self, batches: list[list[str]]):
         """Match a stack of equal-sized topic batches in one device

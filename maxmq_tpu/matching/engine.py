@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from .nfa import MAX_PROBES, NFATables, compile_trie, hash32
+from .topics import pad_topic_batch
 from .trie import SubscriberSet, TopicIndex, subs_version
 
 _I32_MAX = np.int32(np.iinfo(np.int32).max)
@@ -194,11 +195,15 @@ class NFAEngine:
             tables = self._tables
             dev = self._device_tables
         toks, lengths, dollar = tables.tokenize(topics, self.max_levels)
+        # bucket the batch axis: one XLA compile per ladder shape, not
+        # per distinct micro-batch size; per-topic outputs trim clean
+        b = len(topics)
+        toks, lengths, dollar = pad_topic_batch(toks, lengths, dollar)
         rows, overflow = match_batch_device(
             *dev, jnp.asarray(toks), jnp.asarray(lengths),
             jnp.asarray(dollar), width=self.width,
             table_mask=tables.table_size - 1, max_rows=self.max_rows)
-        return np.asarray(rows), np.asarray(overflow), tables
+        return np.asarray(rows)[:b], np.asarray(overflow)[:b], tables
 
     def subscribers_batch(self, topics: list[str]) -> list[SubscriberSet]:
         rows, overflow, tables = self.match_raw(topics)

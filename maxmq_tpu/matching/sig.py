@@ -49,8 +49,9 @@ import jax.numpy as jnp
 
 from .dense import extract_nonzero_words
 from .nfa import Entry, EntryBuilder
-from .topics import (filter_matches_topic, intern_level, split_levels,
-                     tokenize_cached, tokenize_topics)
+from .topics import (batch_bucket as _batch_bucket, filter_matches_topic,
+                     intern_level, split_levels, tokenize_cached,
+                     tokenize_topics)
 from .trie import SubscriberSet, TopicIndex, merge_subscription
 
 MAX_GROUPS = 4096   # compile guard: pathological corpora fall back (engine)
@@ -890,20 +891,6 @@ def prepare_batch(tables, topics: list[str]):
     hostrows = host_exact_rows_from_sig(tables, esig, lengths)
     host_plus_rows(tables, toks, lengths, lens_enc < 0, into=hostrows)
     return toks, lens_enc, hostrows
-
-
-def _batch_bucket(b: int) -> int:
-    """Batch-axis bucket ladder (ADR 006): 16, powers of FOUR to 4096,
-    powers of two beyond. Each bucket shape costs one XLA compile per
-    table version and broker micro-batches vary, so the sparse ladder
-    trades ≤3x padding for ~3 compiles total. warm_buckets MUST walk
-    the same ladder — keep both on this one function."""
-    if b <= 16:
-        return 16
-    n = (b - 1).bit_length()
-    if b <= 4096:
-        return 1 << (n + (n & 1))
-    return 1 << n
 
 
 _STREAM_CHUNK = 1 << 19    # rows per stream-slice fetch (2 MB of uint32).

@@ -153,3 +153,37 @@ def tokenize_topics(vocab: dict[str, int], topics: list[str],
         for j, level in enumerate(levels):
             toks[i, j] = vocab.get(level, UNK)
     return toks, lengths, dollar
+
+
+def batch_bucket(b: int) -> int:
+    """Batch-axis bucket ladder shared by every device engine (ADR 006):
+    16, powers of FOUR to 4096, powers of two beyond. Each bucket shape
+    costs one XLA compile per table version and micro-batch sizes vary,
+    so the sparse ladder trades ≤3x padding for ~3 compiles total.
+    SigEngine.warm_buckets MUST walk this same ladder."""
+    if b <= 16:
+        return 16
+    n = (b - 1).bit_length()
+    if b <= 4096:
+        return 1 << (n + (n & 1))
+    return 1 << n
+
+
+def pad_topic_batch(toks, lengths, dollar):
+    """Pad a tokenized batch (toks [B, L] int, lengths [B], dollar [B])
+    to its bucket with depth-0 rows (toks -1, length 0, dollar False) —
+    per-topic outputs trim clean with ``[:B]``. Returns the (possibly
+    padded) triple; numpy-only, usable from any engine."""
+    import numpy as np
+
+    b = len(lengths)
+    bucket = batch_bucket(b)
+    if bucket == b:
+        return toks, lengths, dollar
+    toks = np.concatenate(
+        [toks, np.full((bucket - b, toks.shape[1]), -1, dtype=toks.dtype)])
+    lengths = np.concatenate(
+        [lengths, np.zeros(bucket - b, dtype=lengths.dtype)])
+    dollar = np.concatenate(
+        [dollar, np.zeros(bucket - b, dtype=dollar.dtype)])
+    return toks, lengths, dollar
