@@ -62,8 +62,10 @@ def build_matcher(conf: Config, broker: Broker):
 
     ``trie`` is the CPU reference path (broker default, no attach needed);
     ``nfa``/``dense`` are the device paths; a ``matcher_mesh`` like "2x4"
-    shards the NFA over a device mesh (cluster mode)."""
-    if conf.matcher in ("", "trie"):
+    shards the NFA over a device mesh (cluster mode); ``service``
+    connects to an external chip-owning matcher service at
+    ``matcher_socket`` (attached in run_server — it needs the loop)."""
+    if conf.matcher in ("", "trie", "service"):
         return None
     if conf.matcher_mesh:
         from .parallel.sharded import (ShardedNFAEngine, ShardedSigEngine,
@@ -152,6 +154,14 @@ def new_logger_from_config(conf: Config) -> Logger:
                       log_id_gen=sf.next_id)
 
 
+async def _maybe_attach_service(conf: Config, broker: Broker) -> None:
+    """matcher = "service": connect to the external chip-owning matcher
+    (``maxmq matcher-service``) at conf.matcher_socket."""
+    if conf.matcher == "service":
+        from .matching.service import attach_matcher_service
+        await attach_matcher_service(broker, conf.matcher_socket)
+
+
 async def run_server(conf: Config, logger: Logger,
                      ready: asyncio.Event | None = None,
                      stop: asyncio.Event | None = None) -> None:
@@ -182,6 +192,7 @@ async def run_server(conf: Config, logger: Logger,
 
     if metrics is not None:
         metrics.start()
+    await _maybe_attach_service(conf, broker)
     await broker.serve()
     boot.info("server started", tcp=conf.mqtt_tcp_address,
               matcher=conf.matcher or "trie")

@@ -32,7 +32,6 @@ from __future__ import annotations
 import asyncio
 import json
 import os
-import struct
 import subprocess
 import sys
 import time
@@ -47,21 +46,7 @@ FRAME_TAKEOVER = 3      # json {w, cid}: session established elsewhere
 BUS_CLIENT_ID = "@bus"  # origin id carried by bus-injected publishes
 
 
-def _frame(ftype: int, payload: bytes) -> bytes:
-    return struct.pack(">IB", len(payload) + 1, ftype) + payload
-
-
-async def _read_frame(reader) -> tuple[int, bytes] | None:
-    try:
-        head = await reader.readexactly(5)
-    except (asyncio.IncompleteReadError, ConnectionError):
-        return None
-    length, ftype = struct.unpack(">IB", head)
-    try:
-        payload = await reader.readexactly(length - 1)
-    except (asyncio.IncompleteReadError, ConnectionError):
-        return None
-    return ftype, payload
+from ..utils.framing import frame as _frame, read_frame as _read_frame
 
 
 class FanoutBus:
@@ -404,6 +389,12 @@ async def run_worker(conf, logger, worker_id: int, bus_path: str,
     # bus first, listeners second: a client accepted before the bus is
     # connected would publish into a void
     await hook.attach(broker)
+    if conf.matcher == "service":
+        # pool workers share ONE chip-owning matcher service (ADR 005):
+        # every worker forwards its own clients' subscription ops and
+        # all workers' match requests coalesce on the service's batcher
+        from ..matching.service import attach_matcher_service
+        await attach_matcher_service(broker, conf.matcher_socket)
     await broker.serve()
     hook.announce()
     if metrics is not None:

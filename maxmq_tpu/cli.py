@@ -30,6 +30,13 @@ def make_parser() -> argparse.ArgumentParser:
                        help="write cpu.prof and heap.prof on shutdown")
     start.add_argument("--no-banner", action="store_true")
 
+    svc = sub.add_parser(
+        "matcher-service",
+        help="run the chip-owning matcher service (ADR 005/006): brokers "
+             "started with matcher = \"service\" connect to its socket")
+    svc.add_argument("--socket", "-s", default="/tmp/maxmq-matcher.sock",
+                     help="unix socket path to serve on")
+
     sub.add_parser("version", help="print version information")
     return parser
 
@@ -70,6 +77,26 @@ def cmd_start(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_matcher_service(args: argparse.Namespace) -> int:
+    async def run() -> None:
+        from .matching.service import MatcherService
+
+        svc = MatcherService(args.socket)
+        await svc.start()
+        print(f"matcher service on {args.socket}", file=sys.stderr,
+              flush=True)
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await svc.close()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = make_parser()
     args = parser.parse_args(argv)
@@ -77,6 +104,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_version()
     if args.command == "start":
         return cmd_start(args)
+    if args.command == "matcher-service":
+        return cmd_matcher_service(args)
     parser.print_help()
     return 0
 

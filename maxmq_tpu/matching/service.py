@@ -1,0 +1,356 @@
+"""Matcher service: one chip-owning process serving topic matches over a
+local socket (ADR 005's designed evolution, ADR 006's enqueue surface).
+
+Why a service: accelerator runtimes are single-claim — in an ADR-005
+worker pool only one process can own the TPU, and a broker restart would
+otherwise throw away compiled 1M-subscription tables. The service owns
+the index + SigEngine + MicroBatcher; any number of broker processes
+connect as clients, forward their subscription ops, and request matches.
+Requests from ALL clients coalesce into the same device micro-batches.
+
+Protocol (length-prefixed frames, ``>IB`` = len+type, same shape as the
+ADR-005 fan-out bus):
+
+  client -> server
+    OP_SUB    {"c": cid, "v": encoded Subscription}
+    OP_UNSUB  {"c": cid, "f": filter}     remove one subscription
+    OP_DROP   {"c": cid}                  remove every filter of a client
+    OP_MATCH  {"r": req_id, "t": [topics]}
+  server -> client
+    OP_RESULT {"r": req_id, "s": [encoded SubscriberSet per topic]}
+
+Ordering: ops and matches on one connection are processed in arrival
+order, so a client's own subscribe is always visible to its later
+matches. Cross-client visibility is bounded by op interleaving (same
+guarantee as the ADR-005 gossip).
+
+Parity surface: the reference keeps matching in-process
+(vendor/.../v2/server.go:766-793); the service is the TPU-native
+factoring — matching is stateless request/response over a compiled
+corpus, so it moves to where the chip is.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import os
+
+from ..hooks.base import Hook
+from ..protocol.packets import Subscription
+from ..utils.framing import frame as _frame, read_frame as _read_frame
+from .trie import SubscriberSet, TopicIndex
+
+OP_SUB = 1
+OP_UNSUB = 2
+OP_DROP = 3
+OP_MATCH = 4
+OP_RESULT = 5
+
+
+def _encode_sub(sub: Subscription) -> list:
+    return [sub.filter, sub.qos, int(sub.no_local),
+            int(sub.retain_as_published), sub.retain_handling,
+            sub.identifier, sub.identifiers]
+
+
+def _decode_sub(v: list) -> Subscription:
+    return Subscription(filter=v[0], qos=v[1], no_local=bool(v[2]),
+                        retain_as_published=bool(v[3]), retain_handling=v[4],
+                        identifier=v[5], identifiers=dict(v[6]))
+
+
+def encode_result(s) -> dict:
+    """SubscriberSet -> JSON-able dict (shared keys become 2-lists)."""
+    return {"s": {cid: _encode_sub(sub)
+                  for cid, sub in s.subscriptions.items()},
+            "g": [[g, f, {cid: _encode_sub(sub)
+                          for cid, sub in members.items()}]
+                  for (g, f), members in s.shared.items()]}
+
+
+def decode_result(d: dict) -> SubscriberSet:
+    return SubscriberSet(
+        subscriptions={cid: _decode_sub(v) for cid, v in d["s"].items()},
+        shared={(g, f): {cid: _decode_sub(v) for cid, v in members.items()}
+                for g, f, members in d["g"]})
+
+
+class MatcherService:
+    """The chip-owning server: index + engine + micro-batcher behind a
+    unix (or TCP) socket. ``engine_factory(index)`` builds the matcher —
+    defaults to MicroBatcher(SigEngine(index))."""
+
+    def __init__(self, path: str, engine_factory=None) -> None:
+        self.path = path
+        self.index = TopicIndex()
+        if engine_factory is None:
+            def engine_factory(index):
+                from .batcher import MicroBatcher
+                from .sig import SigEngine
+                return MicroBatcher(SigEngine(index))
+        self._factory = engine_factory
+        self.matcher = None               # built lazily on first serve
+        self._server: asyncio.Server | None = None
+        # cid -> filters, service-side: OP_DROP must not depend on the
+        # index exposing a per-client reverse map
+        self._client_filters: dict[str, set[str]] = {}
+        self._conns: set = set()        # live client writers
+        self.subs_applied = 0
+        self.matches_served = 0
+
+    async def start(self) -> None:
+        self.matcher = self._factory(self.index)
+        with contextlib.suppress(OSError):
+            os.unlink(self.path)    # stale socket from an unclean exit
+        self._server = await asyncio.start_unix_server(
+            self._serve, path=self.path)
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        for w in list(self._conns):     # established connections too —
+            w.close()                   # close() means STOP serving
+        if self._server is not None:
+            # 3.12 wait_closed() waits for connections as well, so they
+            # must be closed first or this deadlocks
+            await self._server.wait_closed()
+        with contextlib.suppress(OSError):
+            os.unlink(self.path)
+        close_fn = getattr(self.matcher, "close", None)
+        if close_fn is not None:
+            res = close_fn()
+            if asyncio.iscoroutine(res):
+                await res
+
+    async def _serve(self, reader, writer) -> None:
+        """One client connection: ops applied in arrival order; match
+        results may complete out of order (req ids pair them) while the
+        batcher coalesces topics across ALL connections."""
+        tasks: set[asyncio.Task] = set()
+        self._conns.add(writer)
+        try:
+            while True:
+                fr = await _read_frame(reader)
+                if fr is None:
+                    return
+                ftype, payload = fr
+                msg = json.loads(payload)
+                if ftype == OP_SUB:
+                    sub = _decode_sub(msg["v"])
+                    if self.index.subscribe(msg["c"], sub):
+                        self.subs_applied += 1
+                    self._client_filters.setdefault(
+                        msg["c"], set()).add(sub.filter)
+                elif ftype == OP_UNSUB:
+                    self.index.unsubscribe(msg["c"], msg["f"])
+                    self._client_filters.get(msg["c"], set()).discard(
+                        msg["f"])
+                elif ftype == OP_DROP:
+                    for filt in self._client_filters.pop(msg["c"], ()):
+                        self.index.unsubscribe(msg["c"], filt)
+                elif ftype == OP_MATCH:
+                    t = asyncio.ensure_future(
+                        self._match(msg["r"], msg["t"], writer))
+                    tasks.add(t)
+                    t.add_done_callback(tasks.discard)
+        finally:
+            self._conns.discard(writer)
+            for t in tasks:
+                t.cancel()
+            writer.close()
+
+    async def _match(self, req_id: int, topics: list[str], writer) -> None:
+        try:
+            enq = getattr(self.matcher, "enqueue", None)
+            if enq is not None:
+                results = await asyncio.gather(*(enq(t) for t in topics))
+            else:
+                results = await asyncio.gather(
+                    *(self.matcher.subscribers_async(t) for t in topics))
+            self.matches_served += len(topics)
+            out = {"r": req_id, "s": [encode_result(s) for s in results]}
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:
+            # the client MUST get a reply — a silent drop leaves its
+            # future (and that publish) pending forever; the broker
+            # degrades an errored match to its CPU trie
+            out = {"r": req_id, "e": repr(exc)[:300]}
+        writer.write(_frame(OP_RESULT, json.dumps(out).encode()))
+        try:
+            await writer.drain()
+        except (ConnectionError, OSError):
+            pass
+
+
+class ServiceMatcher:
+    """Drop-in broker matcher backed by a MatcherService socket: exposes
+    ``enqueue(topic) -> Future`` (the ADR-006 pipeline surface) plus
+    ``subscribers_async``, and forwards subscription ops. Attach with
+    ``attach_matcher_service(broker, path)`` so sub/unsub forwarding is
+    wired automatically."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._reader = None
+        self._writer = None
+        self._reader_task: asyncio.Task | None = None
+        self._reconnect_task: asyncio.Task | None = None
+        self._pending: dict[int, asyncio.Future] = {}
+        self._next_req = 0
+        self._connect_lock = asyncio.Lock()
+        # callable(matcher) replaying current subscription state after a
+        # reconnect (set by attach_matcher_service)
+        self._reseed = None
+
+    async def connect(self) -> None:
+        async with self._connect_lock:
+            if self._writer is not None:
+                return
+            self._reader, self._writer = await asyncio.open_unix_connection(
+                self.path)
+            self._reader_task = asyncio.ensure_future(self._read_loop())
+
+    async def close(self) -> None:
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+            try:
+                await self._reader_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self._writer is not None:
+            self._writer.close()
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.cancel()
+        self._pending.clear()
+
+    async def _read_loop(self) -> None:
+        while True:
+            fr = await _read_frame(self._reader)
+            if fr is None:
+                # connection lost: fail in-flight matches fast (the
+                # broker degrades them to its CPU trie) and mark the
+                # transport dead so enqueue() fails fast too
+                self._writer = None
+                for fut in self._pending.values():
+                    if not fut.done():
+                        fut.set_exception(
+                            ConnectionError("matcher service lost"))
+                self._pending.clear()
+                return
+            _ftype, payload = fr
+            msg = json.loads(payload)
+            fut = self._pending.pop(msg["r"], None)
+            if fut is None or fut.done():
+                continue
+            if "e" in msg:
+                fut.set_exception(RuntimeError(
+                    f"matcher service error: {msg['e']}"))
+            else:
+                fut.set_result(decode_result(msg["s"][0]))
+
+    def _send(self, ftype: int, msg: dict) -> None:
+        self._writer.write(_frame(ftype, json.dumps(msg).encode()))
+
+    # -- subscription forwarding (called by the attach hook) ----------
+    def forward_subscribe(self, cid: str, sub: Subscription) -> None:
+        self._send(OP_SUB, {"c": cid, "v": _encode_sub(sub)})
+
+    def forward_unsubscribe(self, cid: str, filter_: str) -> None:
+        self._send(OP_UNSUB, {"c": cid, "f": filter_})
+
+    def forward_drop(self, cid: str) -> None:
+        self._send(OP_DROP, {"c": cid})
+
+    # -- matcher surface ----------------------------------------------
+    def enqueue(self, topic: str) -> asyncio.Future:
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        if self._writer is None or self._writer.is_closing():
+            # dead transport: fail fast (trie fallback upstream) and
+            # kick one background reconnect; subscription state is
+            # re-seeded by _reseed once the new connection is up
+            fut.set_exception(ConnectionError("matcher service down"))
+            if self._reconnect_task is None or self._reconnect_task.done():
+                self._reconnect_task = loop.create_task(self._reconnect())
+            return fut
+        req = self._next_req
+        self._next_req += 1
+        self._pending[req] = fut
+        self._send(OP_MATCH, {"r": req, "t": [topic]})
+        return fut
+
+    async def _reconnect(self) -> None:
+        try:
+            self._reader, self._writer = \
+                await asyncio.open_unix_connection(self.path)
+        except OSError:
+            return                      # next enqueue retries
+        self._reader_task = asyncio.ensure_future(self._read_loop())
+        if self._reseed is not None:
+            self._reseed(self)          # replay current subscriptions
+
+    async def subscribers_async(self, topic: str) -> SubscriberSet:
+        return await self.enqueue(topic)
+
+
+class _ForwardHook(Hook):
+    """Hook forwarding the broker's subscription lifecycle to the
+    service."""
+
+    id = "matcher-service-forward"
+
+    def __init__(self, matcher: ServiceMatcher) -> None:
+        self.matcher = matcher
+
+    def on_started(self) -> None:
+        # fires after _restore_from_storage (which installs persisted
+        # subscriptions WITHOUT the subscribe hooks): replay the index
+        if self.matcher._reseed is not None:
+            self.matcher._reseed(self.matcher)
+
+    def on_subscribed(self, client, packet, reason_codes, counts) -> None:
+        for sub, rc in zip(packet.filters, reason_codes):
+            if rc < 0x80:
+                self.matcher.forward_subscribe(client.id, sub)
+
+    def on_unsubscribed(self, client, packet) -> None:
+        for sub in packet.filters:
+            self.matcher.forward_unsubscribe(client.id, sub.filter)
+
+    def on_client_expired(self, client) -> None:
+        self.matcher.forward_drop(client.id)
+
+    def on_disconnect(self, client, err, expire: bool) -> None:
+        # expire-on-disconnect purges the local session immediately
+        # (clean sessions); the service must drop those filters too
+        if expire:
+            self.matcher.forward_drop(client.id)
+
+    def on_session_established(self, client, packet) -> None:
+        # clean-start reconnect purged any previous session's filters
+        if packet.clean_start and not client.inline:
+            self.matcher.forward_drop(client.id)
+
+
+async def attach_matcher_service(broker, path: str) -> ServiceMatcher:
+    """Connect to a MatcherService and wire a broker to it: matcher for
+    the publish pipeline + hook forwarding subscription ops. The
+    broker's CURRENT index contents (e.g. subscriptions restored from
+    persistent storage, which bypass the subscribe hooks) are seeded to
+    the service at attach time and re-seeded after any reconnect."""
+    matcher = ServiceMatcher(path)
+    await matcher.connect()
+
+    def reseed(m: ServiceMatcher) -> None:
+        for cid, sub in broker.topics.walk_subscriptions():
+            m.forward_subscribe(cid, sub)
+
+    matcher._reseed = reseed
+    reseed(matcher)
+    broker.add_hook(_ForwardHook(matcher))
+    broker.attach_matcher(matcher)
+    return matcher

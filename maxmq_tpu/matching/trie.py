@@ -296,6 +296,22 @@ class TopicIndex:
     # Matching
     # ------------------------------------------------------------------
 
+    def walk_subscriptions(self):
+        """Yield every installed (client_id, Subscription) pair, shared
+        ones with their original ``$share/group/...`` filter. Snapshot
+        semantics under the index lock; used to seed external matchers
+        (the matcher service) with pre-existing state."""
+        with self._lock:
+            out = []
+            stack = [self._root]
+            while stack:
+                node = stack.pop()
+                stack.extend(node.children.values())
+                out.extend(node.subscriptions.items())
+                for holders in node.shared.values():
+                    out.extend(holders.items())
+        yield from out
+
     def subscribers(self, topic: str) -> SubscriberSet:
         """All subscriptions matching a published topic name.
 

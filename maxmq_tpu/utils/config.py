@@ -70,11 +70,12 @@ class Config:
                                         # = allow-all
 
     # -- TPU matcher runtime (no reference equivalent: the north-star path) --
-    matcher: str = "sig"                # trie | nfa | dense | sig
+    matcher: str = "sig"                # trie | nfa | dense | sig | service
     matcher_batch_window_us: int = 200
     matcher_max_batch: int = 256
     matcher_max_levels: int = 16
     matcher_mesh: str = ""              # e.g. "2x4" to shard over a mesh
+    matcher_socket: str = "/tmp/maxmq-matcher.sock"  # matcher = "service"
 
     # -- profiling ----------------------------------------------------------
     profile: bool = False

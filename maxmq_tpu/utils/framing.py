@@ -1,0 +1,21 @@
+"""Length-prefixed frame helpers shared by the ADR-005 fan-out bus and
+the matcher service (ADR 005/006): ``>IB`` = payload length + type."""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+
+
+def frame(ftype: int, payload: bytes) -> bytes:
+    return struct.pack(">IB", len(payload) + 1, ftype) + payload
+
+
+async def read_frame(reader) -> tuple[int, bytes] | None:
+    """One frame, or None on EOF/connection loss."""
+    try:
+        head = await reader.readexactly(5)
+        length, ftype = struct.unpack(">IB", head)
+        return ftype, await reader.readexactly(length - 1)
+    except (asyncio.IncompleteReadError, ConnectionError, OSError):
+        return None

@@ -1,0 +1,195 @@
+"""Matcher service (matching/service.py): the chip-owning process
+serving matches over a unix socket to broker clients (ADR 005/006)."""
+
+import asyncio
+import os
+import tempfile
+
+from test_broker_system import connect, running_broker
+from test_nfa_parity import normalize
+
+from maxmq_tpu.matching.batcher import MicroBatcher
+from maxmq_tpu.matching.service import (MatcherService, ServiceMatcher,
+                                        attach_matcher_service)
+from maxmq_tpu.matching.sig import SigEngine
+from maxmq_tpu.matching.trie import TopicIndex
+from maxmq_tpu.protocol import Subscription
+
+
+def _sock_path() -> str:
+    return os.path.join(tempfile.mkdtemp(prefix="maxmq-svc-"), "m.sock")
+
+
+async def test_service_matches_and_tracks_subscriptions():
+    path = _sock_path()
+    svc = MatcherService(path)
+    await svc.start()
+    try:
+        m = ServiceMatcher(path)
+        await m.connect()
+        m.forward_subscribe("c1", Subscription(filter="a/+/c", qos=1))
+        m.forward_subscribe("c2", Subscription(filter="a/#"))
+        m.forward_subscribe(
+            "c3", Subscription(filter="$share/g1/a/b/c", qos=2))
+        # mirror index for the expected answer
+        want_idx = TopicIndex()
+        want_idx.subscribe("c1", Subscription(filter="a/+/c", qos=1))
+        want_idx.subscribe("c2", Subscription(filter="a/#"))
+        want_idx.subscribe("c3",
+                           Subscription(filter="$share/g1/a/b/c", qos=2))
+        for topic in ("a/b/c", "a/x/c", "a", "b/c"):
+            got = await m.subscribers_async(topic)
+            assert normalize(got) == normalize(want_idx.subscribers(topic)), \
+                topic
+        # ops are ordered before matches on the same connection
+        m.forward_unsubscribe("c2", "a/#")
+        got = await m.subscribers_async("a/zzz")
+        assert "c2" not in got.subscriptions
+        m.forward_drop("c1")
+        got = await m.subscribers_async("a/x/c")
+        assert "c1" not in got.subscriptions
+        assert svc.matches_served >= 6
+        await m.close()
+    finally:
+        await svc.close()
+
+
+async def test_two_clients_share_one_service():
+    """Two broker processes' worth of clients coalesce on one engine and
+    see each other's subscriptions (the pool-worker shape)."""
+    path = _sock_path()
+    svc = MatcherService(
+        path, engine_factory=lambda idx: MicroBatcher(
+            SigEngine(idx), window_us=0))
+    await svc.start()
+    try:
+        m1, m2 = ServiceMatcher(path), ServiceMatcher(path)
+        await m1.connect()
+        await m2.connect()
+        m1.forward_subscribe("w1-cl", Subscription(filter="t/+"))
+        await m1.subscribers_async("t/x")     # barrier: op applied
+        got = await m2.subscribers_async("t/x")
+        assert "w1-cl" in got.subscriptions
+        await m1.close()
+        await m2.close()
+    finally:
+        await svc.close()
+
+
+async def test_broker_attached_to_matcher_service():
+    """Full path: MQTT clients against a broker whose matching runs in
+    the service process-equivalent (same loop here; the socket is real)."""
+    path = _sock_path()
+    svc = MatcherService(path)
+    await svc.start()
+    try:
+        async with running_broker() as broker:
+            matcher = await attach_matcher_service(broker, path)
+            sub = await connect(broker, "svc-sub")
+            await sub.subscribe(("svc/+/x", 0))
+            pub = await connect(broker, "svc-pub")
+            await pub.publish("svc/a/x", b"hello")
+            msg = await sub.next_message(timeout=10)
+            assert msg.topic == "svc/a/x" and msg.payload == b"hello"
+            # unsubscribe stops delivery through the service too
+            await sub.unsubscribe("svc/+/x")
+            await pub.publish("svc/a/x", b"again")
+            await asyncio.sleep(0.2)
+            assert sub.messages.empty()
+            await sub.disconnect()
+            await pub.disconnect()
+            await matcher.close()
+    finally:
+        await svc.close()
+
+
+async def test_run_server_with_service_matcher(tmp_path):
+    """Bootstrap path: matcher = "service" connects the broker to an
+    external matcher service socket (maxmq matcher-service)."""
+    import asyncio as aio
+
+    from maxmq_tpu.bootstrap import run_server
+    from maxmq_tpu.mqtt_client import MQTTClient
+    from maxmq_tpu.utils.config import Config
+    from test_bootstrap import quiet_logger
+
+    path = str(tmp_path / "m.sock")
+    svc = MatcherService(path)
+    await svc.start()
+    try:
+        conf = Config(mqtt_tcp_address="127.0.0.1:18845",
+                      metrics_enabled=False, matcher="service",
+                      matcher_socket=path, mqtt_sys_topic_interval=0)
+        ready, stop = aio.Event(), aio.Event()
+        task = aio.create_task(
+            run_server(conf, quiet_logger(), ready=ready, stop=stop))
+        await aio.wait_for(ready.wait(), timeout=10)
+        c = MQTTClient(client_id="svc-boot")
+        await c.connect("127.0.0.1", 18845)
+        await c.subscribe(("sb/#", 0))
+        await c.publish("sb/x", b"via-service")
+        msg = await c.next_message(timeout=5)
+        assert msg.payload == b"via-service"
+        assert svc.matches_served >= 1
+        await c.disconnect()
+        stop.set()
+        await aio.wait_for(task, timeout=15)
+    finally:
+        await svc.close()
+
+
+async def test_service_loss_degrades_to_trie_then_reconnects(tmp_path):
+    """Service crash mid-flight: publishes degrade to the broker's CPU
+    trie (no hangs, no drops); a restarted service at the same path is
+    picked up by the background reconnect and re-seeded."""
+    path = str(tmp_path / "m.sock")
+    svc = MatcherService(path)
+    await svc.start()
+    async with running_broker() as broker:
+        matcher = await attach_matcher_service(broker, path)
+        sub = await connect(broker, "rl-sub")
+        await sub.subscribe(("rl/#", 0))
+        pub = await connect(broker, "rl-pub")
+        await pub.publish("rl/1", b"a")
+        assert (await sub.next_message(timeout=10)).payload == b"a"
+
+        await svc.close()                      # service dies
+        await asyncio.sleep(0.1)
+        await pub.publish("rl/2", b"b")        # trie fallback delivers
+        assert (await sub.next_message(timeout=10)).payload == b"b"
+
+        svc2 = MatcherService(path)            # service comes back
+        await svc2.start()
+        try:
+            for i in range(50):                # reconnect is lazy: each
+                await pub.publish(f"rl/r{i}", b"c")   # publish retries
+                await sub.next_message(timeout=10)
+                if svc2.matches_served:
+                    break
+                await asyncio.sleep(0.05)
+            assert svc2.matches_served > 0, "reconnect never happened"
+            assert svc2.subs_applied >= 1      # re-seeded rl/# for rl-sub
+        finally:
+            await svc2.close()
+        await sub.disconnect()
+        await pub.disconnect()
+        await matcher.close()
+
+
+async def test_attach_seeds_preexisting_subscriptions(tmp_path):
+    """Subscriptions installed WITHOUT the subscribe hooks (the storage
+    restore path) must still reach the service via the index walk."""
+    path = str(tmp_path / "m.sock")
+    svc = MatcherService(path)
+    await svc.start()
+    try:
+        async with running_broker() as broker:
+            # as _restore_from_storage does: direct index install
+            broker.topics.subscribe(
+                "persisted-cl", Subscription(filter="pr/+", qos=1))
+            matcher = await attach_matcher_service(broker, path)
+            got = await matcher.subscribers_async("pr/x")
+            assert "persisted-cl" in got.subscriptions
+            await matcher.close()
+    finally:
+        await svc.close()
