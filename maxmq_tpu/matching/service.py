@@ -204,6 +204,10 @@ class ServiceMatcher:
         # callable(matcher) replaying current subscription state after a
         # reconnect (set by attach_matcher_service)
         self._reseed = None
+        # stats (scraped by the metrics bridge)
+        self.matches = 0
+        self.fallbacks = 0
+        self.reconnects = 0
 
     async def connect(self) -> None:
         async with self._connect_lock:
@@ -274,12 +278,14 @@ class ServiceMatcher:
             # kick one background reconnect; subscription state is
             # re-seeded by _reseed once the new connection is up
             fut.set_exception(ConnectionError("matcher service down"))
+            self.fallbacks += 1
             if self._reconnect_task is None or self._reconnect_task.done():
                 self._reconnect_task = loop.create_task(self._reconnect())
             return fut
         req = self._next_req
         self._next_req += 1
         self._pending[req] = fut
+        self.matches += 1
         self._send(OP_MATCH, {"r": req, "t": [topic]})
         return fut
 
@@ -290,6 +296,7 @@ class ServiceMatcher:
         except OSError:
             return                      # next enqueue retries
         self._reader_task = asyncio.ensure_future(self._read_loop())
+        self.reconnects += 1
         if self._reseed is not None:
             self._reseed(self)          # replay current subscriptions
 
