@@ -229,9 +229,11 @@ async def main() -> None:
                          "scenario (synthetic wildcard corpus in the "
                          "broker's index; see --matcher)")
     ap.add_argument("--matcher", default="trie",
-                    choices=("trie", "sig"),
-                    help="matchbench broker engine: CPU trie or the "
-                         "batched signature matcher + MicroBatcher")
+                    choices=("trie", "sig", "service"),
+                    help="matchbench broker engine: CPU trie, the "
+                         "batched signature matcher + MicroBatcher, or "
+                         "an external chip-owning matcher service "
+                         "(spawned automatically)")
     ap.add_argument("--real-subs", type=int, default=16)
     ap.add_argument("--publishers", type=int, default=2)
     ap.add_argument("--workers", type=int, default=0,
@@ -270,6 +272,15 @@ async def main() -> None:
                     "    eng = SigEngine(b.topics)\n"
                     "    eng.warm_buckets(256, background=False)\n"
                     "    b.attach_matcher(MicroBatcher(eng))\n")
+            elif args.matcher == "service":
+                # attach forwards the preloaded corpus to the service
+                # (index walk reseed) over the socket
+                sock = os.environ.get("MAXMQ_BENCH_SERVICE_SOCKET",
+                                      "/tmp/maxmq-bench-matcher.sock")
+                preload += (
+                    "    from maxmq_tpu.matching.service import "
+                    "attach_matcher_service\n"
+                    f"    await attach_matcher_service(b, {sock!r})\n")
         script = (
             "import asyncio, os, sys\n"
             f"sys.path.insert(0, {REPO!r})\n"
@@ -299,6 +310,24 @@ async def main() -> None:
             "flush=True)\n"
             "    await asyncio.Event().wait()\n"
             "asyncio.run(main())\n")
+        service_proc = None
+        if args.matchbench and args.matcher == "service":
+            sock = os.environ.get("MAXMQ_BENCH_SERVICE_SOCKET",
+                                  "/tmp/maxmq-bench-matcher.sock")
+            try:                      # a stale socket from an unclean
+                os.unlink(sock)       # exit would defeat the bind wait
+            except OSError:
+                pass
+            service_proc = subprocess.Popen(
+                [sys.executable, "-m", "maxmq_tpu", "matcher-service",
+                 "--socket", sock],
+                cwd=REPO, stderr=subprocess.DEVNULL)
+            for _ in range(100):
+                if os.path.exists(sock):
+                    break
+                await asyncio.sleep(0.1)
+            else:
+                ap.error(f"matcher service never bound {sock}")
         if args.workers > 1:
             if args.matchbench:
                 ap.error("--workers does not combine with --matchbench "
@@ -335,6 +364,9 @@ async def main() -> None:
         if broker is not None:
             broker.terminate()
             broker.wait(timeout=10)
+        if service_proc is not None:
+            service_proc.terminate()
+            service_proc.wait(timeout=10)
         sent = (args.messages // args.publishers) * args.publishers
         print(json.dumps({
             "metric": "e2e_broker_matchbench_deliveries_per_sec",

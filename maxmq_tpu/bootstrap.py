@@ -179,6 +179,9 @@ async def run_server(conf: Config, logger: Logger,
     profiler = _start_profiling(conf)
 
     broker = build_broker(conf, logger)
+    # service matcher must attach BEFORE the metrics registry is built,
+    # or the matcher/pipeline metrics never register in service mode
+    await _maybe_attach_service(conf, broker)
     metrics = build_metrics(conf, broker, logger)
 
     if stop is None:
@@ -192,7 +195,6 @@ async def run_server(conf: Config, logger: Logger,
 
     if metrics is not None:
         metrics.start()
-    await _maybe_attach_service(conf, broker)
     await broker.serve()
     boot.info("server started", tcp=conf.mqtt_tcp_address,
               matcher=conf.matcher or "trie")

@@ -385,16 +385,16 @@ async def run_worker(conf, logger, worker_id: int, bus_path: str,
     broker = build_broker(conf, logger)
     hook = BusHook(worker_id, bus_path)
     broker.add_hook(hook)
-    metrics = build_metrics(conf, broker, logger) if worker_id == 0 else None
-    # bus first, listeners second: a client accepted before the bus is
-    # connected would publish into a void
-    await hook.attach(broker)
     if conf.matcher == "service":
         # pool workers share ONE chip-owning matcher service (ADR 005):
         # every worker forwards its own clients' subscription ops and
         # all workers' match requests coalesce on the service's batcher
         from ..matching.service import attach_matcher_service
         await attach_matcher_service(broker, conf.matcher_socket)
+    metrics = build_metrics(conf, broker, logger) if worker_id == 0 else None
+    # bus first, listeners second: a client accepted before the bus is
+    # connected would publish into a void
+    await hook.attach(broker)
     await broker.serve()
     hook.announce()
     if metrics is not None:

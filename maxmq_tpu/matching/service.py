@@ -93,9 +93,6 @@ class MatcherService:
         self._factory = engine_factory
         self.matcher = None               # built lazily on first serve
         self._server: asyncio.Server | None = None
-        # cid -> filters, service-side: OP_DROP must not depend on the
-        # index exposing a per-client reverse map
-        self._client_filters: dict[str, set[str]] = {}
         self._conns: set = set()        # live client writers
         self.subs_applied = 0
         self.matches_served = 0
@@ -130,6 +127,12 @@ class MatcherService:
         batcher coalesces topics across ALL connections."""
         tasks: set[asyncio.Task] = set()
         self._conns.add(writer)
+        # subscription state is OWNED BY THIS CONNECTION (pool workers
+        # shard clients disjointly, and each worker matches only for its
+        # own delivery): when the connection drops, its subscriptions
+        # are purged — a lost UNSUB op can never leave stale filters
+        # past the owning broker's reconnect+reseed
+        owned: dict[str, set[str]] = {}
         try:
             while True:
                 fr = await _read_frame(reader)
@@ -141,14 +144,12 @@ class MatcherService:
                     sub = _decode_sub(msg["v"])
                     if self.index.subscribe(msg["c"], sub):
                         self.subs_applied += 1
-                    self._client_filters.setdefault(
-                        msg["c"], set()).add(sub.filter)
+                    owned.setdefault(msg["c"], set()).add(sub.filter)
                 elif ftype == OP_UNSUB:
                     self.index.unsubscribe(msg["c"], msg["f"])
-                    self._client_filters.get(msg["c"], set()).discard(
-                        msg["f"])
+                    owned.get(msg["c"], set()).discard(msg["f"])
                 elif ftype == OP_DROP:
-                    for filt in self._client_filters.pop(msg["c"], ()):
+                    for filt in owned.pop(msg["c"], ()):
                         self.index.unsubscribe(msg["c"], filt)
                 elif ftype == OP_MATCH:
                     t = asyncio.ensure_future(
@@ -157,6 +158,9 @@ class MatcherService:
                     t.add_done_callback(tasks.discard)
         finally:
             self._conns.discard(writer)
+            for cid, filters in owned.items():
+                for filt in filters:
+                    self.index.unsubscribe(cid, filt)
             for t in tasks:
                 t.cancel()
             writer.close()
@@ -232,6 +236,21 @@ class ServiceMatcher:
         self._pending.clear()
 
     async def _read_loop(self) -> None:
+        try:
+            await self._read_loop_inner()
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            # a malformed frame must fail like EOF, not strand the
+            # pending futures behind a live-looking writer
+            self._writer = None
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(
+                        ConnectionError("matcher service protocol error"))
+            self._pending.clear()
+
+    async def _read_loop_inner(self) -> None:
         while True:
             fr = await _read_frame(self._reader)
             if fr is None:
@@ -256,8 +275,17 @@ class ServiceMatcher:
             else:
                 fut.set_result(decode_result(msg["s"][0]))
 
-    def _send(self, ftype: int, msg: dict) -> None:
-        self._writer.write(_frame(ftype, json.dumps(msg).encode()))
+    def _send(self, ftype: int, msg: dict) -> bool:
+        """Write one op; False (dropped) when the transport is down —
+        the reconnect reseed replays the full current state, and the
+        service purges a lost connection's subscriptions itself, so a
+        dropped op can never strand state. forward_* must never raise
+        into hooks.notify (it does not catch)."""
+        w = self._writer
+        if w is None or w.is_closing():
+            return False
+        w.write(_frame(ftype, json.dumps(msg).encode()))
+        return True
 
     # -- subscription forwarding (called by the attach hook) ----------
     def forward_subscribe(self, cid: str, sub: Subscription) -> None:
