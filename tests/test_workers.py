@@ -164,3 +164,35 @@ async def test_qos1_delivery_across_workers():
         assert m.qos == 1
         await sub.disconnect()
         await pub.disconnect()
+
+
+async def test_pool_workers_share_one_matcher_service(tmp_path):
+    """The flagship composition (ADR 005 + 006): N pool workers, ONE
+    chip-owning matcher service. Each worker forwards its own clients'
+    subscription ops; cross-worker publishes ride the fan-out bus and
+    each worker's matches route through the shared service."""
+    from maxmq_tpu.matching.service import (MatcherService,
+                                            attach_matcher_service)
+
+    path = str(tmp_path / "m.sock")
+    svc = MatcherService(path)
+    await svc.start()
+    try:
+        async with running_pool(2) as (brokers, ports):
+            for b in brokers:
+                await attach_matcher_service(b, path)
+            sub = MQTTClient("ps-sub")
+            await sub.connect("127.0.0.1", ports[0])
+            await sub.subscribe("svcpool/+/x")
+            pub = MQTTClient("ps-pub")
+            await pub.connect("127.0.0.1", ports[1])   # OTHER worker
+            await pub.publish("svcpool/a/x", b"via-svc")
+            m = await sub.next_message(5)
+            assert m.payload == b"via-svc"
+            # both workers' matching went through the one service
+            assert svc.matches_served >= 1
+            assert svc.subs_applied >= 1
+            await sub.disconnect()
+            await pub.disconnect()
+    finally:
+        await svc.close()
