@@ -40,7 +40,8 @@ import os
 from ..hooks.base import Hook
 from ..protocol.packets import Subscription
 from ..utils.framing import frame as _frame, read_frame as _read_frame
-from .trie import SubscriberSet, TopicIndex
+from .trie import (SubscriberSet, TopicIndex,
+                   VersionedTopicCache, subs_version)
 
 OP_SUB = 1
 OP_UNSUB = 2
@@ -208,9 +209,15 @@ class ServiceMatcher:
         # callable(matcher) replaying current subscription state after a
         # reconnect (set by attach_matcher_service)
         self._reseed = None
+        # version-keyed topic cache (same discipline as MicroBatcher):
+        # requires ``self.index`` (set by attach_matcher_service) for
+        # the subscription version; disabled when unset
+        self._cache = VersionedTopicCache()
+        self.index = None
         # stats (scraped by the metrics bridge)
         self.matches = 0
         self.fallbacks = 0
+        self.cache_hits = 0
         self.reconnects = 0
 
     async def connect(self) -> None:
@@ -230,7 +237,7 @@ class ServiceMatcher:
                 pass
         if self._writer is not None:
             self._writer.close()
-        for fut in self._pending.values():
+        for fut, _t, _v in self._pending.values():
             if not fut.done():
                 fut.cancel()
         self._pending.clear()
@@ -244,7 +251,7 @@ class ServiceMatcher:
             # a malformed frame must fail like EOF, not strand the
             # pending futures behind a live-looking writer
             self._writer = None
-            for fut in self._pending.values():
+            for fut, _t, _v in self._pending.values():
                 if not fut.done():
                     fut.set_exception(
                         ConnectionError("matcher service protocol error"))
@@ -258,7 +265,7 @@ class ServiceMatcher:
                 # broker degrades them to its CPU trie) and mark the
                 # transport dead so enqueue() fails fast too
                 self._writer = None
-                for fut in self._pending.values():
+                for fut, _t, _v in self._pending.values():
                     if not fut.done():
                         fut.set_exception(
                             ConnectionError("matcher service lost"))
@@ -266,14 +273,20 @@ class ServiceMatcher:
                 return
             _ftype, payload = fr
             msg = json.loads(payload)
-            fut = self._pending.pop(msg["r"], None)
-            if fut is None or fut.done():
+            entry = self._pending.pop(msg["r"], None)
+            if entry is None:
+                continue
+            fut, topic, ver = entry
+            if fut.done():
                 continue
             if "e" in msg:
                 fut.set_exception(RuntimeError(
                     f"matcher service error: {msg['e']}"))
             else:
-                fut.set_result(decode_result(msg["s"][0]))
+                result = decode_result(msg["s"][0])
+                if ver is not None:
+                    self._cache.put(topic, ver, result)
+                fut.set_result(result)
 
     def _send(self, ftype: int, msg: dict) -> bool:
         """Write one op; False (dropped) when the transport is down —
@@ -310,10 +323,18 @@ class ServiceMatcher:
             if self._reconnect_task is None or self._reconnect_task.done():
                 self._reconnect_task = loop.create_task(self._reconnect())
             return fut
-        req = self._next_req
+        ver = None
+        if self.index is not None:
+            ver = subs_version(self.index)
+            hit = self._cache.get(topic, ver)
+            if hit is not None:
+                self.cache_hits += 1
+                fut.set_result(hit)
+                return fut
+        self.matches += 1       # real round trips only (cache hits are
+        req = self._next_req    # counted separately, as in batcher mode)
         self._next_req += 1
-        self._pending[req] = fut
-        self.matches += 1
+        self._pending[req] = (fut, topic, ver)
         self._send(OP_MATCH, {"r": req, "t": [topic]})
         return fut
 
@@ -378,6 +399,7 @@ async def attach_matcher_service(broker, path: str) -> ServiceMatcher:
     persistent storage, which bypass the subscribe hooks) are seeded to
     the service at attach time and re-seeded after any reconnect."""
     matcher = ServiceMatcher(path)
+    matcher.index = broker.topics       # enables the topic cache
     await matcher.connect()
 
     def reseed(m: ServiceMatcher) -> None:

@@ -193,3 +193,30 @@ async def test_attach_seeds_preexisting_subscriptions(tmp_path):
             await matcher.close()
     finally:
         await svc.close()
+
+
+async def test_service_matcher_topic_cache(tmp_path):
+    """Repeated topics resolve from the version-keyed cache without a
+    socket round trip; a subscription change invalidates."""
+    path = str(tmp_path / "m.sock")
+    svc = MatcherService(path)
+    await svc.start()
+    try:
+        async with running_broker() as broker:
+            matcher = await attach_matcher_service(broker, path)
+            sub = await connect(broker, "tc-sub")
+            await sub.subscribe(("tc/#", 0))
+            r1 = await matcher.subscribers_async("tc/x")
+            served = svc.matches_served
+            r2 = await matcher.subscribers_async("tc/x")   # cache hit
+            assert matcher.cache_hits == 1
+            assert svc.matches_served == served            # no round trip
+            assert "tc-sub" in r1.subscriptions and r1 == r2
+            await sub.subscribe(("tc/x", 1))               # version bump
+            r3 = await matcher.subscribers_async("tc/x")
+            assert svc.matches_served > served
+            assert r3.subscriptions["tc-sub"].qos == 1
+            await sub.disconnect()
+            await matcher.close()
+    finally:
+        await svc.close()
