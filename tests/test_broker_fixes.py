@@ -291,3 +291,43 @@ async def test_publish_pipeline_preserves_publish_order():
             "deliveries out of publish order"
         await sub.disconnect()
         await pub.disconnect()
+
+
+async def test_tls_listener_roundtrip(tmp_path):
+    """TLS TCP listener: a client over ssl does a full QoS0 roundtrip
+    (parity: vendor/.../v2/listeners/tcp.go TLS config path)."""
+    import ssl
+    import subprocess
+
+    from test_broker_system import running_broker
+
+    from maxmq_tpu.broker import TCPListener
+    from maxmq_tpu.mqtt_client import MQTTClient
+
+    key, crt = tmp_path / "k.pem", tmp_path / "c.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(crt), "-days", "1",
+         "-subj", "/CN=localhost"],
+        check=True, capture_output=True)
+    server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    server_ctx.load_cert_chain(str(crt), str(key))
+
+    async with running_broker() as broker:
+        lst = broker.add_listener(
+            TCPListener("tls1", "127.0.0.1:0", tls=server_ctx))
+        await lst.serve(broker._establish)
+        port = lst._server.sockets[0].getsockname()[1]
+
+        client_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        client_ctx.check_hostname = False
+        client_ctx.verify_mode = ssl.CERT_NONE
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", port, ssl=client_ctx)
+        c = MQTTClient(client_id="tls-c")
+        await c.connect(None, None, reader=reader, writer=writer)
+        await c.subscribe(("tls/#", 0))
+        await c.publish("tls/x", b"secured")
+        m = await c.next_message(timeout=10)
+        assert m.payload == b"secured"
+        await c.disconnect()

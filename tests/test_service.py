@@ -220,3 +220,34 @@ async def test_service_matcher_topic_cache(tmp_path):
             await matcher.close()
     finally:
         await svc.close()
+
+
+async def test_cli_matcher_service_command(tmp_path):
+    """`maxmq matcher-service` serves a usable socket (subprocess)."""
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    path = str(tmp_path / "cli.sock")
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "maxmq_tpu", "matcher-service",
+         "--socket", path],
+        cwd="/root/repo", env=env, stderr=subprocess.PIPE)
+    try:
+        for _ in range(100):
+            if os.path.exists(path):
+                break
+            await asyncio.sleep(0.1)
+        else:
+            raise AssertionError("service socket never appeared")
+        m = ServiceMatcher(path)
+        await m.connect()
+        m.forward_subscribe("cli-c", Subscription(filter="cli/+"))
+        got = await m.subscribers_async("cli/x")
+        assert "cli-c" in got.subscriptions
+        await m.close()
+    finally:
+        proc.send_signal(signal.SIGINT)
+        proc.wait(timeout=10)
