@@ -1,0 +1,136 @@
+"""Broker soak: sustained client churn + pub/sub + retained + QoS1/2
+traffic against an in-process broker, watching for leaks.
+
+Usage: python tools/soak.py [--seconds 300] [--matcher trie|sig]
+Prints one JSON line: cycles, deliveries, RSS at start/end, asyncio
+task count at start/end. Exit 1 if RSS grew more than --rss-budget MB
+or tasks leaked.
+
+The reference has no soak harness; this covers the long-run stability
+its users get implicitly from Go's runtime (goroutine/conn lifecycle)
+— here the asyncio task + pipeline lifecycles are ours to prove.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def rss_mb() -> float:
+    with open("/proc/self/status", encoding="ascii") as fh:
+        for line in fh:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) / 1024
+    return 0.0
+
+
+async def churn_cycle(host: str, port: int, i: int, deliveries: list):
+    """One full client lifecycle: connect, subscribe (one wildcard, one
+    exact, one shared), publish QoS 0/1/2, receive, retained touch,
+    unsubscribe half, disconnect (abruptly every 7th — wills fire)."""
+    from maxmq_tpu.mqtt_client import MQTTClient, Will
+
+    rng = random.Random(i)
+    will = Will(topic=f"soak/will/{i % 16}", payload=b"gone") \
+        if i % 5 == 0 else None
+    c = MQTTClient(client_id=f"soak-{i % 64}", clean_start=True,
+                   will=will)
+    await c.connect(host, port)
+    await c.subscribe((f"soak/t/{i % 16}/+", 1))
+    await c.subscribe((f"soak/exact/{i % 8}", 2))
+    await c.subscribe((f"$share/g{i % 4}/soak/sh/#", 0))
+    for q in (0, 1, 2):
+        await c.publish(f"soak/t/{i % 16}/x", f"m{q}".encode(), qos=q)
+    got = 0
+    try:
+        while got < 3:
+            await c.next_message(timeout=10)
+            got += 1
+    except TimeoutError:
+        pass
+    deliveries.append(got)
+    if i % 3 == 0:
+        await c.publish(f"soak/ret/{i % 32}", b"r", retain=True)
+    await c.unsubscribe(f"soak/exact/{i % 8}")
+    if i % 7 == 0:
+        c.writer.transport.abort()      # abrupt: will + takeover paths
+    else:
+        await c.disconnect()
+
+
+async def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=int, default=300)
+    ap.add_argument("--matcher", default="trie",
+                    choices=("trie", "sig"))
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--rss-budget", type=float, default=80.0,
+                    help="max tolerated RSS growth, MB")
+    args = ap.parse_args()
+
+    from maxmq_tpu.broker import (Broker, BrokerOptions, Capabilities,
+                                  TCPListener)
+    from maxmq_tpu.hooks import AllowHook
+
+    b = Broker(BrokerOptions(capabilities=Capabilities(
+        sys_topic_interval=1)))
+    b.add_hook(AllowHook())
+    lst = b.add_listener(TCPListener("soak", "127.0.0.1:0"))
+    await b.serve()
+    port = lst._server.sockets[0].getsockname()[1]
+    if args.matcher == "sig":
+        from maxmq_tpu.matching.batcher import MicroBatcher
+        from maxmq_tpu.matching.sig import SigEngine
+        b.attach_matcher(MicroBatcher(SigEngine(b.topics)))
+
+    deliveries: list[int] = []
+    # settle allocator pools before the baseline (first cycles allocate
+    # caches, codec tables, event-loop machinery)
+    for i in range(32):
+        await churn_cycle("127.0.0.1", port, i, deliveries)
+    rss0, tasks0 = rss_mb(), len(asyncio.all_tasks())
+    cycles = 32
+    t_end = time.time() + args.seconds
+    sem = asyncio.Semaphore(args.concurrency)
+
+    async def bounded(i: int):
+        async with sem:
+            await churn_cycle("127.0.0.1", port, i, deliveries)
+
+    batch = 0
+    while time.time() < t_end:
+        await asyncio.gather(
+            *(bounded(cycles + k) for k in range(64)),
+            return_exceptions=False)
+        cycles += 64
+        batch += 1
+        if batch % 10 == 0:
+            print(f"[soak] {cycles} cycles, rss {rss_mb():.1f}MB",
+                  file=sys.stderr, flush=True)
+    await asyncio.sleep(1.0)            # drain stragglers
+    rss1, tasks1 = rss_mb(), len(asyncio.all_tasks())
+    await b.close()
+
+    grew = rss1 - rss0
+    out = {"metric": "soak", "seconds": args.seconds,
+           "matcher": args.matcher, "cycles": cycles,
+           "deliveries": sum(deliveries),
+           "rss_start_mb": round(rss0, 1), "rss_end_mb": round(rss1, 1),
+           "rss_growth_mb": round(grew, 1),
+           "tasks_start": tasks0, "tasks_end": tasks1,
+           "ok": grew <= args.rss_budget and tasks1 <= tasks0 + 4}
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(asyncio.run(main()))
