@@ -108,6 +108,12 @@ class Broker:
         self._sys_task: asyncio.Task | None = None
         self._will_delays: dict[str, tuple[float, Packet]] = {}
         self._retained_expiry: list[tuple[float, str]] = []
+        # topic -> latest due time: the heap uses lazy deletion, and a
+        # retained topic REPUBLISHED often (1Hz sensor state) would
+        # otherwise grow the heap by one stale entry per publish for a
+        # full expiry interval (~86K entries/day/topic) — found by
+        # tools/soak.py
+        self._retained_due: dict[str, float] = {}
         # publish topics repeat heavily, and a trie walk costs ~20us;
         # entries self-invalidate on any subscription change
         self._match_cache = VersionedTopicCache()
@@ -1203,15 +1209,26 @@ class Broker:
         $-topics are broker-owned and never expire (the old '#'-scan
         skipped them the same way)."""
         maximum = self.capabilities.maximum_message_expiry_interval
-        if not maximum or not packet.payload or packet.topic.startswith("$"):
+        if not maximum or packet.topic.startswith("$"):
+            return
+        if not packet.payload:          # retained CLEAR, from any path
+            self._retained_due.pop(packet.topic, None)
             return
         expiry = packet.properties.message_expiry
         if expiry is None:
             expiry = maximum
         if expiry <= 0:
             return
-        heapq.heappush(self._retained_expiry,
-                       (packet.created + expiry, packet.topic))
+        due = packet.created + expiry
+        self._retained_due[packet.topic] = due
+        heap = self._retained_expiry
+        heapq.heappush(heap, (due, packet.topic))
+        if len(heap) > 64 and len(heap) > 4 * len(self._retained_due):
+            # compact the lazy-deleted majority: rebuild from the live
+            # per-topic dues (bounded by the retained-message count)
+            self._retained_expiry = [
+                (d, t) for t, d in self._retained_due.items()]
+            heapq.heapify(self._retained_expiry)
 
     def _check_expired_retained(self, now: float) -> None:
         maximum = self.capabilities.maximum_message_expiry_interval
@@ -1219,7 +1236,10 @@ class Broker:
             return
         heap = self._retained_expiry
         while heap and heap[0][0] <= now:
-            _due, topic = heapq.heappop(heap)
+            due, topic = heapq.heappop(heap)
+            if self._retained_due.get(topic) != due:
+                continue        # superseded by a later republish
+            self._retained_due.pop(topic, None)   # entry consumed
             p = self.topics.retained_get(topic)
             if p is None or not self._message_expired(p, now, maximum):
                 continue        # cleared or replaced since: stale entry

@@ -331,3 +331,32 @@ async def test_tls_listener_roundtrip(tmp_path):
         m = await c.next_message(timeout=10)
         assert m.payload == b"secured"
         await c.disconnect()
+
+
+def test_retained_expiry_heap_bounded_under_republish():
+    """A retained topic republished many times must not grow the expiry
+    heap by one stale entry per publish (soak-found leak): lazy
+    deletion + bounded rebuild keep the heap O(live retained topics)."""
+    b = Broker(BrokerOptions(capabilities=Capabilities(
+        maximum_message_expiry_interval=3600)))
+
+    class _C:
+        id = "rp"
+        inline = True
+
+    for i in range(5000):
+        p = Packet(fixed=FixedHeader(type=PT.PUBLISH, retain=True),
+                   topic=f"rp/{i % 8}", payload=b"x")
+        p.created = 1000.0 + i
+        b.retain_message(_C(), p)
+    assert len(b._retained_expiry) <= 64, len(b._retained_expiry)
+    assert len(b._retained_due) == 8
+    # clearing a retained topic drops its due entry
+    clear = Packet(fixed=FixedHeader(type=PT.PUBLISH, retain=True),
+                   topic="rp/0", payload=b"")
+    clear.created = 9999.0
+    b.retain_message(_C(), clear)
+    assert "rp/0" not in b._retained_due
+    # expiry still fires off the compacted heap
+    b._check_expired_retained(now=1000.0 + 5000 + 3600 + 1)
+    assert not b._retained_due
