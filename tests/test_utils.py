@@ -197,3 +197,33 @@ class TestBuildInfo:
         assert info.version
         assert info.short_version() == info.version
         assert info.distribution in info.long_version()
+
+
+def test_module_entrypoint_version():
+    """python -m maxmq_tpu version (covers __main__.py + cli version)."""
+    import subprocess
+    import sys
+
+    p = subprocess.run([sys.executable, "-m", "maxmq_tpu", "version"],
+                       cwd="/root/repo", capture_output=True, text=True,
+                       timeout=60)
+    assert p.returncode == 0
+    assert "maxmq" in p.stdout.lower() or "0." in p.stdout
+
+
+def test_cli_start_bad_address_exits_nonzero(tmp_path):
+    import os
+    import subprocess
+    import sys
+
+    conf = tmp_path / "bad.conf"
+    conf.write_text('mqtt_tcp_address = "256.0.0.1:99999"\n'
+                    'matcher = "trie"\n')
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="",
+               JAX_PLATFORMS="cpu")   # hermetic: no accelerator init
+    p = subprocess.run(
+        [sys.executable, "-m", "maxmq_tpu", "start", "--config",
+         str(conf), "--no-banner"],
+        cwd="/root/repo", env=env, capture_output=True, text=True,
+        timeout=120)
+    assert p.returncode == 1
