@@ -555,3 +555,40 @@ def test_fixed_path_bucket_ladder_parity():
         for topic, result in zip(topics, got):
             want = idx.subscribers(topic)
             assert normalize(result) == normalize(want), (size, topic)
+
+
+@pytest.mark.parametrize("seed", [101, 202, 303])
+def test_randomized_churn_parity(seed):
+    """Subscribe/unsubscribe churn interleaved with fixed-path matches:
+    every match must agree with the trie REGARDLESS of where the engine
+    is in its overlay/journal/recompile lifecycle (forced rotations and
+    overlay-served windows both exercised)."""
+    rng = random.Random(seed)
+    filters, topics = rand_corpus(rng, 250, 40)
+    idx = TopicIndex()
+    live: list[tuple[str, str]] = []
+    for i, f in enumerate(filters[:120]):
+        cid = f"cl-{i % 40}"
+        idx.subscribe(cid, Subscription(filter=f, qos=i % 3))
+        live.append((cid, f))
+    engine = SigEngine(idx, auto_refresh=False)
+    pool = filters[120:]
+    for step in range(60):
+        op = rng.random()
+        if op < 0.4 and pool:
+            cid = f"cl-{rng.randrange(40)}"
+            f = pool.pop(rng.randrange(len(pool)))
+            idx.subscribe(cid, Subscription(filter=f,
+                                            qos=rng.randrange(3)))
+            live.append((cid, f))
+        elif op < 0.7 and live:
+            cid, f = live.pop(rng.randrange(len(live)))
+            idx.unsubscribe(cid, f)
+        if rng.random() < 0.25:
+            engine.refresh(force=True)      # rotation mid-churn
+        batch = [rng.choice(topics) for _ in range(rng.randint(1, 9))]
+        got = engine.subscribers_fixed_batch(batch)
+        for topic, result in zip(batch, got):
+            want = idx.subscribers(topic)
+            assert normalize(result) == normalize(want), (seed, step,
+                                                          topic)
