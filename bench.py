@@ -46,9 +46,80 @@ import numpy as np
 
 GO_TRIE_BASELINE = 500_000.0  # matches/sec, see module docstring
 
+# Last-good real-TPU capture, persisted after every successful TPU run
+# and REPLAYED (explicitly labeled "cached") when the accelerator tunnel
+# is wedged at bench time: the rig's tunnel is known to wedge for hours
+# (BENCH_r02/r03 both lost their driver capture to it), and a wedged
+# probe must not erase the best-known hardware number from the round's
+# artifact.
+LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "BENCH_TPU_LAST_GOOD.json")
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+def load_last_good() -> dict | None:
+    try:
+        with open(LAST_GOOD_PATH) as f:
+            saved = json.load(f)
+        if saved.get("result", {}).get("value", 0) > 0:
+            return saved
+    except Exception:
+        pass
+    return None
+
+
+HEADLINE_METRIC = "wildcard_topic_matches_per_sec_iot_1m_share"
+
+
+def save_last_good(result: dict) -> None:
+    """Persist a successful TPU capture (atomic; best-effort). A
+    degraded run (partial wedge, or a single-config invocation) whose
+    headline fell back to a smaller config must never overwrite a saved
+    true-headline capture — that is exactly the number this cache
+    exists to preserve."""
+    if result.get("detail", {}).get("backend") != "tpu":
+        return
+    if result.get("value", 0) <= 0:
+        return
+    existing = load_last_good()
+    if (existing is not None
+            and existing["result"].get("metric") == HEADLINE_METRIC
+            and result.get("metric") != HEADLINE_METRIC):
+        log("[cache] keeping existing headline capture "
+            f"({existing['result']['metric']}); this run's "
+            f"{result.get('metric')} is lower-fidelity")
+        return
+    saved = {"saved_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime()),
+             "provenance": "bench.py live TPU capture",
+             "result": result}
+    try:
+        tmp = LAST_GOOD_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(saved, f, indent=1)
+        os.replace(tmp, LAST_GOOD_PATH)
+        log(f"[cache] saved last-good TPU capture to {LAST_GOOD_PATH}")
+    except Exception as exc:
+        log(f"[cache] could not persist last-good capture: {exc!r}")
+
+
+def cached_replay(live_detail: dict) -> dict | None:
+    """Build a bench result from the persisted last-good TPU capture,
+    explicitly labeled cached, carrying the live failure detail."""
+    saved = load_last_good()
+    if saved is None:
+        return None
+    result = dict(saved["result"])
+    detail = dict(result.get("detail", {}))
+    detail.update(cached=True, cached_at=saved.get("saved_at"),
+                  cached_provenance=saved.get("provenance"),
+                  live=live_detail)
+    result["detail"] = detail
+    result["metric"] = result["metric"] + "_cached"
+    return result
 
 
 def build_corpus(n_subs: int, seed: int = 42, plus_only: bool = False,
@@ -538,6 +609,20 @@ def main() -> None:
         "MAXMQ_BENCH_BACKEND_TIMEOUT", "180"))
 
     def fail(detail: dict) -> None:
+        # tunnel wedged: replay the last-good TPU capture (labeled
+        # cached) rather than reporting 0 — the wedge is an infra
+        # failure, not a perf regression (VERDICT r03 #3). Only for
+        # runs that TARGETED the TPU: a CPU-pinned validation/sanity
+        # run failing must stay an infra-failure record, never borrow
+        # a hardware number.
+        cached = (None if subproc_child or want == "cpu"
+                  else cached_replay(detail))
+        if cached is not None:
+            log("[cache] tunnel wedged; replaying last-good TPU capture "
+                f"({cached['detail'].get('cached_at')})")
+            print(json.dumps(cached))
+            sys.stdout.flush()
+            os._exit(0)
         print(json.dumps({
             "metric": "wildcard_topic_matches_per_sec_none",
             "value": 0.0, "unit": "matches/sec", "vs_baseline": 0.0,
@@ -662,8 +747,11 @@ def main() -> None:
     link = link_box[0] if link_box else {"error":
                                          "link probe timed out (60s)"}
 
-    print(json.dumps(assemble_result(
-        configs, link, jax.default_backend(), len(jax.devices()))))
+    result = assemble_result(
+        configs, link, jax.default_backend(), len(jax.devices()))
+    if not subproc_child:
+        save_last_good(result)
+    print(json.dumps(result))
 
 
 def assemble_result(configs: list, link: dict, backend_name: str,
@@ -767,9 +855,20 @@ def run_supervised(which: list[str]) -> None:
     except Exception as exc:
         link = {"error": f"link probe subprocess: {exc!r}"[:300]}
 
-    print(json.dumps(assemble_result(
-        configs, link, backend_name or "unreported",
-        n_devices or 1)))
+    result = assemble_result(configs, link, backend_name or "unreported",
+                             n_devices or 1)
+    if result.get("value", 0) > 0:
+        save_last_good(result)
+    elif os.environ.get("JAX_PLATFORMS") != "cpu":
+        # every config wedged mid-run with no headline row on a
+        # TPU-intent run: replay the last-good capture, carrying the
+        # fresh (failed) rows as live
+        cached = cached_replay(result["detail"])
+        if cached is not None:
+            log("[cache] no live headline row; replaying last-good "
+                "TPU capture")
+            result = cached
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

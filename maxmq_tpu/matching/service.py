@@ -86,6 +86,13 @@ class MatcherService:
     def __init__(self, path: str, engine_factory=None) -> None:
         self.path = path
         self.index = TopicIndex()
+        # (cid, filter) -> number of live connections owning that entry.
+        # Ownership is refcounted ACROSS connections: during cross-worker
+        # session takeover, worker B's re-subscribe and worker A's
+        # takeover-driven drop race over the same (cid, filter) key — the
+        # index entry must survive until the LAST owner releases it, or a
+        # live client silently loses matcher-path deliveries.
+        self._owners: dict[tuple, int] = {}
         if engine_factory is None:
             def engine_factory(index):
                 from .batcher import MicroBatcher
@@ -128,12 +135,25 @@ class MatcherService:
         batcher coalesces topics across ALL connections."""
         tasks: set[asyncio.Task] = set()
         self._conns.add(writer)
-        # subscription state is OWNED BY THIS CONNECTION (pool workers
-        # shard clients disjointly, and each worker matches only for its
-        # own delivery): when the connection drops, its subscriptions
-        # are purged — a lost UNSUB op can never leave stale filters
-        # past the owning broker's reconnect+reseed
+        # subscription state is OWNED BY THIS CONNECTION, but ownership
+        # of an index entry is REFCOUNTED across connections via
+        # self._owners: a (cid, filter) leaves the index only when its
+        # last owning connection releases it. When the connection drops,
+        # its refs are released — a lost UNSUB op can never leave stale
+        # filters past the owning broker's reconnect+reseed, and a stale
+        # drop (old worker's takeover purge, late close-then-reseed)
+        # cannot remove an entry a newer connection re-owns.
         owned: dict[str, set[str]] = {}
+
+        def _release(cid: str, filt: str) -> None:
+            key = (cid, filt)
+            n = self._owners.get(key, 0) - 1
+            if n <= 0:
+                self._owners.pop(key, None)
+                self.index.unsubscribe(cid, filt)
+            else:
+                self._owners[key] = n
+
         try:
             while True:
                 fr = await _read_frame(reader)
@@ -145,13 +165,19 @@ class MatcherService:
                     sub = _decode_sub(msg["v"])
                     if self.index.subscribe(msg["c"], sub):
                         self.subs_applied += 1
-                    owned.setdefault(msg["c"], set()).add(sub.filter)
+                    conn_set = owned.setdefault(msg["c"], set())
+                    if sub.filter not in conn_set:
+                        conn_set.add(sub.filter)
+                        key = (msg["c"], sub.filter)
+                        self._owners[key] = self._owners.get(key, 0) + 1
                 elif ftype == OP_UNSUB:
-                    self.index.unsubscribe(msg["c"], msg["f"])
-                    owned.get(msg["c"], set()).discard(msg["f"])
+                    conn_set = owned.get(msg["c"], set())
+                    if msg["f"] in conn_set:
+                        conn_set.discard(msg["f"])
+                        _release(msg["c"], msg["f"])
                 elif ftype == OP_DROP:
                     for filt in owned.pop(msg["c"], ()):
-                        self.index.unsubscribe(msg["c"], filt)
+                        _release(msg["c"], filt)
                 elif ftype == OP_MATCH:
                     t = asyncio.ensure_future(
                         self._match(msg["r"], msg["t"], writer))
@@ -161,7 +187,7 @@ class MatcherService:
             self._conns.discard(writer)
             for cid, filters in owned.items():
                 for filt in filters:
-                    self.index.unsubscribe(cid, filt)
+                    _release(cid, filt)
             for t in tasks:
                 t.cancel()
             writer.close()
@@ -206,6 +232,7 @@ class ServiceMatcher:
         self._pending: dict[int, asyncio.Future] = {}
         self._next_req = 0
         self._connect_lock = asyncio.Lock()
+        self._closed = False
         # callable(matcher) replaying current subscription state after a
         # reconnect (set by attach_matcher_service)
         self._reseed = None
@@ -224,11 +251,19 @@ class ServiceMatcher:
         async with self._connect_lock:
             if self._writer is not None:
                 return
-            self._reader, self._writer = await asyncio.open_unix_connection(
-                self.path)
-            self._reader_task = asyncio.ensure_future(self._read_loop())
+            reader, writer = await asyncio.open_unix_connection(self.path)
+            self._reader, self._writer = reader, writer
+            self._reader_task = asyncio.ensure_future(
+                self._read_loop(reader, writer))
 
     async def close(self) -> None:
+        # flag first: a queued _reconnect must not resurrect the
+        # connection (leaked fd + read-loop task + post-shutdown reseed)
+        self._closed = True
+        if self._reconnect_task is not None:
+            self._reconnect_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError, Exception):
+                await self._reconnect_task
         if self._reader_task is not None:
             self._reader_task.cancel()
             try:
@@ -242,34 +277,47 @@ class ServiceMatcher:
                 fut.cancel()
         self._pending.clear()
 
-    async def _read_loop(self) -> None:
+    async def _read_loop(self, reader, writer) -> None:
         try:
-            await self._read_loop_inner()
+            await self._read_loop_inner(reader, writer)
         except asyncio.CancelledError:
             raise
         except Exception:
             # a malformed frame must fail like EOF, not strand the
-            # pending futures behind a live-looking writer
-            self._writer = None
-            for fut, _t, _v in self._pending.values():
-                if not fut.done():
-                    fut.set_exception(
-                        ConnectionError("matcher service protocol error"))
-            self._pending.clear()
+            # pending futures behind a live-looking writer; close the
+            # transport (not just null it) or the fd leaks and the
+            # server's eventual purge of the half-open connection would
+            # race a later reconnect's reseed
+            self._drop_transport(writer, "matcher service protocol error")
 
-    async def _read_loop_inner(self) -> None:
+    def _drop_transport(self, writer=None,
+                        msg: str = "matcher service lost") -> None:
+        """Close a dead transport and fail its in-flight matches (the
+        broker degrades them to its CPU trie). When ``writer`` is given
+        and is NOT the current transport — a stale read-loop waking
+        after a reconnect already replaced it — only that stale fd is
+        closed; the live connection's state is untouched."""
+        if writer is not None and writer is not self._writer:
+            with contextlib.suppress(Exception):
+                writer.close()
+            return
+        w, self._writer = self._writer, None
+        self._reader = None
+        if w is not None:
+            with contextlib.suppress(Exception):
+                w.close()
+        for fut, _t, _v in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError(msg))
+        self._pending.clear()
+
+    async def _read_loop_inner(self, reader, writer) -> None:
         while True:
-            fr = await _read_frame(self._reader)
+            fr = await _read_frame(reader)
             if fr is None:
-                # connection lost: fail in-flight matches fast (the
-                # broker degrades them to its CPU trie) and mark the
-                # transport dead so enqueue() fails fast too
-                self._writer = None
-                for fut, _t, _v in self._pending.values():
-                    if not fut.done():
-                        fut.set_exception(
-                            ConnectionError("matcher service lost"))
-                self._pending.clear()
+                # connection lost: fail in-flight matches fast and
+                # close the dead transport so enqueue() fails fast too
+                self._drop_transport(writer)
                 return
             _ftype, payload = fr
             msg = json.loads(payload)
@@ -339,15 +387,31 @@ class ServiceMatcher:
         return fut
 
     async def _reconnect(self) -> None:
-        try:
-            self._reader, self._writer = \
-                await asyncio.open_unix_connection(self.path)
-        except OSError:
-            return                      # next enqueue retries
-        self._reader_task = asyncio.ensure_future(self._read_loop())
-        self.reconnects += 1
-        if self._reseed is not None:
-            self._reseed(self)          # replay current subscriptions
+        # under the connect lock: a concurrent connect() may already
+        # have restored a live transport, which a queued reconnect must
+        # not tear down
+        async with self._connect_lock:
+            if self._closed:
+                return
+            if self._writer is not None and not self._writer.is_closing():
+                return
+            # close any lingering old transport FIRST so the server
+            # purges that connection's subscription refs before (or
+            # concurrently with) the reseed replaying them on the new
+            # connection — the service-side refcounting makes either
+            # ordering safe, but a half-open fd must not leak
+            self._drop_transport()
+            try:
+                reader, writer = await asyncio.open_unix_connection(
+                    self.path)
+            except OSError:
+                return                  # next enqueue retries
+            self._reader, self._writer = reader, writer
+            self._reader_task = asyncio.ensure_future(
+                self._read_loop(reader, writer))
+            self.reconnects += 1
+            if self._reseed is not None:
+                self._reseed(self)      # replay current subscriptions
 
     async def subscribers_async(self, topic: str) -> SubscriberSet:
         return await self.enqueue(topic)

@@ -330,15 +330,22 @@ struct DecodeTable {
   PyObject *subs;       // list len A: Subscription
   PyObject *cache;      // verified-row-set bytes -> SubscriberSet
   PyObject *frag;       // row int -> single-row SubscriberSet fragment
-  Py_ssize_t cache_pairs = 0;  // total subscriber entries cached
+  Py_ssize_t cache_pairs = 0;  // subscriber entries in the row-set cache
+  Py_ssize_t frag_pairs = 0;   // subscriber entries in the fragment cache
   std::vector<PyObject *> key, cid, sub;  // borrowed from the lists
   Py_ssize_t R, W, A;
 };
 
-// The row-set result cache is bounded by the TOTAL subscriber entries
-// it holds (hot corpora cache few, fat sets — a per-key cap would let
-// 100K x 400-entry sets grow to GBs); past this the whole dict is
-// dropped. The table rotates on every subscription change anyway.
+// Each cache (fragments, row-set unions) is bounded by the TOTAL
+// subscriber entries it physically holds (hot corpora cache few, fat
+// sets — a per-key cap would let 100K x 400-entry sets grow to GBs);
+// past the cap that dict is dropped. The budgets are SEPARATE: a
+// multi-row union is a real dict copy of its base fragment plus the
+// delta (PyDict_Copy allocates fresh slots; only the Subscription
+// values are shared), so it is charged its full pair count against the
+// row-set budget — while fragment storage, charged once to its own
+// budget, no longer halves the row-set cache's effective capacity
+// (ADVICE r03 low). The table rotates on every subscription change.
 constexpr Py_ssize_t kDecodeCachePairsCap = 4 << 20;
 
 void table_destroy(PyObject *capsule) {
@@ -537,9 +544,15 @@ SubSetObject *fragment_for_row(DecodeTable *t, int32_t r) {
     return nullptr;
   }
   const Py_ssize_t pairs = subset_pairs(res);
-  if (t->cache_pairs + pairs > kDecodeCachePairsCap) {
-    PyDict_Clear(t->cache);
+  if (t->frag_pairs + pairs > kDecodeCachePairsCap) {
+    // clear BOTH dicts: single-row entries in t->cache alias fragment
+    // objects with pairs=0 charged, so dropping only t->frag would
+    // leave up to a full cap of fragment storage alive-but-uncounted
+    // through those aliases (resident could reach 3x cap); clearing
+    // both restores the documented 2x-cap bound
     PyDict_Clear(t->frag);
+    PyDict_Clear(t->cache);
+    t->frag_pairs = 0;
     t->cache_pairs = 0;
   }
   const int rc = PyDict_SetItem(t->frag, rk,
@@ -547,7 +560,7 @@ SubSetObject *fragment_for_row(DecodeTable *t, int32_t r) {
   Py_DECREF(rk);
   Py_DECREF(res);  // t->frag holds the ref; borrowed below
   if (rc < 0) return nullptr;
-  t->cache_pairs += pairs;
+  t->frag_pairs += pairs;
   return res;
 }
 
@@ -639,13 +652,12 @@ PyObject *cached_rowset_result(DecodeTable *t, const int32_t *rows,
       }
     }
   }
-  // a single-row result ALIASES its fragment, whose pairs were already
-  // charged by fragment_for_row — charging again would burn the budget
-  // at half rate and evict the fragment the moment it was built
+  // a single-row result ALIASES its fragment (no new dict storage —
+  // its pairs live in the fragment budget); a multi-row union owns a
+  // real copied dict and is charged in full against the row-set budget
   const Py_ssize_t pairs = n_rows == 1 ? 0 : subset_pairs(res);
   if (t->cache_pairs + pairs > kDecodeCachePairsCap) {
     PyDict_Clear(t->cache);
-    PyDict_Clear(t->frag);
     t->cache_pairs = 0;
   }
   int rc = PyDict_SetItem(t->cache, key, reinterpret_cast<PyObject *>(res));

@@ -222,6 +222,103 @@ async def test_service_matcher_topic_cache(tmp_path):
         await svc.close()
 
 
+async def test_takeover_refcounted_across_connections():
+    """Cross-worker session takeover (ADVICE r03 high): worker B
+    re-subscribes (cid, filter) on its connection, then worker A's
+    takeover-driven drop arrives — the index entry must survive until
+    the LAST owning connection releases it, in every op interleaving."""
+    path = _sock_path()
+    svc = MatcherService(path)
+    await svc.start()
+    try:
+        a, b = ServiceMatcher(path), ServiceMatcher(path)
+        await a.connect()
+        await b.connect()
+        sub = Subscription(filter="tk/+", qos=1)
+        a.forward_subscribe("cl", sub)
+        await a.subscribers_async("tk/x")          # barrier: op applied
+        # takeover: B re-subscribes, then A's stale drop arrives
+        b.forward_subscribe("cl", sub)
+        await b.subscribers_async("tk/x")
+        a.forward_drop("cl")
+        await a.subscribers_async("tk/x")
+        got = await b.subscribers_async("tk/x")
+        assert "cl" in got.subscriptions, \
+            "stale drop removed a re-owned subscription"
+        # A's connection closing entirely must not purge B's entry either
+        await a.close()
+        await asyncio.sleep(0.1)
+        got = await b.subscribers_async("tk/y")
+        assert "cl" in got.subscriptions
+        # the LAST owner's drop does release the entry
+        b.forward_drop("cl")
+        got = await b.subscribers_async("tk/x")
+        assert "cl" not in got.subscriptions
+        assert svc._owners == {}, "owner refs leaked"
+        await b.close()
+    finally:
+        await svc.close()
+
+
+async def test_unsub_only_releases_own_connections_ref():
+    """An UNSUB from a connection that never subscribed the filter must
+    not tear down another connection's live entry."""
+    path = _sock_path()
+    svc = MatcherService(path)
+    await svc.start()
+    try:
+        a, b = ServiceMatcher(path), ServiceMatcher(path)
+        await a.connect()
+        await b.connect()
+        a.forward_subscribe("cl", Subscription(filter="ur/+"))
+        await a.subscribers_async("ur/x")
+        b.forward_unsubscribe("cl", "ur/+")        # B never owned it
+        await b.subscribers_async("ur/x")
+        got = await a.subscribers_async("ur/x")
+        assert "cl" in got.subscriptions
+        await a.close()
+        await b.close()
+    finally:
+        await svc.close()
+
+
+async def test_protocol_error_closes_transport_before_reconnect():
+    """ADVICE r03 medium: a protocol error must CLOSE the old transport
+    (not just null it) so the server purges the dead connection's state;
+    the reconnect reseed then repopulates it without fd leaks."""
+    path = _sock_path()
+    svc = MatcherService(path)
+    await svc.start()
+    async with running_broker() as broker:
+        matcher = await attach_matcher_service(broker, path)
+        sub = await connect(broker, "pe-sub")
+        await sub.subscribe(("pe/#", 0))
+        await matcher.subscribers_async("pe/x")    # round trip ok
+        old_writer = matcher._writer
+        # inject garbage into the reader path by closing the server side:
+        # force a protocol error instead via a malformed internal frame
+        matcher._reader.feed_data(b"\x00\x00\x00\x02\x63{")  # bad frame
+        await asyncio.sleep(0.2)
+        assert matcher._writer is None
+        assert old_writer.is_closing(), "old transport leaked"
+        # next publish degrades to trie and kicks a reconnect that
+        # replays subscriptions on a FRESH connection
+        pub = await connect(broker, "pe-pub")
+        for i in range(50):
+            await pub.publish(f"pe/r{i}", b"x")
+            await sub.next_message(timeout=10)
+            if matcher.reconnects:
+                break
+            await asyncio.sleep(0.05)
+        assert matcher.reconnects >= 1
+        got = await matcher.subscribers_async("pe/q")
+        assert "pe-sub" in got.subscriptions
+        await sub.disconnect()
+        await pub.disconnect()
+        await matcher.close()
+    await svc.close()
+
+
 async def test_cli_matcher_service_command(tmp_path):
     """`maxmq matcher-service` serves a usable socket (subprocess)."""
     import os
