@@ -210,8 +210,19 @@ def run_sig(engine, batches, depth: int):
 
 
 def run_subscribers(engine, batches, depth: int):
-    """Pipelined decode-inclusive matching (merged SubscriberSets out).
-    Returns total delivered (client, topic) pairs."""
+    """Pipelined decode-inclusive matching: merged SubscriberSets or
+    DeliveryIntents out, per ``engine.emit_intents`` (ADR 007 — intents
+    are the production broker boundary; sets are the reference-shaped
+    Subscribers() form). Returns total delivered (client, topic) pairs."""
+
+    def units(s):
+        # sets: plain entries + shared GROUPS (historic metric);
+        # intents: n is the plain count, shared counted the same way
+        n = getattr(s, "n", None)
+        if n is not None:
+            return n + (len(s.shared) if len(s) != n else 0)
+        return len(s.subscriptions) + len(s.shared)
+
     delivered = 0
     pending = deque()
 
@@ -219,8 +230,7 @@ def run_subscribers(engine, batches, depth: int):
         nonlocal delivered
         topics, ctx = pending.popleft()
         res = engine.collect_fixed(topics, ctx)
-        delivered += sum(len(s.subscriptions) + len(s.shared)
-                         for s in res)
+        delivered += sum(units(s) for s in res)
 
     for topics in batches:
         pending.append((topics, engine.dispatch_fixed(topics)))
@@ -319,12 +329,19 @@ def stage_decomposition(engine, topics_batch: list[str],
 
     ctx = engine.dispatch_fixed(topics_batch)
     cnt, rows, hr, tbl = engine.match_fixed([], out=ctx)
-    t0 = time.perf_counter()
-    for _ in range(iters):
+    saved_emit = engine.emit_intents
+    for form, emit in (("intents", True), ("sets", False)):
+        engine.emit_intents = emit
         engine.decode_fixed(topics_batch, cnt, rows, hr, tbl,
-                            ctx[4], ctx[5])
-    d["decode_topics_per_sec"] = round(
-        batch * iters / (time.perf_counter() - t0), 1)
+                            ctx[4], ctx[5])          # warm the caches
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            engine.decode_fixed(topics_batch, cnt, rows, hr, tbl,
+                                ctx[4], ctx[5])
+        d[f"decode_{form}_topics_per_sec"] = round(
+            batch * iters / (time.perf_counter() - t0), 1)
+    engine.emit_intents = saved_emit
+    d["decode_topics_per_sec"] = d["decode_intents_topics_per_sec"]
     log(f"[stages] prep {d['host_prep_topics_per_sec']:,.0f}/s  "
         f"device {d['device_only_topics_per_sec']:,.0f}/s  "
         f"decode {d['decode_topics_per_sec']:,.0f}/s  "
@@ -359,11 +376,25 @@ def bench_config(name: str, n_subs: int, batch: int, iters: int,
     raw_dt = time.perf_counter() - t0
     raw_rate = batch * iters / raw_dt
 
+    # decode-inclusive, production boundary (ADR 007): DeliveryIntents —
+    # what the broker's fan-out actually consumes, exactly as the
+    # reference's Subscribers() returns what ITS fan-out consumes
+    engine.emit_intents = True
     run_subscribers(engine, batches[:1], depth)  # warm
     t0 = time.perf_counter()
     delivered = run_subscribers(engine, batches, depth)
     dec_dt = time.perf_counter() - t0
     dec_rate = batch * iters / dec_dt
+
+    # merged-SubscriberSet form (round-3 continuity; the pre-ADR-007
+    # boundary) — warmed like the intents pass so the published
+    # set-vs-intents comparison is like-for-like, then one timed pass
+    engine.emit_intents = False
+    run_subscribers(engine, batches[:1], depth)  # warm the set caches
+    t0 = time.perf_counter()
+    run_subscribers(engine, batches[:1], depth)
+    set_rate = batch / (time.perf_counter() - t0)
+    engine.emit_intents = True
 
     # our python CPU trie on the same corpus: secondary reference point
     sample = batches[0][:2000]
@@ -383,6 +414,8 @@ def bench_config(name: str, n_subs: int, batch: int, iters: int,
         "pipeline_depth": depth,
         **({"stages": stages} if stages else {}),
         "matches_per_sec": round(dec_rate, 1),
+        "boundary_form": "delivery_intents",
+        "mergedset_matches_per_sec": round(set_rate, 1),
         "raw_slot_matches_per_sec": round(raw_rate, 1),
         "delivered_pairs": delivered,
         "matched_rows": matched, "overflow_topics": n_over,

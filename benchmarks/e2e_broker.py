@@ -270,6 +270,7 @@ async def main() -> None:
                     "    from maxmq_tpu.matching.batcher import "
                     "MicroBatcher\n"
                     "    eng = SigEngine(b.topics)\n"
+                    "    eng.emit_intents = True\n"
                     "    eng.warm_buckets(256, background=False)\n"
                     "    b.attach_matcher(MicroBatcher(eng))\n")
             elif args.matcher == "service":

@@ -92,6 +92,10 @@ def build_matcher(conf: Config, broker: Broker):
         from .matching.sig import SigEngine
         engine = SigEngine(broker.topics,
                            max_levels=conf.matcher_max_levels)
+        # fan-out-ready DeliveryIntents from the native decode (ADR 007)
+        # — the broker handles both result shapes, so this is safe to
+        # default on; matcher_intents = false restores merged sets
+        engine.emit_intents = conf.matcher_intents
     else:
         raise ValueError(f"unknown matcher {conf.matcher!r}")
     from .matching.batcher import MicroBatcher

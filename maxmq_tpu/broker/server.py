@@ -710,16 +710,54 @@ class Broker:
             subscribers = self.topics.subscribers(packet.topic)
         self._fan_out(subscribers, packet)
 
-    def _fan_out(self, subscribers: SubscriberSet, packet: Packet) -> None:
+    def _fan_out(self, subscribers, packet: Packet) -> None:
         """Sync fan-out half (no awaits): shared-group selection + per-
         subscriber delivery. The trie path calls it directly so a QoS0
-        publish costs no extra coroutine hop."""
-        if self.hooks.overrides("on_select_subscribers"):
-            subscribers = self._select_subscribers(subscribers, packet)
+        publish costs no extra coroutine hop.
 
-        # $share: pick one member per (group, filter), merging per client
+        ``subscribers`` is either a SubscriberSet or a DeliveryIntents
+        (ADR 007: the native decode's fan-out-ready form — iterable of
+        (cid, sub) with a ``shared`` dict and ``has_client``). Intents
+        skip the merged-dict materialization on the hot path; the hook
+        override path materializes via ``to_set()`` since hooks expect
+        the full SubscriberSet surface."""
+        to_set = getattr(subscribers, "to_set", None)
+        if to_set is not None and self.hooks.overrides(
+                "on_select_subscribers"):
+            # shared_only hooks (the worker-pool $share ownership
+            # filter) only drop keys from the outer shared dict: on a
+            # shared-free intents result they are identity, so the fast
+            # path survives — pool deployments must not pay set
+            # materialization on every publish
+            shared_only = all(
+                getattr(h, "select_subscribers_shared_only", False)
+                for h in self.hooks._overriders("on_select_subscribers"))
+            if not (shared_only and len(subscribers) == subscribers.n):
+                subscribers = to_set()
+                to_set = None
+        if to_set is None:
+            if self.hooks.overrides("on_select_subscribers"):
+                subscribers = self._select_subscribers(subscribers, packet)
+            shared = subscribers.shared
+            if shared:
+                plain = subscribers.subscriptions
+                self._fan_out_shared(shared, plain.__contains__, packet)
+            for cid, sub in subscribers.subscriptions.items():
+                self._publish_to_client(cid, sub, packet, shared=False)
+            return
+        # intents fast path: flat entries, no dict in sight
+        if len(subscribers) != subscribers.n:   # any shared candidates?
+            self._fan_out_shared(subscribers.shared,
+                                 subscribers.has_client, packet)
+        for cid, sub in subscribers:
+            self._publish_to_client(cid, sub, packet, shared=False)
+
+    def _fan_out_shared(self, shared, has_plain, packet: Packet) -> None:
+        """$share: pick one member per (group, filter), merging per
+        client; a client already receiving a plain delivery is skipped
+        [MQTT-4.8.2-4]."""
         selected: dict[str, Subscription] = {}
-        for (group, filt), candidates in subscribers.shared.items():
+        for (group, filt), candidates in shared.items():
             pick = self.topics.select_shared(
                 group, filt, candidates,
                 alive=lambda cid: (c := self.clients.get(cid)) is not None
@@ -730,10 +768,8 @@ class Broker:
                 if prev is None or sub.qos > prev.qos:
                     selected[cid] = sub
         for cid, sub in selected.items():
-            if cid not in subscribers.subscriptions:
+            if not has_plain(cid):
                 self._publish_to_client(cid, sub, packet, shared=True)
-        for cid, sub in subscribers.subscriptions.items():
-            self._publish_to_client(cid, sub, packet, shared=False)
 
     async def _match_async(self, topic: str) -> SubscriberSet:
         async_fn = getattr(self.matcher, "subscribers_async", None)

@@ -86,13 +86,19 @@ class MatcherService:
     def __init__(self, path: str, engine_factory=None) -> None:
         self.path = path
         self.index = TopicIndex()
-        # (cid, filter) -> number of live connections owning that entry.
-        # Ownership is refcounted ACROSS connections: during cross-worker
-        # session takeover, worker B's re-subscribe and worker A's
-        # takeover-driven drop race over the same (cid, filter) key — the
-        # index entry must survive until the LAST owner releases it, or a
-        # live client silently loses matcher-path deliveries.
-        self._owners: dict[tuple, int] = {}
+        # (cid, filter) -> [generation, owner-count]. Ownership is
+        # refcounted ACROSS connections: during cross-worker session
+        # takeover, worker B's re-subscribe and worker A's takeover-
+        # driven drop race over the same (cid, filter) key — the index
+        # entry must survive until the LAST owner releases it, or a
+        # live client silently loses matcher-path deliveries. An
+        # explicit OP_UNSUB is AUTHORITATIVE (the client said stop):
+        # it voids the entry immediately for every owner; the
+        # generation guards a voided-then-resubscribed entry against a
+        # stale owner's late release (a wedged old worker's connection
+        # dying minutes later must not tear down the new entry).
+        self._owners: dict[tuple, list] = {}
+        self._gen = 0
         if engine_factory is None:
             def engine_factory(index):
                 from .batcher import MicroBatcher
@@ -137,22 +143,25 @@ class MatcherService:
         self._conns.add(writer)
         # subscription state is OWNED BY THIS CONNECTION, but ownership
         # of an index entry is REFCOUNTED across connections via
-        # self._owners: a (cid, filter) leaves the index only when its
-        # last owning connection releases it. When the connection drops,
-        # its refs are released — a lost UNSUB op can never leave stale
-        # filters past the owning broker's reconnect+reseed, and a stale
-        # drop (old worker's takeover purge, late close-then-reseed)
-        # cannot remove an entry a newer connection re-owns.
-        owned: dict[str, set[str]] = {}
+        # self._owners: a (cid, filter) leaves the index when its last
+        # owning connection releases it — OR immediately on an explicit
+        # OP_UNSUB (authoritative). When the connection drops, its refs
+        # are released generation-guarded — a lost UNSUB op can never
+        # leave stale filters past the owning broker's reconnect+reseed,
+        # and a stale drop (old worker's takeover purge, late
+        # close-then-reseed) cannot remove an entry a newer connection
+        # re-owns. owned: cid -> {filter: generation at acquire}.
+        owned: dict[str, dict[str, int]] = {}
 
-        def _release(cid: str, filt: str) -> None:
+        def _release(cid: str, filt: str, gen: int) -> None:
             key = (cid, filt)
-            n = self._owners.get(key, 0) - 1
-            if n <= 0:
-                self._owners.pop(key, None)
+            ent = self._owners.get(key)
+            if ent is None or ent[0] != gen:
+                return          # voided/re-owned since we acquired it
+            ent[1] -= 1
+            if ent[1] <= 0:
+                del self._owners[key]
                 self.index.unsubscribe(cid, filt)
-            else:
-                self._owners[key] = n
 
         try:
             while True:
@@ -165,19 +174,26 @@ class MatcherService:
                     sub = _decode_sub(msg["v"])
                     if self.index.subscribe(msg["c"], sub):
                         self.subs_applied += 1
-                    conn_set = owned.setdefault(msg["c"], set())
-                    if sub.filter not in conn_set:
-                        conn_set.add(sub.filter)
-                        key = (msg["c"], sub.filter)
-                        self._owners[key] = self._owners.get(key, 0) + 1
+                    conn_map = owned.setdefault(msg["c"], {})
+                    key = (msg["c"], sub.filter)
+                    ent = self._owners.get(key)
+                    if ent is None:
+                        self._gen += 1
+                        ent = self._owners[key] = [self._gen, 0]
+                    if conn_map.get(sub.filter) != ent[0]:
+                        conn_map[sub.filter] = ent[0]
+                        ent[1] += 1
                 elif ftype == OP_UNSUB:
-                    conn_set = owned.get(msg["c"], set())
-                    if msg["f"] in conn_set:
-                        conn_set.discard(msg["f"])
-                        _release(msg["c"], msg["f"])
+                    # authoritative: the client unsubscribed — stop
+                    # matching NOW for every owner, not when the last
+                    # (possibly wedged) connection finally dies
+                    owned.get(msg["c"], {}).pop(msg["f"], None)
+                    if self._owners.pop((msg["c"], msg["f"]), None) \
+                            is not None:
+                        self.index.unsubscribe(msg["c"], msg["f"])
                 elif ftype == OP_DROP:
-                    for filt in owned.pop(msg["c"], ()):
-                        _release(msg["c"], filt)
+                    for filt, gen in owned.pop(msg["c"], {}).items():
+                        _release(msg["c"], filt, gen)
                 elif ftype == OP_MATCH:
                     t = asyncio.ensure_future(
                         self._match(msg["r"], msg["t"], writer))
@@ -186,8 +202,8 @@ class MatcherService:
         finally:
             self._conns.discard(writer)
             for cid, filters in owned.items():
-                for filt in filters:
-                    _release(cid, filt)
+                for filt, gen in filters.items():
+                    _release(cid, filt, gen)
             for t in tasks:
                 t.cancel()
             writer.close()

@@ -1019,6 +1019,13 @@ def _native_decode(tables):
                 np.ascontiguousarray(tok),
                 np.ascontiguousarray(min_depth), flags, offsets,
                 np.array(kinds, dtype=np.uint8), keys, cids, subs)
+            if hasattr(mod, "table_release"):
+                # cached DeliveryIntents hold the capsule alive and the
+                # capsule's caches hold them — an uncollectible cycle
+                # (capsules aren't GC-tracked). Break it when the
+                # snapshot is dropped; handed-out results stay valid.
+                import weakref
+                weakref.finalize(tables, mod.table_release, cap)
             nd = (mod, cap)
     except Exception:
         nd = None
@@ -1290,6 +1297,12 @@ class SigEngine(OverlayedEngine):
         # False = XLA body
         self.use_pallas = use_pallas
         self.pallas_active = False
+        # emit DeliveryIntents (flat fan-out-ready entries, ADR 007)
+        # instead of merged SubscriberSet dicts from the native decode —
+        # the production broker path; falls back to sets automatically
+        # for overlay windows, CPU-trie fallbacks, and when the C
+        # extension is absent (consumers handle both shapes)
+        self.emit_intents = False
         self._state = None
         self._refresh_lock = threading.Lock()
         self.fallbacks = 0
@@ -1748,11 +1761,19 @@ class SigEngine(OverlayedEngine):
         if nd is not None:
             # one C pass: verify + the whole entry union (plain inserts,
             # identifier merges via the merge_subscription callback,
-            # shared-group maps) + the SubscriberSet construction —
-            # nothing left to walk in python
+            # shared-group maps) + the result construction — nothing
+            # left to walk in python. Intents mode (ADR 007) skips the
+            # merged-dict materialization entirely: flat borrowed-
+            # pointer entries the broker fans out directly. Overlay
+            # windows need merge_delta's set mutation, so they keep the
+            # set form until the background recompile lands.
             mod, capsule = nd
             _dt, pad = _compact_dtype(tables)
-            out = mod.decode_batch(
+            decode_fn = (mod.decode_batch_intents
+                         if self.emit_intents and overlay is None
+                         and hasattr(mod, "decode_batch_intents")
+                         else mod.decode_batch)
+            out = decode_fn(
                 capsule, toks8, toks8.dtype.itemsize, int(pad), lens_enc,
                 batch, np.ascontiguousarray(ti),
                 np.ascontiguousarray(rw))

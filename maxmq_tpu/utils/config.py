@@ -73,6 +73,9 @@ class Config:
     matcher: str = "sig"                # trie | nfa | dense | sig | service
     matcher_batch_window_us: int = 200
     matcher_max_batch: int = 256
+    # native decode emits fan-out-ready DeliveryIntents (ADR 007)
+    # instead of merged SubscriberSet dicts on the publish hot path
+    matcher_intents: bool = True
     matcher_max_levels: int = 16
     matcher_mesh: str = ""              # e.g. "2x4" to shard over a mesh
     matcher_socket: str = "/tmp/maxmq-matcher.sock"  # matcher = "service"

@@ -305,6 +305,257 @@ PyType_Spec subset_spec = {
     Py_TPFLAGS_DEFAULT | Py_TPFLAGS_BASETYPE | Py_TPFLAGS_HAVE_GC,
     subset_slots};
 
+// ----------------------------------------------------------------- //
+//  DeliveryIntents — the fan-out hot-path result type               //
+// ----------------------------------------------------------------- //
+//
+// The broker's fan-out does not need a {client_id: Subscription} dict
+// per publish — it needs to ITERATE deliveries (reference boundary:
+// publishToSubscribers consuming Subscribers(), vendor/.../v2/
+// server.go:766-793). Materializing the merged dict per topic is what
+// capped the 1M-sub decode at ~12K topics/s: ~330 scattered dict
+// inserts + ~660 refcount writes across a ~1M-object heap per topic
+// (BASELINE-COMPARE.md r03). DeliveryIntents replaces that with two
+// flat pointer arrays BORROWED from the immutable decode table (kept
+// alive by one strong ref to the table capsule): construction per
+// row-set is an epoch-stamped dedupe writing int32s and pointers —
+// no dict, no per-entry refcounting. Same-client overlapping-filter
+// collisions (rare) still route through merge_subscription and own
+// their merged record. Shared-group candidates keep the dict shape
+// ($share selection needs keyed maps). to_set() materializes a full
+// SubscriberSet lazily for the hook path (on_select_subscribers) and
+// caches it — intents are cached per row-set and shared across
+// topics, so consumers treat them as immutable, like cached sets.
+
+struct IntentsObject {
+  PyObject_HEAD
+  PyObject *table_cap;  // strong ref: keeps borrowed cid/sub ptrs alive
+  Py_ssize_t n;         // plain (non-shared) delivery entries
+  PyObject **cids;      // [n] borrowed from the table's cid list
+  PyObject **subs;      // [n] borrowed, or owned when owned[i]
+  uint8_t *owned;       // [n] subs[i] is an owned merged Subscription
+  PyObject *shared;     // (group, filter) -> {cid: sub}, or NULL
+  PyObject *set_cache;  // lazily-built SubscriberSet twin
+};
+
+PyTypeObject *g_intents_type = nullptr;
+PyTypeObject *g_intents_iter_type = nullptr;
+
+IntentsObject *intents_alloc(PyObject *capsule, Py_ssize_t capacity) {
+  auto *self = PyObject_GC_New(IntentsObject, g_intents_type);
+  if (!self) return nullptr;
+  self->table_cap = Py_NewRef(capsule);
+  self->n = 0;
+  self->cids = nullptr;
+  self->subs = nullptr;
+  self->owned = nullptr;
+  self->shared = nullptr;
+  self->set_cache = nullptr;
+  if (capacity) {
+    self->cids = static_cast<PyObject **>(
+        PyMem_Malloc(capacity * sizeof(PyObject *)));
+    self->subs = static_cast<PyObject **>(
+        PyMem_Malloc(capacity * sizeof(PyObject *)));
+    self->owned = static_cast<uint8_t *>(PyMem_Malloc(capacity));
+    if (!self->cids || !self->subs || !self->owned) {
+      PyObject_GC_Track(self);
+      Py_DECREF(self);
+      PyErr_NoMemory();
+      return nullptr;
+    }
+  }
+  PyObject_GC_Track(self);
+  return self;
+}
+
+int intents_traverse(PyObject *self_o, visitproc visit, void *arg) {
+  auto *self = reinterpret_cast<IntentsObject *>(self_o);
+  Py_VISIT(self->table_cap);
+  Py_VISIT(self->shared);
+  Py_VISIT(self->set_cache);
+  for (Py_ssize_t i = 0; i < self->n; i++)
+    if (self->owned && self->owned[i]) Py_VISIT(self->subs[i]);
+  return 0;
+}
+
+int intents_clear_slot(PyObject *self_o) {
+  auto *self = reinterpret_cast<IntentsObject *>(self_o);
+  if (self->owned)
+    for (Py_ssize_t i = 0; i < self->n; i++)
+      if (self->owned[i]) Py_CLEAR(self->subs[i]);
+  self->n = 0;
+  PyMem_Free(self->cids);
+  PyMem_Free(self->subs);
+  PyMem_Free(self->owned);
+  self->cids = self->subs = nullptr;
+  self->owned = nullptr;
+  Py_CLEAR(self->table_cap);
+  Py_CLEAR(self->shared);
+  Py_CLEAR(self->set_cache);
+  return 0;
+}
+
+void intents_dealloc(PyObject *self_o) {
+  PyObject_GC_UnTrack(self_o);
+  intents_clear_slot(self_o);
+  PyTypeObject *tp = Py_TYPE(self_o);
+  PyObject_GC_Del(self_o);
+  Py_DECREF(tp);
+}
+
+Py_ssize_t intents_len(PyObject *self_o) {
+  auto *self = reinterpret_cast<IntentsObject *>(self_o);
+  Py_ssize_t n = self->n;
+  if (self->shared) {
+    PyObject *k, *v;
+    Py_ssize_t pos = 0;
+    while (PyDict_Next(self->shared, &pos, &k, &v)) n += PyDict_Size(v);
+  }
+  return n;
+}
+
+// to_set() -> SubscriberSet (cached): the hook-path materialization
+PyObject *intents_to_set(PyObject *self_o, PyObject *) {
+  auto *self = reinterpret_cast<IntentsObject *>(self_o);
+  if (self->set_cache) return Py_NewRef(self->set_cache);
+  PyObject *subs = PyDict_New();
+  if (!subs) return nullptr;
+  for (Py_ssize_t i = 0; i < self->n; i++)
+    if (PyDict_SetItem(subs, self->cids[i], self->subs[i]) < 0) {
+      Py_DECREF(subs);
+      return nullptr;
+    }
+  // outer dict is fresh (callers re-wrap/copy it before dropping keys);
+  // inner member dicts may be shared — consumers never mutate them
+  PyObject *shared =
+      self->shared ? PyDict_Copy(self->shared) : PyDict_New();
+  if (!shared) {
+    Py_DECREF(subs);
+    return nullptr;
+  }
+  auto *res = subset_new_fast(subs, shared);
+  Py_DECREF(subs);
+  Py_DECREF(shared);
+  if (!res) return nullptr;
+  self->set_cache = reinterpret_cast<PyObject *>(res);
+  return Py_NewRef(self->set_cache);
+}
+
+// has_client(cid) -> bool; linear scan (used only by the rare $share
+// overlap check, on sets of a few hundred entries at most)
+PyObject *intents_has_client(PyObject *self_o, PyObject *cid) {
+  auto *self = reinterpret_cast<IntentsObject *>(self_o);
+  for (Py_ssize_t i = 0; i < self->n; i++) {
+    if (self->cids[i] == cid) Py_RETURN_TRUE;
+    const int eq = PyObject_RichCompareBool(self->cids[i], cid, Py_EQ);
+    if (eq < 0) return nullptr;
+    if (eq) Py_RETURN_TRUE;
+  }
+  Py_RETURN_FALSE;
+}
+
+PyObject *intents_get_shared(PyObject *self_o, void *) {
+  auto *self = reinterpret_cast<IntentsObject *>(self_o);
+  if (!self->shared) {
+    self->shared = PyDict_New();
+    if (!self->shared) return nullptr;
+  }
+  return Py_NewRef(self->shared);
+}
+
+PyObject *intents_get_n(PyObject *self_o, void *) {
+  return PyLong_FromSsize_t(
+      reinterpret_cast<IntentsObject *>(self_o)->n);
+}
+
+struct IntentsIterObject {
+  PyObject_HEAD
+  IntentsObject *it;  // strong
+  Py_ssize_t i;
+};
+
+PyObject *intents_iter(PyObject *self_o) {
+  auto *iter = PyObject_GC_New(IntentsIterObject, g_intents_iter_type);
+  if (!iter) return nullptr;
+  iter->it = reinterpret_cast<IntentsObject *>(Py_NewRef(self_o));
+  iter->i = 0;
+  PyObject_GC_Track(iter);
+  return reinterpret_cast<PyObject *>(iter);
+}
+
+PyObject *intents_iternext(PyObject *self_o) {
+  auto *self = reinterpret_cast<IntentsIterObject *>(self_o);
+  if (self->i >= self->it->n) return nullptr;  // StopIteration
+  const Py_ssize_t i = self->i++;
+  return PyTuple_Pack(2, self->it->cids[i], self->it->subs[i]);
+}
+
+int intents_iter_traverse(PyObject *self_o, visitproc visit, void *arg) {
+  Py_VISIT(reinterpret_cast<IntentsIterObject *>(self_o)->it);
+  return 0;
+}
+
+void intents_iter_dealloc(PyObject *self_o) {
+  PyObject_GC_UnTrack(self_o);
+  Py_CLEAR(reinterpret_cast<IntentsIterObject *>(self_o)->it);
+  PyTypeObject *tp = Py_TYPE(self_o);
+  PyObject_GC_Del(self_o);
+  Py_DECREF(tp);
+}
+
+PyObject *intents_repr(PyObject *self_o) {
+  auto *self = reinterpret_cast<IntentsObject *>(self_o);
+  return PyUnicode_FromFormat(
+      "DeliveryIntents(n=%zd, shared=%zd)", self->n,
+      self->shared ? PyDict_Size(self->shared) : (Py_ssize_t)0);
+}
+
+PyMethodDef intents_methods[] = {
+    {"to_set", intents_to_set, METH_NOARGS,
+     "Materialize (and cache) the SubscriberSet twin for hook paths."},
+    {"has_client", intents_has_client, METH_O,
+     "True when the client id has a plain (non-shared) delivery entry."},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyGetSetDef intents_getset[] = {
+    {"shared", intents_get_shared, nullptr,
+     "(group, filter) -> {client_id: Subscription} candidates", nullptr},
+    {"n", intents_get_n, nullptr, "plain delivery entry count", nullptr},
+    {nullptr, nullptr, nullptr, nullptr, nullptr}};
+
+PyType_Slot intents_slots[] = {
+    {Py_tp_doc, const_cast<char *>(
+         "Per-topic delivery intents: iterable of (client_id, "
+         "Subscription) plus shared-group candidate maps — the "
+         "fan-out-ready decode result that skips merged-dict "
+         "construction. Immutable; shared across topics and calls.")},
+    {Py_tp_dealloc, reinterpret_cast<void *>(intents_dealloc)},
+    {Py_tp_traverse, reinterpret_cast<void *>(intents_traverse)},
+    {Py_tp_clear, reinterpret_cast<void *>(intents_clear_slot)},
+    {Py_tp_methods, intents_methods},
+    {Py_tp_getset, intents_getset},
+    {Py_tp_iter, reinterpret_cast<void *>(intents_iter)},
+    {Py_sq_length, reinterpret_cast<void *>(intents_len)},
+    {Py_tp_repr, reinterpret_cast<void *>(intents_repr)},
+    {0, nullptr}};
+
+PyType_Spec intents_spec = {
+    "maxmq_decode.DeliveryIntents", sizeof(IntentsObject), 0,
+    Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC | Py_TPFLAGS_DISALLOW_INSTANTIATION,
+    intents_slots};
+
+PyType_Slot intents_iter_slots[] = {
+    {Py_tp_dealloc, reinterpret_cast<void *>(intents_iter_dealloc)},
+    {Py_tp_traverse, reinterpret_cast<void *>(intents_iter_traverse)},
+    {Py_tp_iter, reinterpret_cast<void *>(PyObject_SelfIter)},
+    {Py_tp_iternext, reinterpret_cast<void *>(intents_iternext)},
+    {0, nullptr}};
+
+PyType_Spec intents_iter_spec = {
+    "maxmq_decode._DeliveryIntentsIter", sizeof(IntentsIterObject), 0,
+    Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC | Py_TPFLAGS_DISALLOW_INSTANTIATION,
+    intents_iter_slots};
+
 // configure(merge_fn, copy_sub_fn) — register the python semantics
 PyObject *configure(PyObject *, PyObject *args) {
   PyObject *merge, *copy;
@@ -330,9 +581,18 @@ struct DecodeTable {
   PyObject *subs;       // list len A: Subscription
   PyObject *cache;      // verified-row-set bytes -> SubscriberSet
   PyObject *frag;       // row int -> single-row SubscriberSet fragment
+  PyObject *icache;     // verified-row-set bytes -> DeliveryIntents
   Py_ssize_t cache_pairs = 0;  // subscriber entries in the row-set cache
   Py_ssize_t frag_pairs = 0;   // subscriber entries in the fragment cache
+  Py_ssize_t icache_pairs = 0;  // entries in the intents cache
   std::vector<PyObject *> key, cid, sub;  // borrowed from the lists
+  // intents union scratch: per-action interned client index + an
+  // epoch-stamped per-client slot map (no per-topic clearing)
+  std::vector<int32_t> act_cidx;  // [A]; -1 for shared actions
+  std::vector<int64_t> stamp;     // [n_clients] last epoch seen
+  std::vector<int32_t> slot;      // [n_clients] entry index this epoch
+  int64_t epoch = 0;
+  PyObject *empty_intents = nullptr;  // shared zero-entry result
   Py_ssize_t R, W, A;
 };
 
@@ -362,6 +622,8 @@ void table_destroy(PyObject *capsule) {
   Py_XDECREF(t->subs);
   Py_XDECREF(t->cache);
   Py_XDECREF(t->frag);
+  Py_XDECREF(t->icache);
+  Py_XDECREF(t->empty_intents);
   delete t;
 }
 
@@ -419,7 +681,8 @@ PyObject *table_new(PyObject *, PyObject *args) {
   t->subs = Py_NewRef(subs);
   t->cache = PyDict_New();
   t->frag = PyDict_New();
-  if (!t->cache || !t->frag) return fail(nullptr);
+  t->icache = PyDict_New();
+  if (!t->cache || !t->frag || !t->icache) return fail(nullptr);
   t->key.resize(t->A);
   t->cid.resize(t->A);
   t->sub.resize(t->A);
@@ -428,7 +691,59 @@ PyObject *table_new(PyObject *, PyObject *args) {
     t->cid[a] = PyList_GET_ITEM(cids, a);
     t->sub[a] = PyList_GET_ITEM(subs, a);
   }
+  // intern client ids to dense indices for the intents union scratch
+  {
+    const auto *kind = static_cast<const uint8_t *>(t->kinds.buf);
+    t->act_cidx.resize(t->A);
+    PyObject *interned = PyDict_New();
+    if (!interned) return fail(nullptr);
+    Py_ssize_t C = 0;
+    for (Py_ssize_t a = 0; a < t->A; a++) {
+      if (kind[a] == ACT_SHARED) {
+        t->act_cidx[a] = -1;
+        continue;
+      }
+      PyObject *idx = PyDict_GetItemWithError(interned, t->cid[a]);
+      if (idx) {
+        t->act_cidx[a] = static_cast<int32_t>(PyLong_AsSsize_t(idx));
+      } else {
+        if (PyErr_Occurred()) {
+          Py_DECREF(interned);
+          return fail(nullptr);
+        }
+        PyObject *nv = PyLong_FromSsize_t(C);
+        if (!nv || PyDict_SetItem(interned, t->cid[a], nv) < 0) {
+          Py_XDECREF(nv);
+          Py_DECREF(interned);
+          return fail(nullptr);
+        }
+        Py_DECREF(nv);
+        t->act_cidx[a] = static_cast<int32_t>(C++);
+      }
+    }
+    Py_DECREF(interned);
+    t->stamp.assign(C, 0);
+    t->slot.resize(C);
+  }
   return capsule;
+}
+
+// table_release(capsule) — break the table->caches->intents->capsule
+// reference cycle when the python side drops a compiled snapshot.
+// Capsules are not GC-tracked, so without this the whole table (token
+// arrays, entry lists, every cached result) would leak on rotation.
+// Outstanding handed-out results still hold the capsule and stay valid;
+// only the table-held caches are dropped.
+PyObject *table_release(PyObject *, PyObject *cap) {
+  auto *t = static_cast<DecodeTable *>(
+      PyCapsule_GetPointer(cap, "maxmq_decode.table"));
+  if (!t) return nullptr;
+  if (t->cache) PyDict_Clear(t->cache);
+  if (t->frag) PyDict_Clear(t->frag);
+  if (t->icache) PyDict_Clear(t->icache);
+  Py_CLEAR(t->empty_intents);
+  t->cache_pairs = t->frag_pairs = t->icache_pairs = 0;
+  Py_RETURN_NONE;
 }
 
 inline int32_t topic_tok(const void *base, int mode, int32_t pad,
@@ -670,6 +985,123 @@ PyObject *cached_rowset_result(DecodeTable *t, const int32_t *rows,
   return reinterpret_cast<PyObject *>(res);
 }
 
+// build-or-fetch DeliveryIntents for one verified, sorted, deduped row
+// set; NEW reference. The union is an epoch-stamped dedupe over the
+// rows' action streams — int32/pointer writes only; merge_subscription
+// runs solely on same-client collisions and v5-identifier entries.
+PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
+                                const int32_t *rows, Py_ssize_t n_rows) {
+  PyObject *key = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(rows),
+      n_rows * (Py_ssize_t)sizeof(int32_t));
+  if (!key) return nullptr;
+  PyObject *hit = PyDict_GetItemWithError(t->icache, key);
+  if (hit) {
+    Py_DECREF(key);
+    return Py_NewRef(hit);
+  }
+  if (PyErr_Occurred()) {
+    Py_DECREF(key);
+    return nullptr;
+  }
+  const auto *off = static_cast<const int64_t *>(t->offsets.buf);
+  const auto *kind = static_cast<const uint8_t *>(t->kinds.buf);
+  Py_ssize_t total = 0;
+  for (Py_ssize_t i = 0; i < n_rows; i++)
+    total += off[rows[i] + 1] - off[rows[i]];
+  IntentsObject *it = intents_alloc(cap, total);
+  if (!it) {
+    Py_DECREF(key);
+    return nullptr;
+  }
+  auto bail = [&]() -> PyObject * {
+    Py_DECREF(key);
+    Py_DECREF(it);
+    return nullptr;
+  };
+  const int64_t e = ++t->epoch;
+  Py_ssize_t n = 0;
+  Py_ssize_t sh_pairs = 0;
+  for (Py_ssize_t i = 0; i < n_rows; i++) {
+    const int64_t r = rows[i];
+    for (int64_t a = off[r]; a < off[r + 1]; a++) {
+      const uint8_t k = kind[a];
+      if (k == ACT_SHARED) {
+        if (!it->shared) {
+          it->shared = PyDict_New();
+          if (!it->shared) return bail();
+        }
+        PyObject *g = PyDict_GetItemWithError(it->shared, t->key[a]);
+        if (!g) {
+          if (PyErr_Occurred()) return bail();
+          g = PyDict_New();
+          if (!g || PyDict_SetItem(it->shared, t->key[a], g) < 0) {
+            Py_XDECREF(g);
+            return bail();
+          }
+          Py_DECREF(g);
+        }
+        if (PyDict_SetItem(g, t->cid[a], t->sub[a]) < 0) return bail();
+        sh_pairs++;
+        continue;
+      }
+      const int32_t c = t->act_cidx[a];
+      if (t->stamp[c] != e) {
+        t->stamp[c] = e;
+        t->slot[c] = static_cast<int32_t>(n);
+        it->cids[n] = t->cid[a];
+        if (k == ACT_MERGE) {
+          // v5 identifiers: ALWAYS through merge_subscription so the
+          // identifier-union copy semantics hold from the first insert
+          PyObject *mg = PyObject_CallFunctionObjArgs(
+              g_merge_fn, Py_None, t->sub[a], t->key[a], nullptr);
+          if (!mg) return bail();
+          it->subs[n] = mg;
+          it->owned[n] = 1;
+        } else {
+          it->subs[n] = t->sub[a];  // borrowed; table keeps it alive
+          it->owned[n] = 0;
+        }
+        it->n = ++n;  // keep n consistent for dealloc on error
+      } else {
+        const int32_t j = t->slot[c];
+        if (k == ACT_PLAIN && it->subs[j] == t->sub[a])
+          continue;  // same record twice (duplicate filter rows)
+        PyObject *mg = PyObject_CallFunctionObjArgs(
+            g_merge_fn, it->subs[j], t->sub[a], t->key[a], nullptr);
+        if (!mg) return bail();
+        if (it->owned[j]) Py_DECREF(it->subs[j]);
+        it->subs[j] = mg;
+        it->owned[j] = 1;
+      }
+    }
+  }
+  const Py_ssize_t charge = n + sh_pairs;
+  if (t->icache_pairs + charge > kDecodeCachePairsCap) {
+    PyDict_Clear(t->icache);
+    t->icache_pairs = 0;
+  }
+  const int rc =
+      PyDict_SetItem(t->icache, key, reinterpret_cast<PyObject *>(it));
+  Py_DECREF(key);
+  if (rc < 0) {
+    Py_DECREF(it);
+    return nullptr;
+  }
+  t->icache_pairs += charge;
+  return reinterpret_cast<PyObject *>(it);
+}
+
+// the shared zero-entry intents for unmatched topics (one per table)
+PyObject *empty_intents_for(DecodeTable *t, PyObject *cap) {
+  if (!t->empty_intents) {
+    auto *it = intents_alloc(cap, 0);
+    if (!it) return nullptr;
+    t->empty_intents = reinterpret_cast<PyObject *>(it);
+  }
+  return Py_NewRef(t->empty_intents);
+}
+
 // decode_batch(table, toks, mode, pad, lens_enc, B, ti, rw)
 //   -> list[SubscriberSet] of length B (every slot populated)
 //
@@ -678,7 +1110,7 @@ PyObject *cached_rowset_result(DecodeTable *t, const int32_t *rows,
 // arrays (fallback topics and out-of-table rows already dropped by
 // _candidate_pairs). Unverified pairs are discarded; verified rows'
 // action streams are applied.
-PyObject *decode_batch(PyObject *, PyObject *args) {
+PyObject *decode_batch_impl(PyObject *args, const bool intents) {
   PyObject *cap, *toks_o, *lens_o, *ti_o, *rw_o;
   int mode;
   long pad_l;
@@ -792,23 +1224,39 @@ PyObject *decode_batch(PyObject *, PyObject *args) {
     std::sort(rowbuf.begin(), rowbuf.end());
     rowbuf.erase(std::unique(rowbuf.begin(), rowbuf.end()),
                  rowbuf.end());
-    PyObject *res = cached_rowset_result(t, rowbuf.data(),
-                                         (Py_ssize_t)rowbuf.size());
+    PyObject *res =
+        intents ? cached_intents_result(t, cap, rowbuf.data(),
+                                        (Py_ssize_t)rowbuf.size())
+                : cached_rowset_result(t, rowbuf.data(),
+                                       (Py_ssize_t)rowbuf.size());
     if (!res) return bail();
     PyList_SetItem(out, tp, res);  // steals; replaces the None
   }
-  // fill the untouched slots with fresh empty sets so every consumer
-  // sees a real SubscriberSet. NOTE: populated slots may be SHARED
-  // (cache hits alias one object across topics and calls) — callers
-  // must treat results as immutable and deep_copy() before mutating
+  // fill the untouched slots so every consumer sees a real result
+  // object. NOTE: populated slots may be SHARED (cache hits alias one
+  // object across topics and calls) — callers must treat results as
+  // immutable and deep_copy()/to_set() before mutating
   // (see SigEngine.decode_pairs' contract)
   for (Py_ssize_t i = 0; i < B; i++) {
     if (PyList_GET_ITEM(out, i) != Py_None) continue;
-    auto *n = subset_new_fast(nullptr, nullptr);
+    PyObject *n;
+    if (intents) {
+      n = empty_intents_for(t, cap);
+    } else {
+      n = reinterpret_cast<PyObject *>(subset_new_fast(nullptr, nullptr));
+    }
     if (!n) return bail();
-    PyList_SetItem(out, i, reinterpret_cast<PyObject *>(n));
+    PyList_SetItem(out, i, n);
   }
   return out;
+}
+
+PyObject *decode_batch(PyObject *, PyObject *args) {
+  return decode_batch_impl(args, false);
+}
+
+PyObject *decode_batch_intents(PyObject *, PyObject *args) {
+  return decode_batch_impl(args, true);
 }
 
 PyMethodDef methods[] = {
@@ -819,6 +1267,12 @@ PyMethodDef methods[] = {
     {"decode_batch", decode_batch, METH_VARARGS,
      "Verify candidate pairs and union their subscriber entries into "
      "per-topic SubscriberSets."},
+    {"decode_batch_intents", decode_batch_intents, METH_VARARGS,
+     "Verify candidate pairs and union their subscriber entries into "
+     "per-topic DeliveryIntents (the fan-out hot-path form)."},
+    {"table_release", table_release, METH_O,
+     "Drop a snapshot table's caches, breaking the intents->capsule "
+     "reference cycle (call when the snapshot is dropped)."},
     {nullptr, nullptr, 0, nullptr}};
 
 PyModuleDef mod = {PyModuleDef_HEAD_INIT, "maxmq_decode",
@@ -839,5 +1293,21 @@ PyMODINIT_FUNC PyInit_maxmq_decode(void) {
     return nullptr;
   }
   g_subset_type = tp;  // module holds the ref
+  auto *ip = reinterpret_cast<PyTypeObject *>(
+      PyType_FromSpec(&intents_spec));
+  if (!ip || PyModule_AddObject(m, "DeliveryIntents",
+                                reinterpret_cast<PyObject *>(ip)) < 0) {
+    Py_XDECREF(reinterpret_cast<PyObject *>(ip));
+    Py_DECREF(m);
+    return nullptr;
+  }
+  g_intents_type = ip;
+  auto *itp = reinterpret_cast<PyTypeObject *>(
+      PyType_FromSpec(&intents_iter_spec));
+  if (!itp) {
+    Py_DECREF(m);
+    return nullptr;
+  }
+  g_intents_iter_type = itp;  // not exposed; module keeps the ref alive
   return m;
 }

@@ -1,27 +1,41 @@
 #!/bin/sh
 # Round-4 TPU evidence watchdog (VERDICT r03 #3: capture EARLY/whenever
-# the tunnel is alive — it wedges for hours mid-day).
+# the tunnel is alive — it wedges for hours mid-day, including MID-RUN).
 #
 # Probes the axon tunnel every 4 minutes in a throwaway subprocess (a
-# wedged in-process init can never be retried); on first success runs a
-# full driver-grade bench capture, which also refreshes
+# wedged in-process init can never be retried); on success runs a full
+# driver-grade bench capture, which also refreshes
 # BENCH_TPU_LAST_GOOD.json for bench.py's cached-replay fallback.
-# Run under tmux:  tmux new-session -d -s tpuwatch 'sh scripts/tpu_watchdog.sh'
+# Keeps probing until a capture SUCCEEDS (bench exits 0 with output) —
+# a capture killed by a mid-run wedge resumes the probe loop instead of
+# abandoning the round's evidence.
+# Run:  setsid nohup sh scripts/tpu_watchdog.sh >/dev/null 2>&1 &
 cd "$(dirname "$0")/.." || exit 1
 LOG=/tmp/tpu_watchdog.log
+FLAG=/tmp/tpu_capture_in_progress
+trap 'rm -f "$FLAG"' EXIT INT TERM
+n=0
 while :; do
     if timeout 90 python -c \
         "import jax.numpy as j; j.arange(8).block_until_ready()" \
         >/dev/null 2>&1; then
-        echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tunnel ALIVE - capturing" >> "$LOG"
-        touch /tmp/tpu_capture_in_progress
+        n=$((n + 1))
+        echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tunnel ALIVE - capture #$n" >> "$LOG"
+        touch "$FLAG"
         MAXMQ_BENCH_CONFIGS="${MAXMQ_BENCH_CONFIGS:-1,2,3,4,4h,lat,lath}" \
             timeout 7200 python bench.py \
-            > /tmp/bench_r04_live.json 2> /tmp/bench_r04_live.err
-        echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) capture rc=$?" >> "$LOG"
-        rm -f /tmp/tpu_capture_in_progress
-        exit 0
+            > "/tmp/bench_r04_live_$n.json" 2> "/tmp/bench_r04_live_$n.err"
+        rc=$?
+        rm -f "$FLAG"
+        echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) capture #$n rc=$rc" >> "$LOG"
+        if [ "$rc" -eq 0 ] && [ -s "/tmp/bench_r04_live_$n.json" ]; then
+            cp "/tmp/bench_r04_live_$n.json" /tmp/bench_r04_live.json
+            echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) capture good - done" >> "$LOG"
+            exit 0
+        fi
+        # failed/partial capture: resume probing (tunnel may be re-wedged)
+    else
+        echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) wedged" >> "$LOG"
     fi
-    echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) wedged" >> "$LOG"
     sleep 240
 done

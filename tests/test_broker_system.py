@@ -310,6 +310,65 @@ async def test_broker_with_nfa_matcher_attached():
             await s.next_message(timeout=0.3)
 
 
+async def test_broker_with_sig_matcher_intents():
+    """Full path through DeliveryIntents (ADR 007): PUBLISH over TCP ->
+    sig match -> native decode emits intents -> broker fans out from the
+    flat entries. Covers plain QoS1, $share exactly-once across two
+    group members, NoLocal, and a select_subscribers hook forcing the
+    to_set() materialization path."""
+    from maxmq_tpu.matching.batcher import MicroBatcher
+    from maxmq_tpu.matching.sig import SigEngine
+    from maxmq_tpu.native import decode_module
+    mod = decode_module()
+    if mod is None or not hasattr(mod, "DeliveryIntents"):
+        pytest.skip("maxmq_decode extension unavailable")
+    async with running_broker() as broker:
+        eng = SigEngine(broker.topics)
+        eng.emit_intents = True
+        broker.attach_matcher(MicroBatcher(eng, window_us=0))
+        s = await connect(broker, "sub", version=5)
+        await s.subscribe(("ity/+/path", 1))
+        g1 = await connect(broker, "g1", version=5)
+        await g1.subscribe(("$share/g/ity/shared", 0))
+        g2 = await connect(broker, "g2", version=5)
+        await g2.subscribe(("$share/g/ity/shared", 0))
+        p = await connect(broker, "pub")
+        await p.publish("ity/hot/path", b"via-intents", qos=1)
+        msg = await s.next_message()
+        assert (msg.topic, msg.payload, msg.qos) == \
+            ("ity/hot/path", b"via-intents", 1)
+        # $share: exactly one of the two group members per publish
+        for i in range(6):
+            await p.publish("ity/shared", f"s{i}".encode())
+        deadline = asyncio.get_running_loop().time() + 15
+        while (g1.messages.qsize() + g2.messages.qsize()) < 6:
+            assert asyncio.get_running_loop().time() < deadline, (
+                f"shared fan-out delivered "
+                f"{g1.messages.qsize() + g2.messages.qsize()}, want 6")
+            await asyncio.sleep(0.05)
+        await asyncio.sleep(0.2)          # no duplicates trickling in
+        assert g1.messages.qsize() + g2.messages.qsize() == 6
+        # NoLocal: publisher subscribed no_local must not self-receive
+        nl = await connect(broker, "nl", version=5)
+        await nl.subscribe(("ity/nl", 0), no_local=True)
+        await nl.publish("ity/nl", b"self")
+        with pytest.raises(asyncio.TimeoutError):
+            await nl.next_message(timeout=0.3)
+        # a select_subscribers hook flips the fan-out to to_set()
+        from maxmq_tpu.hooks.base import Hook
+
+        class DropAll(Hook):
+            id = "drop-all-sel"
+
+            def on_select_subscribers(self, subscribers, packet):
+                subscribers.subscriptions.clear()
+                return subscribers
+        broker.add_hook(DropAll())
+        await p.publish("ity/hot/path", b"suppressed", qos=1)
+        with pytest.raises(asyncio.TimeoutError):
+            await s.next_message(timeout=0.3)
+
+
 async def test_send_quota_holds_and_releases():
     """v5 receive-maximum flow control: excess QoS1 fan-out parks on the
     held queue and drains as acks return quota."""

@@ -260,9 +260,12 @@ async def test_takeover_refcounted_across_connections():
         await svc.close()
 
 
-async def test_unsub_only_releases_own_connections_ref():
-    """An UNSUB from a connection that never subscribed the filter must
-    not tear down another connection's live entry."""
+async def test_unsub_is_authoritative_across_owners():
+    """An explicit UNSUB stops matching IMMEDIATELY even while a stale
+    connection still holds an ownership ref (a wedged old worker must
+    not keep an unsubscribed client receiving deliveries) — and the
+    stale owner's eventual death must not tear down a LATER re-subscribe
+    (generation guard)."""
     path = _sock_path()
     svc = MatcherService(path)
     await svc.start()
@@ -270,13 +273,24 @@ async def test_unsub_only_releases_own_connections_ref():
         a, b = ServiceMatcher(path), ServiceMatcher(path)
         await a.connect()
         await b.connect()
-        a.forward_subscribe("cl", Subscription(filter="ur/+"))
+        sub = Subscription(filter="ur/+", qos=1)
+        a.forward_subscribe("cl", sub)             # stale-owner-to-be
         await a.subscribers_async("ur/x")
-        b.forward_unsubscribe("cl", "ur/+")        # B never owned it
+        b.forward_subscribe("cl", sub)             # takeover re-own
         await b.subscribers_async("ur/x")
-        got = await a.subscribers_async("ur/x")
-        assert "cl" in got.subscriptions
+        b.forward_unsubscribe("cl", "ur/+")        # client unsubscribed
+        got = await b.subscribers_async("ur/x")
+        assert "cl" not in got.subscriptions, \
+            "unsub must take effect immediately, not at last-owner death"
+        # client re-subscribes on B; A (wedged all along) finally dies —
+        # the re-subscribed entry must survive A's stale release
+        b.forward_subscribe("cl", sub)
+        await b.subscribers_async("ur/x")
         await a.close()
+        await asyncio.sleep(0.1)
+        got = await b.subscribers_async("ur/y")
+        assert "cl" in got.subscriptions, \
+            "stale owner death removed a re-subscribed entry"
         await b.close()
     finally:
         await svc.close()
