@@ -444,6 +444,11 @@ def bench_latency(n_subs: int = 100_000, n_requests: int = 2000,
     filters, topic_gen = build_corpus(n_subs, topic_pool=topic_pool)
     index = build_index(filters)
     engine = SigEngine(index, auto_refresh=False)
+    # production attach precompiles the dispatch bucket ladder
+    # (bootstrap.build_matcher -> warm_buckets); without it the first
+    # batch at a new bucket shape pays its XLA compile on the caller
+    # path and the p99 measures compilation, not steady state
+    engine.warm_buckets(max(256, concurrency), background=False)
     batcher = MicroBatcher(engine, window_us=200, max_batch=4096)
     topics = topic_gen(n_requests, seed2=7)
     lats: list[float] = []
@@ -463,7 +468,14 @@ def bench_latency(n_subs: int = 100_000, n_requests: int = 2000,
         if topic_pool:
             for t in set(topics):
                 await one(t)
-        await asyncio.gather(*(one(topics[0]) for _ in range(8)))
+        # two sequential rounds AT THE MEASURED CONCURRENCY: the first
+        # absorbs any residual compile (its RTT sample is discarded),
+        # the second lands the post-warm RTT sample for the batch shape
+        # the run will actually form, arming the adaptive CPU bypass —
+        # measured latency is the steady state either way
+        await asyncio.gather(*(one(topics[0]) for _ in range(concurrency)))
+        await asyncio.gather(*(one(topics[1 % len(topics)])
+                               for _ in range(concurrency)))
         lats.clear()
         hits_base[0] = batcher.cache_hits
         sem = asyncio.Semaphore(concurrency)
@@ -489,9 +501,12 @@ def bench_latency(n_subs: int = 100_000, n_requests: int = 2000,
         "p99_ms": round(lats[int(len(lats) * 0.99)] * 1e3, 2),
         "mean_batch": round(batcher.batched_topics
                             / max(batcher.batches, 1), 1),
+        "bypassed_topics": batcher.bypasses,
+        "device_rtt_ms": round((batcher._device_rtt or 0) * 1e3, 2),
     }
     log(f"[lat] p50 {out['p50_ms']}ms p99 {out['p99_ms']}ms "
-        f"(mean batch {out['mean_batch']})")
+        f"(mean batch {out['mean_batch']}, "
+        f"bypassed {out['bypassed_topics']})")
     return out
 
 

@@ -86,18 +86,17 @@ class MatcherService:
     def __init__(self, path: str, engine_factory=None) -> None:
         self.path = path
         self.index = TopicIndex()
-        # (cid, filter) -> [generation, owner-count]. Ownership is
-        # refcounted ACROSS connections: during cross-worker session
-        # takeover, worker B's re-subscribe and worker A's takeover-
-        # driven drop race over the same (cid, filter) key — the index
-        # entry must survive until the LAST owner releases it, or a
-        # live client silently loses matcher-path deliveries. An
-        # explicit OP_UNSUB is AUTHORITATIVE (the client said stop):
-        # it voids the entry immediately for every owner; the
-        # generation guards a voided-then-resubscribed entry against a
-        # stale owner's late release (a wedged old worker's connection
-        # dying minutes later must not tear down the new entry).
-        self._owners: dict[tuple, list] = {}
+        # (cid, filter) -> generation of the LATEST acquiring
+        # connection, which owns the entry exclusively. In the pool
+        # topology one worker serves a client at a time, so each new
+        # connection's subscribe bumps the generation and takes sole
+        # ownership; everything a STALE connection later does to the
+        # pair — takeover-driven OP_DROP, its own death purge, a
+        # buffered OP_UNSUB flushing minutes after the session moved —
+        # is generation-mismatched and ignored, while the CURRENT
+        # owner's ops (an explicit client UNSUBSCRIBE above all) take
+        # effect immediately.
+        self._owners: dict[tuple, int] = {}
         self._gen = 0
         if engine_factory is None:
             def engine_factory(index):
@@ -141,27 +140,25 @@ class MatcherService:
         batcher coalesces topics across ALL connections."""
         tasks: set[asyncio.Task] = set()
         self._conns.add(writer)
-        # subscription state is OWNED BY THIS CONNECTION, but ownership
-        # of an index entry is REFCOUNTED across connections via
-        # self._owners: a (cid, filter) leaves the index when its last
-        # owning connection releases it — OR immediately on an explicit
-        # OP_UNSUB (authoritative). When the connection drops, its refs
-        # are released generation-guarded — a lost UNSUB op can never
-        # leave stale filters past the owning broker's reconnect+reseed,
-        # and a stale drop (old worker's takeover purge, late
-        # close-then-reseed) cannot remove an entry a newer connection
-        # re-owns. owned: cid -> {filter: generation at acquire}.
+        # subscription state is OWNED BY THIS CONNECTION while it holds
+        # the entry's CURRENT generation (self._owners): each OP_SUB
+        # bumps the generation and transfers sole ownership to this
+        # connection, so a stale connection's later drop/unsub/death
+        # cannot touch an entry a newer connection re-owns, while the
+        # current owner's explicit OP_UNSUB stops matching immediately
+        # (no ghost deliveries until a wedged old worker dies). A lost
+        # UNSUB op can never leave stale filters past the owning
+        # broker's reconnect+reseed: the connection purge releases
+        # everything this connection still owns.
+        # owned: cid -> {filter: generation at acquire}.
         owned: dict[str, dict[str, int]] = {}
 
         def _release(cid: str, filt: str, gen: int) -> None:
             key = (cid, filt)
-            ent = self._owners.get(key)
-            if ent is None or ent[0] != gen:
-                return          # voided/re-owned since we acquired it
-            ent[1] -= 1
-            if ent[1] <= 0:
-                del self._owners[key]
-                self.index.unsubscribe(cid, filt)
+            if self._owners.get(key) != gen:
+                return          # re-owned by a newer connection
+            del self._owners[key]
+            self.index.unsubscribe(cid, filt)
 
         try:
             while True:
@@ -174,23 +171,13 @@ class MatcherService:
                     sub = _decode_sub(msg["v"])
                     if self.index.subscribe(msg["c"], sub):
                         self.subs_applied += 1
-                    conn_map = owned.setdefault(msg["c"], {})
-                    key = (msg["c"], sub.filter)
-                    ent = self._owners.get(key)
-                    if ent is None:
-                        self._gen += 1
-                        ent = self._owners[key] = [self._gen, 0]
-                    if conn_map.get(sub.filter) != ent[0]:
-                        conn_map[sub.filter] = ent[0]
-                        ent[1] += 1
+                    self._gen += 1
+                    self._owners[(msg["c"], sub.filter)] = self._gen
+                    owned.setdefault(msg["c"], {})[sub.filter] = self._gen
                 elif ftype == OP_UNSUB:
-                    # authoritative: the client unsubscribed — stop
-                    # matching NOW for every owner, not when the last
-                    # (possibly wedged) connection finally dies
-                    owned.get(msg["c"], {}).pop(msg["f"], None)
-                    if self._owners.pop((msg["c"], msg["f"]), None) \
-                            is not None:
-                        self.index.unsubscribe(msg["c"], msg["f"])
+                    gen = owned.get(msg["c"], {}).pop(msg["f"], None)
+                    if gen is not None:
+                        _release(msg["c"], msg["f"], gen)
                 elif ftype == OP_DROP:
                     for filt, gen in owned.pop(msg["c"], {}).items():
                         _release(msg["c"], filt, gen)

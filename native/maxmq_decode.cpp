@@ -37,6 +37,7 @@
 #include <algorithm>
 #include <cstddef>
 #include <cstdint>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -587,11 +588,17 @@ struct DecodeTable {
   Py_ssize_t icache_pairs = 0;  // entries in the intents cache
   std::vector<PyObject *> key, cid, sub;  // borrowed from the lists
   // intents union scratch: per-action interned client index + an
-  // epoch-stamped per-client slot map (no per-topic clearing)
+  // epoch-stamped per-client slot map (no per-topic clearing). The
+  // scratch is SINGLE-BUILDER: merge_subscription callbacks (and any
+  // allocation-triggered GC) can release the GIL mid-build, letting a
+  // second executor thread enter cached_intents_result on the same
+  // table — scratch_busy hands that builder a local-map fallback so
+  // the stamps cannot be corrupted into duplicate deliveries.
   std::vector<int32_t> act_cidx;  // [A]; -1 for shared actions
   std::vector<int64_t> stamp;     // [n_clients] last epoch seen
   std::vector<int32_t> slot;      // [n_clients] entry index this epoch
   int64_t epoch = 0;
+  bool scratch_busy = false;
   PyObject *empty_intents = nullptr;  // shared zero-entry result
   Py_ssize_t R, W, A;
 };
@@ -1019,7 +1026,36 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
     Py_DECREF(it);
     return nullptr;
   };
-  const int64_t e = ++t->epoch;
+  // single-builder fast scratch, local-map fallback for a concurrent
+  // builder that entered while a Python callback had the GIL released
+  struct ScratchGuard {
+    DecodeTable *t;
+    bool owned;
+    explicit ScratchGuard(DecodeTable *tt)
+        : t(tt), owned(!tt->scratch_busy) {
+      if (owned) t->scratch_busy = true;
+    }
+    ~ScratchGuard() {
+      if (owned) t->scratch_busy = false;
+    }
+  } guard(t);
+  std::unordered_map<int32_t, Py_ssize_t> local_slot;
+  const bool fast = guard.owned;
+  const int64_t e = fast ? ++t->epoch : 0;
+  auto slot_of = [&](int32_t c) -> Py_ssize_t {
+    if (fast)
+      return t->stamp[c] == e ? (Py_ssize_t)t->slot[c] : -1;
+    auto f = local_slot.find(c);
+    return f == local_slot.end() ? -1 : f->second;
+  };
+  auto record_slot = [&](int32_t c, Py_ssize_t j) {
+    if (fast) {
+      t->stamp[c] = e;
+      t->slot[c] = static_cast<int32_t>(j);
+    } else {
+      local_slot[c] = j;
+    }
+  };
   Py_ssize_t n = 0;
   Py_ssize_t sh_pairs = 0;
   for (Py_ssize_t i = 0; i < n_rows; i++) {
@@ -1046,9 +1082,9 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
         continue;
       }
       const int32_t c = t->act_cidx[a];
-      if (t->stamp[c] != e) {
-        t->stamp[c] = e;
-        t->slot[c] = static_cast<int32_t>(n);
+      const Py_ssize_t j = slot_of(c);
+      if (j < 0) {
+        record_slot(c, n);
         it->cids[n] = t->cid[a];
         if (k == ACT_MERGE) {
           // v5 identifiers: ALWAYS through merge_subscription so the
@@ -1064,7 +1100,6 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
         }
         it->n = ++n;  // keep n consistent for dealloc on error
       } else {
-        const int32_t j = t->slot[c];
         if (k == ACT_PLAIN && it->subs[j] == t->sub[a])
           continue;  // same record twice (duplicate filter rows)
         PyObject *mg = PyObject_CallFunctionObjArgs(

@@ -32,7 +32,7 @@ class FakeEngine:
 
 async def test_concurrent_requests_coalesce():
     eng = FakeEngine()
-    batcher = MicroBatcher(eng, window_us=2000, max_batch=64)
+    batcher = MicroBatcher(eng, window_us=2000, max_batch=64, cpu_bypass=False)
     try:
         results = await asyncio.gather(
             *[batcher.subscribers_async(f"t/{i}") for i in range(16)])
@@ -48,7 +48,7 @@ async def test_concurrent_requests_coalesce():
 
 async def test_max_batch_splits():
     eng = FakeEngine()
-    batcher = MicroBatcher(eng, window_us=1000, max_batch=4)
+    batcher = MicroBatcher(eng, window_us=1000, max_batch=4, cpu_bypass=False)
     try:
         results = await asyncio.gather(
             *[batcher.subscribers_async(f"t/{i}") for i in range(10)])
@@ -61,7 +61,7 @@ async def test_max_batch_splits():
 
 async def test_single_request_low_latency():
     eng = FakeEngine()
-    batcher = MicroBatcher(eng, window_us=100, max_batch=64)
+    batcher = MicroBatcher(eng, window_us=100, max_batch=64, cpu_bypass=False)
     try:
         out = await asyncio.wait_for(batcher.subscribers_async("a/b"),
                                      timeout=1)
@@ -106,7 +106,7 @@ async def test_batched_dense_engine_parity():
 
 def test_batcher_delegates_sync_surface():
     eng = FakeEngine()
-    batcher = MicroBatcher(eng)
+    batcher = MicroBatcher(eng, cpu_bypass=False)
     assert batcher.subscribers("a") == "result:a"
     assert batcher.refresh() is False
     assert batcher.index is eng.index
@@ -153,7 +153,7 @@ async def test_pipelined_batches_overlap():
     # behind the round trip of the batch ahead of them
     eng = SplitEngine()
     batcher = MicroBatcher(eng, window_us=0, max_batch=2,
-                           pipeline_depth=3)
+                           pipeline_depth=3, cpu_bypass=False)
     try:
         results = await asyncio.gather(
             *[batcher.subscribers_async(f"p/{i}") for i in range(12)])
@@ -166,7 +166,7 @@ async def test_pipelined_batches_overlap():
 async def test_pipeline_depth_one_still_serializes():
     eng = SplitEngine(collect_s=0.01)
     batcher = MicroBatcher(eng, window_us=0, max_batch=2,
-                           pipeline_depth=1)
+                           pipeline_depth=1, cpu_bypass=False)
     try:
         results = await asyncio.gather(
             *[batcher.subscribers_async(f"q/{i}") for i in range(8)])
@@ -184,7 +184,7 @@ async def test_pipelined_collect_failure_fails_only_its_batch():
             return super().collect_fixed(topics, ctx)
 
     eng = Flaky(collect_s=0.005)
-    batcher = MicroBatcher(eng, window_us=0, max_batch=1,
+    batcher = MicroBatcher(eng, window_us=0, max_batch=1, cpu_bypass=False,
                            pipeline_depth=2)
     try:
         ok_futs = [batcher.subscribers_async(f"z/{i}") for i in range(3)]
@@ -209,7 +209,7 @@ async def test_pipelined_dispatch_refusal_falls_back_to_whole_batch():
             return [f"trie:{t}" for t in topics]
 
     eng = TrieOnly()
-    batcher = MicroBatcher(eng, window_us=0, max_batch=4,
+    batcher = MicroBatcher(eng, window_us=0, max_batch=4, cpu_bypass=False,
                            pipeline_depth=3)
     try:
         results = await asyncio.gather(
@@ -235,7 +235,7 @@ async def test_enqueue_cache_hits_and_version_invalidation():
             return ("ctx", list(topics))
 
     eng = Counting()
-    batcher = MicroBatcher(eng, window_us=0, max_batch=8)
+    batcher = MicroBatcher(eng, window_us=0, max_batch=8, cpu_bypass=False)
     try:
         r1 = await batcher.subscribers_async("hot/a")
         r2 = await batcher.subscribers_async("hot/a")   # cache hit
@@ -246,5 +246,46 @@ async def test_enqueue_cache_hits_and_version_invalidation():
         eng.index.subscribe("c1", Subscription(filter="hot/a"))
         await batcher.subscribers_async("hot/a")
         assert eng.dispatched == 2
+    finally:
+        await batcher.close()
+
+
+async def test_adaptive_cpu_bypass_serves_small_batches():
+    """VERDICT r04 #2: with a measured device RTT on record, a small
+    batch is served inline from the CPU trie (trie-class latency) with
+    exact results; the probe cadence still sends periodic batches to
+    the device so the RTT estimate cannot go stale."""
+    from maxmq_tpu.matching.sig import SigEngine
+
+    index = TopicIndex()
+    for i in range(200):
+        index.subscribe(f"cl-{i}", Subscription(filter=f"by/{i}/+", qos=1))
+    eng = SigEngine(index)
+    batcher = MicroBatcher(eng, window_us=0, max_batch=64)
+    try:
+        # no RTT sample yet: everything goes to the device path
+        r = await batcher.subscribers_async("by/7/x")
+        assert "cl-7" in (r.to_set() if hasattr(r, "to_set") else r).subscriptions
+        assert batcher.bypasses == 0
+        # seed a slow measured round trip (the tunnel regime)
+        batcher._device_rtt = 0.05
+        batcher._rtt_samples = 2
+        r = await batcher.subscribers_async("by/9/x")
+        assert batcher.bypasses >= 1, "small batch should take the bypass"
+        assert "cl-9" in r.subscriptions          # trie-shaped result
+        # correctness across a subscription change mid-bypass-regime
+        index.subscribe("late", Subscription(filter="by/9/+", qos=0))
+        for _ in range(3):
+            r = await batcher.subscribers_async("by/9/x")
+        assert "late" in r.subscriptions
+        # probe cadence: at the threshold the NEXT bypassed batch spawns
+        # a background shadow probe (callers never wait on it) that
+        # refreshes the RTT estimate
+        batcher._since_probe = batcher.BYPASS_PROBE_EVERY
+        assert batcher._should_bypass(1)   # callers still bypass
+        await batcher.subscribers_async("by/11/x")
+        assert batcher._probe_task is not None
+        await batcher._probe_task
+        assert batcher._since_probe <= 1
     finally:
         await batcher.close()

@@ -282,10 +282,16 @@ async def test_unsub_is_authoritative_across_owners():
         got = await b.subscribers_async("ur/x")
         assert "cl" not in got.subscriptions, \
             "unsub must take effect immediately, not at last-owner death"
-        # client re-subscribes on B; A (wedged all along) finally dies —
-        # the re-subscribed entry must survive A's stale release
+        # client re-subscribes on B; A's BUFFERED unsub flushes late —
+        # generation-stale, it must not tear down B's live entry
         b.forward_subscribe("cl", sub)
         await b.subscribers_async("ur/x")
+        a.forward_unsubscribe("cl", "ur/+")
+        await a.subscribers_async("ur/x")
+        got = await b.subscribers_async("ur/x")
+        assert "cl" in got.subscriptions, \
+            "stale buffered unsub removed a re-owned entry"
+        # ... and A (wedged all along) finally dies — same guarantee
         await a.close()
         await asyncio.sleep(0.1)
         got = await b.subscribers_async("ur/y")
