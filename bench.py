@@ -378,22 +378,41 @@ def bench_config(name: str, n_subs: int, batch: int, iters: int,
 
     # decode-inclusive, production boundary (ADR 007): DeliveryIntents —
     # what the broker's fan-out actually consumes, exactly as the
-    # reference's Subscribers() returns what ITS fan-out consumes
+    # reference's Subscribers() returns what ITS fan-out consumes.
+    # ADR-008-routed corpora (<= ROUTE_SUBS_MAX subs — none of the
+    # standard configs; reachable via MAXMQ_BENCH_SCALE) are measured
+    # through the surface production uses: the engine's own batch call,
+    # which serves them from the CPU trie.
     engine.emit_intents = True
-    run_subscribers(engine, batches[:1], depth)  # warm
+    routed = engine._routes_to_trie()
+
+    def run_routed(_engine, bs, _depth):
+        total = 0
+        for b in bs:
+            res = _engine.subscribers_fixed_batch(b)
+            total += sum(len(s.subscriptions) + len(s.shared)
+                         for s in res)
+        return total
+
+    run = run_routed if routed else run_subscribers
+    run(engine, batches[:1], depth)              # warm
     t0 = time.perf_counter()
-    delivered = run_subscribers(engine, batches, depth)
+    delivered = run(engine, batches, depth)
     dec_dt = time.perf_counter() - t0
     dec_rate = batch * iters / dec_dt
 
-    # merged-SubscriberSet form (round-3 continuity; the pre-ADR-007
-    # boundary) — warmed like the intents pass so the published
-    # set-vs-intents comparison is like-for-like, then one timed pass
+    # merged-SubscriberSet form over the DEVICE path (round-3
+    # continuity; the pre-ADR-007/008 boundary) — warmed like the
+    # intents pass so the published comparison is like-for-like, then
+    # one timed pass
     engine.emit_intents = False
+    saved_route = engine.route_small
+    engine.route_small = False
     run_subscribers(engine, batches[:1], depth)  # warm the set caches
     t0 = time.perf_counter()
     run_subscribers(engine, batches[:1], depth)
     set_rate = batch / (time.perf_counter() - t0)
+    engine.route_small = saved_route
     engine.emit_intents = True
 
     # our python CPU trie on the same corpus: secondary reference point
@@ -414,7 +433,8 @@ def bench_config(name: str, n_subs: int, batch: int, iters: int,
         "pipeline_depth": depth,
         **({"stages": stages} if stages else {}),
         "matches_per_sec": round(dec_rate, 1),
-        "boundary_form": "delivery_intents",
+        "boundary_form": ("trie_routed" if routed
+                          else "delivery_intents"),
         "mergedset_matches_per_sec": round(set_rate, 1),
         "raw_slot_matches_per_sec": round(raw_rate, 1),
         "delivered_pairs": delivered,

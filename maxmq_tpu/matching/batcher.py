@@ -228,7 +228,11 @@ class MicroBatcher:
             if self._should_bypass(len(batch)):
                 self._run_bypass(batch, topics, ver)
                 continue
-            if split:
+            # ADR-008 routed corpora serve via the engine's whole-batch
+            # surface (which answers from its trie); dispatch_fixed
+            # would force the device round trip the router rejected
+            routes = getattr(self.engine, "_routes_to_trie", None)
+            if split and not (routes is not None and routes()):
                 await self._dispatch_pipelined(loop, batch, topics, ver)
             else:
                 await self._run_whole_batch(loop, batch, topics, ver)

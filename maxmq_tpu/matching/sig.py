@@ -1303,6 +1303,12 @@ class SigEngine(OverlayedEngine):
         # for overlay windows, CPU-trie fallbacks, and when the C
         # extension is absent (consumers handle both shapes)
         self.emit_intents = False
+        # auto-route TINY corpora to the CPU trie (ADR 008): a few
+        # hundred subscriptions never amortize table compiles and
+        # device batches; everything larger stays on the device path
+        # (link-degraded regimes are the batcher's adaptive bypass)
+        self.route_small = True
+        self.trie_routed = 0
         self._state = None
         self._refresh_lock = threading.Lock()
         self.fallbacks = 0
@@ -1663,15 +1669,34 @@ class SigEngine(OverlayedEngine):
             out = (counts_dev, stream_dev, slices)
         return out, hostrows, tables, fmt, toks8, lens_enc
 
+    # Auto-route (ADR 008): serve TINY corpora from the CPU trie — a
+    # few hundred subscriptions never amortize table compiles and
+    # device batches, and the trie answers in ~1-2us/topic at this
+    # size. Anything larger stays on the device path: measured with
+    # warmed buckets, the device beats the trie even on exact-only 1K
+    # corpora (sets 1.44M vs trie 735K topics/s, CPU backend), and
+    # LINK-degraded regimes (the tunnel rig) are handled by the
+    # MicroBatcher's adaptive measured-RTT bypass, not a static rule.
+    ROUTE_SUBS_MAX = 256
+
+    def _routes_to_trie(self) -> bool:
+        return (self.route_small
+                and self.index.subscription_count <= self.ROUTE_SUBS_MAX)
+
     def _trie_batch(self, topics: list[str]) -> list[SubscriberSet] | None:
-        """CPU-trie fallback for corpora the compiler declined
-        (> MAX_GROUPS wildcard shapes); None when the device is active."""
+        """CPU-trie service for corpora the compiler declined
+        (> MAX_GROUPS wildcard shapes) or the ADR-008 router claims;
+        None when the device path should run."""
         if self.auto_refresh:
             self.refresh_soon()
-        if self._state[2] is not None:
+        declined = self._state[2] is None
+        if not declined and not self._routes_to_trie():
             return None
         self.matches += len(topics)
-        self.fallbacks += len(topics)
+        if declined:
+            self.fallbacks += len(topics)
+        else:
+            self.trie_routed += len(topics)
         return [self.index.subscribers(t) for t in topics]
 
     def subscribers_fixed_batch(self, topics: list[str]

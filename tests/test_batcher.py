@@ -261,6 +261,7 @@ async def test_adaptive_cpu_bypass_serves_small_batches():
     for i in range(200):
         index.subscribe(f"cl-{i}", Subscription(filter=f"by/{i}/+", qos=1))
     eng = SigEngine(index)
+    eng.route_small = False      # this test exercises the device path
     batcher = MicroBatcher(eng, window_us=0, max_batch=64)
     try:
         # no RTT sample yet: everything goes to the device path

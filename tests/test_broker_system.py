@@ -325,6 +325,7 @@ async def test_broker_with_sig_matcher_intents():
     async with running_broker() as broker:
         eng = SigEngine(broker.topics)
         eng.emit_intents = True
+        eng.route_small = False   # force the device/intents path
         broker.attach_matcher(MicroBatcher(eng, window_us=0))
         s = await connect(broker, "sub", version=5)
         await s.subscribe(("ity/+/path", 1))

@@ -142,6 +142,7 @@ def test_threaded_churn_sig_intents():
     _seed(idx)
     eng = SigEngine(idx)
     eng.emit_intents = True
+    eng.route_small = False   # storm the device decode, not the trie
     checked, total, errors = _storm(eng, idx, duration_s=6, n_readers=3)
     assert not errors, errors
     assert total > 5, "storm produced too few batches to mean anything"
@@ -154,6 +155,7 @@ def test_threaded_churn_sig_sets():
     idx = TopicIndex()
     _seed(idx)
     eng = SigEngine(idx)
+    eng.route_small = False
     checked, total, errors = _storm(eng, idx, duration_s=5, n_readers=2)
     assert not errors, errors
     assert total > 5 and checked > 0

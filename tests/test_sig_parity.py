@@ -18,6 +18,15 @@ from test_nfa_parity import normalize, rand_corpus
 PATHS = ["word", "compact", "fixed"]
 
 
+@pytest.fixture(autouse=True)
+def _always_device_path(monkeypatch):
+    """These tests exist to exercise the DEVICE path; the ADR-008
+    small-corpus router must not silently serve them from the trie
+    (parity would pass vacuously)."""
+    monkeypatch.setattr(SigEngine, "ROUTE_SUBS_MAX", -1)
+
+
+
 def run_path(engine, path, topics):
     if path == "word":
         return engine.subscribers_batch(topics)

@@ -42,6 +42,7 @@ for i in range(4000):
     idx.subscribe(f"c{i}", Subscription(filter=f, qos=i % 3))
 
 engine = SigEngine(idx, auto_refresh=False)
+engine.route_small = False    # the smoke must hit the device
 assert engine.pallas_active, "Pallas kernel must be active on TPU"
 topics = ["/".join(rng.choice(alphabet) for _ in range(rng.randint(1, 6)))
           for _ in range(512)] + ["$SYS/broker/x", "a//b"]
