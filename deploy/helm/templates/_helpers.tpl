@@ -5,3 +5,16 @@
 {{- define "maxmq-tpu.fullname" -}}
 {{- printf "%s-%s" .Release.Name (include "maxmq-tpu.name" .) | trunc 63 | trimSuffix "-" -}}
 {{- end -}}
+
+{{- define "maxmq-tpu.labels" -}}
+helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version | replace "+" "_" | trunc 63 | trimSuffix "-" }}
+app.kubernetes.io/name: {{ include "maxmq-tpu.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/version: {{ .Chart.AppVersion | quote }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end -}}
+
+{{- define "maxmq-tpu.selectorLabels" -}}
+app.kubernetes.io/name: {{ include "maxmq-tpu.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+{{- end -}}

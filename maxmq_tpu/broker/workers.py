@@ -30,6 +30,7 @@ section).
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 import os
 import subprocess
@@ -442,6 +443,45 @@ async def _supervise_workers(procs, spawn, boot) -> None:
                 await asyncio.sleep(wait)
             last_spawn[i] = time.monotonic()
             procs[i] = spawn(i)
+
+
+@contextlib.asynccontextmanager
+async def inprocess_pool(n: int = 2, bus_path: str | None = None):
+    """N pool workers in ONE process: the same Broker/BusHook/FanoutBus
+    objects the subprocess pool runs, minus the process boundary (which
+    only the kernel's SO_REUSEPORT accept sharding cares about). Yields
+    (brokers, ports). Used by the cross-worker test suite and the
+    overhead measurement harness (tools/measure_pool.py); also the
+    embedding surface for hosts that want a pool without subprocesses."""
+    bus_path = bus_path or f"/tmp/maxmq-bus-inproc-{os.getpid()}.sock"
+    bus = FanoutBus(bus_path)
+    await bus.start()
+    brokers, hooks, ports = [], [], []
+    try:
+        for i in range(n):
+            from ..hooks import AllowHook
+            from .listeners import TCPListener
+            from .server import Broker, BrokerOptions, Capabilities
+            b = Broker(BrokerOptions(capabilities=Capabilities(
+                sys_topic_interval=0)))
+            b.add_hook(AllowHook())
+            hook = BusHook(i, bus_path)
+            b.add_hook(hook)
+            lst = b.add_listener(TCPListener(f"tcp{i}", "127.0.0.1:0"))
+            await b.serve()
+            await hook.attach(b)
+            brokers.append(b)
+            hooks.append(hook)
+            ports.append(lst._server.sockets[0].getsockname()[1])
+        yield brokers, ports
+    finally:
+        for h in hooks:
+            h.stop()
+        for b in brokers:
+            await b.close()
+        await bus.close()
+        with contextlib.suppress(FileNotFoundError):
+            os.unlink(bus_path)
 
 
 async def run_pool(conf, logger, ready: asyncio.Event | None = None,

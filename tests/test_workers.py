@@ -12,40 +12,15 @@ import asyncio
 import contextlib
 import os
 
-from maxmq_tpu.broker import Broker, BrokerOptions, Capabilities, TCPListener
-from maxmq_tpu.broker.workers import BusHook, FanoutBus
-from maxmq_tpu.hooks import AllowHook
+from maxmq_tpu.broker.workers import inprocess_pool
 from maxmq_tpu.mqtt_client import MQTTClient
 
 
 @contextlib.asynccontextmanager
 async def running_pool(n: int = 2):
-    bus_path = f"/tmp/maxmq-test-bus-{os.getpid()}.sock"
-    bus = FanoutBus(bus_path)
-    await bus.start()
-    brokers, hooks, ports = [], [], []
-    for i in range(n):
-        b = Broker(BrokerOptions(capabilities=Capabilities(
-            sys_topic_interval=0)))
-        b.add_hook(AllowHook())
-        hook = BusHook(i, bus_path)
-        b.add_hook(hook)
-        lst = b.add_listener(TCPListener(f"tcp{i}", "127.0.0.1:0"))
-        await b.serve()
-        await hook.attach(b)
-        brokers.append(b)
-        hooks.append(hook)
-        ports.append(lst._server.sockets[0].getsockname()[1])
-    try:
-        yield brokers, ports
-    finally:
-        for h in hooks:
-            h.stop()
-        for b in brokers:
-            await b.close()
-        await bus.close()
-        with contextlib.suppress(FileNotFoundError):
-            os.unlink(bus_path)
+    async with inprocess_pool(
+            n, bus_path=f"/tmp/maxmq-test-bus-{os.getpid()}.sock") as out:
+        yield out
 
 
 async def test_cross_worker_delivery():

@@ -1,0 +1,160 @@
+"""Measure the ADR-005 worker-pool overhead ceiling at N=2 on one core
+(VERDICT r03 #8): the costs that BOUND the pool's scaling claim are all
+measurable here even though speedup is not —
+
+  bus_forward   per-message cost of the fan-out bus forwarding every
+                publish (pool same-worker delivery vs single broker)
+  bus_hop       added cost when delivery crosses workers (pool
+                cross-worker vs pool same-worker)
+  gossip        per-membership-change cost of $share ownership gossip
+                (shared subscribe/unsubscribe rate vs plain, on-pool)
+  takeover      wall latency of a cross-worker session takeover
+                (CONNECT with an id owned by the other worker ->
+                CONNACK session_present + first queued delivery)
+
+Writes one JSON line; `python tools/measure_pool.py`. Results are
+recorded in docs/adr/005-delivery-worker-pool.md.
+"""
+
+import asyncio
+import contextlib
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from maxmq_tpu.broker import (Broker, BrokerOptions, Capabilities,  # noqa: E402
+                              TCPListener)
+from maxmq_tpu.broker.workers import inprocess_pool  # noqa: E402
+from maxmq_tpu.hooks import AllowHook  # noqa: E402
+from maxmq_tpu.mqtt_client import MQTTClient  # noqa: E402
+
+N_MSGS = 2000
+N_CHURN = 1500
+N_TAKEOVERS = 30
+
+
+@contextlib.asynccontextmanager
+async def single_broker():
+    b = Broker(BrokerOptions(capabilities=Capabilities(
+        sys_topic_interval=0)))
+    b.add_hook(AllowHook())
+    lst = b.add_listener(TCPListener("t", "127.0.0.1:0"))
+    await b.serve()
+    try:
+        yield b, lst._server.sockets[0].getsockname()[1]
+    finally:
+        await b.close()
+
+
+@contextlib.asynccontextmanager
+async def pool(n: int = 2):
+    async with inprocess_pool(
+            n,
+            bus_path=f"/tmp/maxmq-measure-bus-{os.getpid()}.sock") \
+            as (_brokers, ports):
+        yield ports
+
+
+async def _pump(pub_port: int, sub_port: int, n: int) -> float:
+    """QoS0 publish->deliver msgs/s, one publisher one subscriber."""
+    s = MQTTClient(client_id="m-sub")
+    await s.connect("127.0.0.1", sub_port)
+    await s.subscribe(("mp/t", 0))
+    p = MQTTClient(client_id="m-pub")
+    await p.connect("127.0.0.1", pub_port)
+    await p.publish("mp/t", b"w")            # warm / route established
+    await s.next_message(timeout=30)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        await p.publish("mp/t", b"x")
+    for _ in range(n):
+        await s.next_message(timeout=60)
+    dt = time.perf_counter() - t0
+    await s.disconnect()
+    await p.disconnect()
+    return n / dt
+
+
+async def measure_bus() -> dict:
+    async with single_broker() as (_b, port):
+        base = await _pump(port, port, N_MSGS)
+    async with pool(2) as ports:
+        same = await _pump(ports[0], ports[0], N_MSGS)
+        cross = await _pump(ports[0], ports[1], N_MSGS)
+    us = lambda r: 1e6 / r
+    return {
+        "single_broker_msgs_per_sec": round(base, 1),
+        "pool_same_worker_msgs_per_sec": round(same, 1),
+        "pool_cross_worker_msgs_per_sec": round(cross, 1),
+        "bus_forward_us_per_msg": round(us(same) - us(base), 1),
+        "bus_hop_us_per_msg": round(us(cross) - us(same), 1),
+    }
+
+
+async def measure_gossip() -> dict:
+    async with pool(2) as ports:
+        c = MQTTClient(client_id="g-cl", version=5)
+        await c.connect("127.0.0.1", ports[0])
+
+        async def churn(filters) -> float:
+            t0 = time.perf_counter()
+            for f in filters:
+                await c.subscribe((f, 0))
+                await c.unsubscribe(f)
+            return time.perf_counter() - t0
+
+        plain = await churn([f"gp/{i}" for i in range(N_CHURN)])
+        shared = await churn([f"$share/g/gs/{i}"
+                              for i in range(N_CHURN)])
+        await c.disconnect()
+    # each shared sub+unsub is TWO membership changes (join + leave)
+    per_change_us = (shared - plain) / (2 * N_CHURN) * 1e6
+    return {
+        "plain_sub_unsub_pairs_per_sec": round(N_CHURN / plain, 1),
+        "shared_sub_unsub_pairs_per_sec": round(N_CHURN / shared, 1),
+        "gossip_us_per_membership_change": round(per_change_us, 1),
+    }
+
+
+async def measure_takeover() -> dict:
+    """Cross-worker takeover PROPAGATION latency: CONNECT on worker B
+    with an id live on worker A -> A's connection killed over the bus
+    ([MQTT-3.1.4-2] across the pool; session state is per-worker, so
+    what propagates is the termination)."""
+    lats = []
+    async with pool(2) as ports:
+        for i in range(N_TAKEOVERS):
+            cid = f"tk-{i}"
+            a = MQTTClient(client_id=cid)
+            await a.connect("127.0.0.1", ports[0])
+            t0 = time.perf_counter()
+            b = MQTTClient(client_id=cid)
+            await b.connect("127.0.0.1", ports[1])
+            await a.wait_closed(timeout=10)
+            lats.append(time.perf_counter() - t0)
+            await b.disconnect()
+    lats.sort()
+    return {
+        "takeovers": len(lats),
+        "takeover_propagation_ms_p50": round(
+            statistics.median(lats) * 1e3, 2),
+        "takeover_propagation_ms_max": round(lats[-1] * 1e3, 2),
+    }
+
+
+async def main() -> None:
+    out = {"n_workers": 2, "cores": os.cpu_count(),
+           "messages": N_MSGS}
+    out.update(await measure_bus())
+    out.update(await measure_gossip())
+    out.update(await measure_takeover())
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
