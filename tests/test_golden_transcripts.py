@@ -22,10 +22,11 @@ from maxmq_tpu.hooks import AllowHook
 
 
 @contextlib.asynccontextmanager
-async def raw_broker():
-    b = Broker(BrokerOptions(capabilities=Capabilities(
-        sys_topic_interval=0, receive_maximum=0, topic_alias_maximum=0,
-        maximum_packet_size=0)))
+async def raw_broker(**overrides):
+    caps = dict(sys_topic_interval=0, receive_maximum=0,
+                topic_alias_maximum=0, maximum_packet_size=0)
+    caps.update(overrides)
+    b = Broker(BrokerOptions(capabilities=Capabilities(**caps)))
     b.add_hook(AllowHook())
     lst = b.add_listener(TCPListener("raw", "127.0.0.1:0"))
     await b.serve()
@@ -195,3 +196,226 @@ async def test_retained_transcript():
         await expect(r2, SUBACK_R, "SUBACK")
         await expect(r2, PUBLISH_RETAIN_OUT, "retained redelivery")
         w2.close()
+
+
+# --- v5 subscription identifiers [MQTT5-3.8.2.1.2 / 3.3.2.3.8] -------
+
+# CONNECT v5 client "si5": clean start, keepalive 60, no props
+CONNECT_SI = bytes.fromhex("10100004" + "4d515454" + "05" + "02"
+                           + "003c" + "00" + "0003" + "736935")
+# SUBSCRIBE pid=0x0A, props = [Subscription Identifier (0x0B) = 7],
+# filter "s/i" opts 0x01 (maxqos 1)
+SUBSCRIBE_SI = bytes.fromhex("820b" + "000a" + "02" + "0b07"
+                             + "0003" + "732f69" + "01")
+SUBACK_SI = bytes.fromhex("9004" + "000a" + "00" + "01")
+# PUBLISH qos0 "s/i" payload "x", props len 0
+PUBLISH_SI_IN = bytes.fromhex("3007" + "0003" + "732f69" + "00" + "78")
+# delivery MUST carry the subscription identifier back [MQTT5-3.3.2-3.8]
+PUBLISH_SI_OUT = bytes.fromhex("3009" + "0003" + "732f69" + "02"
+                               + "0b07" + "78")
+
+
+async def test_v5_subscription_identifier_transcript():
+    async with raw_broker() as port:
+        reader, writer = await open_raw(port)
+        writer.write(CONNECT_SI + SUBSCRIBE_SI)
+        await writer.drain()
+        await expect(reader, CONNACK_V5, "CONNACK")
+        await expect(reader, SUBACK_SI, "SUBACK w/ sub id")
+        writer.write(PUBLISH_SI_IN)
+        await writer.drain()
+        await expect(reader, PUBLISH_SI_OUT,
+                     "delivery carries subscription identifier 7")
+        writer.write(DISCONNECT_V5)
+        await writer.drain()
+        writer.close()
+
+
+# --- v5 inbound topic aliases [MQTT5-3.3.2.3.4] ----------------------
+
+# CONNACK advertising Topic Alias Maximum (0x22) = 5 [MQTT5-3.2.2.3.8]
+CONNACK_ALIAS = bytes.fromhex("2006" + "00" + "00" + "03" + "220005")
+# publisher "al5" (v5) and a v4 watcher "alw" on "a/l"
+CONNECT_AL = bytes.fromhex("10100004" + "4d515454" + "05" + "02"
+                           + "003c" + "00" + "0003" + "616c35")
+CONNECT_ALW = bytes.fromhex("100f0004" + "4d515454" + "04" + "02"
+                            + "003c" + "0003" + "616c77")
+SUBSCRIBE_AL = bytes.fromhex("8208" + "0011" + "0003" + "612f6c" + "00")
+SUBACK_AL = bytes.fromhex("9003" + "0011" + "00")
+# PUBLISH "a/l" with props [Topic Alias (0x23) = 3], payload "p1":
+# establishes the alias [MQTT5-3.3.2-9..12]
+PUBLISH_AL_FULL = bytes.fromhex("300b" + "0003" + "612f6c" + "03"
+                                + "230003" + "7031")
+# PUBLISH with EMPTY topic + same alias, payload "p2": resolves to a/l
+PUBLISH_AL_BARE = bytes.fromhex("3008" + "0000" + "03" + "230003"
+                                + "7032")
+# the v4 watcher sees both as plain deliveries on the real topic
+DELIVER_AL_1 = bytes.fromhex("3007" + "0003" + "612f6c" + "7031")
+DELIVER_AL_2 = bytes.fromhex("3007" + "0003" + "612f6c" + "7032")
+
+
+async def test_v5_inbound_topic_alias_transcript():
+    async with raw_broker(topic_alias_maximum=5) as port:
+        wr, ww = await open_raw(port)
+        ww.write(CONNECT_ALW + SUBSCRIBE_AL)
+        await ww.drain()
+        await expect(wr, CONNACK_V4, "watcher CONNACK")
+        await expect(wr, SUBACK_AL, "watcher SUBACK")
+        pr, pw = await open_raw(port)
+        pw.write(CONNECT_AL)
+        await pw.drain()
+        await expect(pr, CONNACK_ALIAS, "CONNACK advertises alias max 5")
+        pw.write(PUBLISH_AL_FULL + PUBLISH_AL_BARE)
+        await pw.drain()
+        await expect(wr, DELIVER_AL_1, "aliased publish 1 resolved")
+        await expect(wr, DELIVER_AL_2, "alias-only publish 2 resolved")
+        pw.close()
+        ww.close()
+
+
+# --- v5 flow control: client Receive Maximum gates QoS1 sends --------
+# [MQTT5-3.1.2.11.3]: the server MUST NOT exceed the client's Receive
+# Maximum of unacknowledged QoS>0 deliveries.
+
+# CONNECT "fq5" with props [Receive Maximum (0x21) = 1]
+CONNECT_FQ = bytes.fromhex("1013" + "0004" + "4d515454" + "05" + "02"
+                           + "003c" + "03" + "210001"
+                           + "0003" + "667135")
+SUBSCRIBE_FQ = bytes.fromhex("8209" + "0021" + "00" + "0003" + "662f71"
+                             + "01")
+SUBACK_FQ = bytes.fromhex("9004" + "0021" + "00" + "01")
+# broker-side QoS1 deliveries: broker-assigned pids start at 1 per
+# session (implementation choice; any nonzero pid is spec-legal)
+DELIVER_FQ_1 = bytes.fromhex("320a" + "0003" + "662f71" + "0001" + "00"
+                             + "6d30")
+DELIVER_FQ_2 = bytes.fromhex("320a" + "0003" + "662f71" + "0002" + "00"
+                             + "6d31")
+PUBACK_FQ_1 = bytes.fromhex("4002" + "0001")
+PUBACK_FQ_2 = bytes.fromhex("4002" + "0002")
+
+
+async def test_v5_receive_maximum_flow_control_transcript():
+    async with raw_broker() as port:
+        reader, writer = await open_raw(port)
+        writer.write(CONNECT_FQ + SUBSCRIBE_FQ)
+        await writer.drain()
+        await expect(reader, CONNACK_V5, "CONNACK")
+        await expect(reader, SUBACK_FQ, "SUBACK")
+        # a second connection publishes two QoS1 messages back to back
+        pr, pw = await open_raw(port)
+        pub2 = bytearray(CONNECT_V5)
+        pub2[-1] = ord("6")              # client id "gold6"
+        pw.write(bytes(pub2))
+        await pw.drain()
+        await expect(pr, CONNACK_V5, "pub CONNACK")
+        # QoS1 inbound publishes m0, m1 (pids 0x21/0x22; the broker
+        # PUBACKs inbound independently of the outbound send quota)
+        pw.write(bytes.fromhex("320a" + "0003" + "662f71" + "0021"
+                               + "00" + "6d30"))
+        pw.write(bytes.fromhex("320a" + "0003" + "662f71" + "0022"
+                               + "00" + "6d31"))
+        await pw.drain()
+        await expect(pr, bytes.fromhex("40020021"), "inbound PUBACK m0")
+        await expect(pr, bytes.fromhex("40020022"), "inbound PUBACK m1")
+        # quota 1: exactly ONE delivery until we PUBACK
+        await expect(reader, DELIVER_FQ_1, "first QoS1 delivery")
+        with contextlib.suppress(asyncio.TimeoutError):
+            extra = await asyncio.wait_for(reader.read(1), 0.3)
+            if not extra:
+                raise AssertionError("broker dropped the connection "
+                                     "instead of withholding delivery")
+            raise AssertionError(
+                f"delivery exceeded Receive Maximum: {extra!r}")
+        writer.write(PUBACK_FQ_1)
+        await writer.drain()
+        await expect(reader, DELIVER_FQ_2, "second delivery after ack")
+        writer.write(PUBACK_FQ_2)
+        await writer.drain()
+        pw.close()
+        writer.close()
+
+
+# --- QoS2 DUP redelivery is de-duplicated [MQTT-4.3.3] ---------------
+
+SUBSCRIBE_D = bytes.fromhex("8208" + "0031" + "0003" + "672f64" + "02")
+SUBACK_D = bytes.fromhex("9003" + "0031" + "02")
+# PUBLISH qos2 pid=0x11 "g/d" payload "D"
+PUBLISH_D = bytes.fromhex("3408" + "0003" + "672f64" + "0011" + "44")
+# the same packet resent with DUP=1 after PUBREC [MQTT-3.3.1-1]
+PUBLISH_D_DUP = bytes.fromhex("3c08" + "0003" + "672f64" + "0011" + "44")
+PUBREC_D = bytes.fromhex("5002" + "0011")
+PUBREL_D = bytes.fromhex("6202" + "0011")
+PUBCOMP_D = bytes.fromhex("7002" + "0011")
+DELIVER_D = bytes.fromhex("3408" + "0003" + "672f64" + "0001" + "44")
+
+
+async def test_qos2_dup_dedup_transcript():
+    async with raw_broker() as port:
+        # watcher at qos2
+        wr, ww = await open_raw(port)
+        watcher = bytearray(CONNECT_V4)
+        watcher[-1] = ord("w")
+        ww.write(bytes(watcher) + SUBSCRIBE_D)
+        await ww.drain()
+        await expect(wr, CONNACK_V4, "watcher CONNACK")
+        await expect(wr, SUBACK_D, "watcher SUBACK")
+        # publisher sends qos2, gets PUBREC, RESENDS with DUP, completes
+        reader, writer = await open_raw(port)
+        writer.write(CONNECT_V4)
+        await writer.drain()
+        await expect(reader, CONNACK_V4, "CONNACK")
+        writer.write(PUBLISH_D)
+        await writer.drain()
+        await expect(reader, PUBREC_D, "PUBREC")
+        writer.write(PUBLISH_D_DUP)     # retry: must re-ack, not re-send
+        await writer.drain()
+        await expect(reader, PUBREC_D, "PUBREC for DUP retry")
+        writer.write(PUBREL_D)
+        await writer.drain()
+        await expect(reader, PUBCOMP_D, "PUBCOMP")
+        # the watcher got exactly ONE delivery (broker pid 1, qos2)
+        await expect(wr, DELIVER_D, "single delivery")
+        # ack the delivery's qos2 flow so teardown is clean
+        ww.write(bytes.fromhex("50020001"))
+        await ww.drain()
+        await expect(wr, bytes.fromhex("62020001"), "broker PUBREL")
+        ww.write(bytes.fromhex("70020001"))
+        await ww.drain()
+        with contextlib.suppress(asyncio.TimeoutError):
+            extra = await asyncio.wait_for(wr.read(1), 0.3)
+            if not extra:
+                raise AssertionError("broker dropped the watcher "
+                                     "instead of deduplicating")
+            raise AssertionError(f"duplicate delivery: {extra!r}")
+        writer.close()
+        ww.close()
+
+
+# --- will published on abnormal disconnect [MQTT-3.1.2-8] ------------
+
+# CONNECT "wl4" with will flag (0x06 = clean + will), will qos0:
+# payload = client id, will topic "w/t", will message "W"
+CONNECT_WILL = bytes.fromhex("1017" + "0004" + "4d515454" + "04" + "06"
+                             + "003c" + "0003" + "776c34"
+                             + "0003" + "772f74" + "0001" + "57")
+SUBSCRIBE_W = bytes.fromhex("8208" + "0041" + "0003" + "772f74" + "00")
+SUBACK_W = bytes.fromhex("9003" + "0041" + "00")
+DELIVER_WILL = bytes.fromhex("3006" + "0003" + "772f74" + "57")
+
+
+async def test_will_transcript():
+    async with raw_broker() as port:
+        wr, ww = await open_raw(port)
+        watcher = bytearray(CONNECT_V4)
+        watcher[-1] = ord("W")
+        ww.write(bytes(watcher) + SUBSCRIBE_W)
+        await ww.drain()
+        await expect(wr, CONNACK_V4, "watcher CONNACK")
+        await expect(wr, SUBACK_W, "watcher SUBACK")
+        dr, dw = await open_raw(port)
+        dw.write(CONNECT_WILL)
+        await dw.drain()
+        await expect(dr, CONNACK_V4, "will client CONNACK")
+        dw.close()                       # abrupt close -> will fires
+        await expect(wr, DELIVER_WILL, "will delivered")
+        ww.close()
