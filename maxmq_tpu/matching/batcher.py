@@ -207,15 +207,7 @@ class MicroBatcher:
             self._wakeup.clear()
             if not self._pending:
                 continue
-            # adaptive window: coalescing only pays when the device is
-            # already busy (arrivals during a flight pile up anyway) or
-            # the batch will go to the device and could still grow —
-            # when the bypass will take it, or nothing is in flight,
-            # waiting just adds latency
-            if (len(self._pending) < self.max_batch and self.window_us > 0
-                    and not self._should_bypass(len(self._pending))
-                    and self._inflight._value < self.pipeline_depth):
-                await asyncio.sleep(self.window_us / 1e6)
+            await self._maybe_window()
             batch, self._pending = (self._pending[:self.max_batch],
                                     self._pending[self.max_batch:])
             if self._pending:
@@ -227,15 +219,27 @@ class MicroBatcher:
             ver = self._subs_version()   # results valid as-of dispatch
             if self._should_bypass(len(batch)):
                 self._run_bypass(batch, topics, ver)
-                continue
-            # ADR-008 routed corpora serve via the engine's whole-batch
-            # surface (which answers from its trie); dispatch_fixed
-            # would force the device round trip the router rejected
-            routes = getattr(self.engine, "_routes_to_trie", None)
-            if split and not (routes is not None and routes()):
+            elif split and not self._engine_routes():
                 await self._dispatch_pipelined(loop, batch, topics, ver)
             else:
                 await self._run_whole_batch(loop, batch, topics, ver)
+
+    async def _maybe_window(self) -> None:
+        """Adaptive coalescing window: waiting only pays when the device
+        is already busy (arrivals during a flight pile up anyway) AND
+        the batch will actually go to the device — when the bypass will
+        take it, or nothing is in flight, waiting just adds latency."""
+        if (len(self._pending) < self.max_batch and self.window_us > 0
+                and not self._should_bypass(len(self._pending))
+                and self._inflight._value < self.pipeline_depth):
+            await asyncio.sleep(self.window_us / 1e6)
+
+    def _engine_routes(self) -> bool:
+        """ADR-008 routed corpora serve via the engine's whole-batch
+        surface (which answers from its trie); dispatch_fixed would
+        force the device round trip the router rejected."""
+        routes = getattr(self.engine, "_routes_to_trie", None)
+        return routes is not None and routes()
 
     # -- adaptive CPU bypass -------------------------------------------
 

@@ -134,32 +134,51 @@ class MatcherService:
             if asyncio.iscoroutine(res):
                 await res
 
+    def _release(self, cid: str, filt: str, gen: int) -> None:
+        """Drop an index entry IF the releasing connection still holds
+        its current generation (a stale owner's late release must not
+        tear down an entry a newer connection re-owns)."""
+        key = (cid, filt)
+        if self._owners.get(key) != gen:
+            return              # re-owned by a newer connection
+        del self._owners[key]
+        self.index.unsubscribe(cid, filt)
+
+    def _apply_op(self, ftype: int, msg: dict,
+                  owned: dict[str, dict[str, int]]) -> None:
+        """One subscription op from one connection. Subscription state
+        is OWNED BY THE CONNECTION while it holds the entry's CURRENT
+        generation (self._owners): each OP_SUB bumps the generation and
+        transfers sole ownership, so a stale connection's later
+        drop/unsub/death cannot touch an entry a newer connection
+        re-owns, while the current owner's explicit OP_UNSUB stops
+        matching immediately (no ghost deliveries until a wedged old
+        worker dies). ``owned``: cid -> {filter: generation at acquire}."""
+        if ftype == OP_SUB:
+            sub = _decode_sub(msg["v"])
+            if self.index.subscribe(msg["c"], sub):
+                self.subs_applied += 1
+            self._gen += 1
+            self._owners[(msg["c"], sub.filter)] = self._gen
+            owned.setdefault(msg["c"], {})[sub.filter] = self._gen
+        elif ftype == OP_UNSUB:
+            gen = owned.get(msg["c"], {}).pop(msg["f"], None)
+            if gen is not None:
+                self._release(msg["c"], msg["f"], gen)
+        elif ftype == OP_DROP:
+            for filt, gen in owned.pop(msg["c"], {}).items():
+                self._release(msg["c"], filt, gen)
+
     async def _serve(self, reader, writer) -> None:
         """One client connection: ops applied in arrival order; match
         results may complete out of order (req ids pair them) while the
-        batcher coalesces topics across ALL connections."""
+        batcher coalesces topics across ALL connections. A lost UNSUB op
+        can never leave stale filters past the owning broker's
+        reconnect+reseed: the connection purge releases everything this
+        connection still owns."""
         tasks: set[asyncio.Task] = set()
         self._conns.add(writer)
-        # subscription state is OWNED BY THIS CONNECTION while it holds
-        # the entry's CURRENT generation (self._owners): each OP_SUB
-        # bumps the generation and transfers sole ownership to this
-        # connection, so a stale connection's later drop/unsub/death
-        # cannot touch an entry a newer connection re-owns, while the
-        # current owner's explicit OP_UNSUB stops matching immediately
-        # (no ghost deliveries until a wedged old worker dies). A lost
-        # UNSUB op can never leave stale filters past the owning
-        # broker's reconnect+reseed: the connection purge releases
-        # everything this connection still owns.
-        # owned: cid -> {filter: generation at acquire}.
         owned: dict[str, dict[str, int]] = {}
-
-        def _release(cid: str, filt: str, gen: int) -> None:
-            key = (cid, filt)
-            if self._owners.get(key) != gen:
-                return          # re-owned by a newer connection
-            del self._owners[key]
-            self.index.unsubscribe(cid, filt)
-
         try:
             while True:
                 fr = await _read_frame(reader)
@@ -167,30 +186,18 @@ class MatcherService:
                     return
                 ftype, payload = fr
                 msg = json.loads(payload)
-                if ftype == OP_SUB:
-                    sub = _decode_sub(msg["v"])
-                    if self.index.subscribe(msg["c"], sub):
-                        self.subs_applied += 1
-                    self._gen += 1
-                    self._owners[(msg["c"], sub.filter)] = self._gen
-                    owned.setdefault(msg["c"], {})[sub.filter] = self._gen
-                elif ftype == OP_UNSUB:
-                    gen = owned.get(msg["c"], {}).pop(msg["f"], None)
-                    if gen is not None:
-                        _release(msg["c"], msg["f"], gen)
-                elif ftype == OP_DROP:
-                    for filt, gen in owned.pop(msg["c"], {}).items():
-                        _release(msg["c"], filt, gen)
-                elif ftype == OP_MATCH:
+                if ftype == OP_MATCH:
                     t = asyncio.ensure_future(
                         self._match(msg["r"], msg["t"], writer))
                     tasks.add(t)
                     t.add_done_callback(tasks.discard)
+                else:
+                    self._apply_op(ftype, msg, owned)
         finally:
             self._conns.discard(writer)
             for cid, filters in owned.items():
                 for filt, gen in filters.items():
-                    _release(cid, filt, gen)
+                    self._release(cid, filt, gen)
             for t in tasks:
                 t.cancel()
             writer.close()

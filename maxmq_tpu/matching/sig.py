@@ -1784,41 +1784,50 @@ class SigEngine(OverlayedEngine):
 
         nd = _native_decode(tables) if removed is None else None
         if nd is not None:
-            # one C pass: verify + the whole entry union (plain inserts,
-            # identifier merges via the merge_subscription callback,
-            # shared-group maps) + the result construction — nothing
-            # left to walk in python. Intents mode (ADR 007) skips the
-            # merged-dict materialization entirely: flat borrowed-
-            # pointer entries the broker fans out directly. Overlay
-            # windows need merge_delta's set mutation, so they keep the
-            # set form until the background recompile lands.
-            mod, capsule = nd
-            _dt, pad = _compact_dtype(tables)
-            decode_fn = (mod.decode_batch_intents
-                         if self.emit_intents and overlay is None
-                         and hasattr(mod, "decode_batch_intents")
-                         else mod.decode_batch)
-            out = decode_fn(
-                capsule, toks8, toks8.dtype.itemsize, int(pad), lens_enc,
-                batch, np.ascontiguousarray(ti),
-                np.ascontiguousarray(rw))
-            ti = rw = None
+            out = self._decode_native(nd, tables, toks8, lens_enc, batch,
+                                      ti, rw, overlay)
         else:
-            lengths = np.abs(lens_enc.astype(np.int32))
-            dollar = lens_enc < 0
-            dtype, pad = _compact_dtype(tables)
-            toks32 = toks8.astype(np.int32)
-            if dtype is not np.int32:
-                toks32[toks32 == pad] = -1
-            ok = verify_pairs(tables, toks32, lengths, dollar, ti, rw)
-            ti, rw = ti[ok], rw[ok]
-            out = [SubscriberSet() for _ in range(batch)]
-        if ti is not None:             # the C pass already did the walk
-            if removed is None:
-                _union_pairs(out, ti, rw, tables)
-            else:
-                _union_pairs_removed(out, ti, rw, tables, removed)
+            out = self._decode_python(tables, toks8, lens_enc, batch,
+                                      ti, rw, removed)
         return self._overlay_fallback_pass(topics, out, fall, overlay)
+
+    def _decode_native(self, nd, tables, toks8, lens_enc, batch, ti, rw,
+                       overlay):
+        """One C pass: verify + the whole entry union (plain inserts,
+        identifier merges via the merge_subscription callback,
+        shared-group maps) + the result construction — nothing left to
+        walk in python. Intents mode (ADR 007) skips the merged-dict
+        materialization entirely: flat borrowed-pointer entries the
+        broker fans out directly. Overlay windows need merge_delta's
+        set mutation, so they keep the set form until the background
+        recompile lands."""
+        mod, capsule = nd
+        _dt, pad = _compact_dtype(tables)
+        decode_fn = (mod.decode_batch_intents
+                     if self.emit_intents and overlay is None
+                     and hasattr(mod, "decode_batch_intents")
+                     else mod.decode_batch)
+        return decode_fn(
+            capsule, toks8, toks8.dtype.itemsize, int(pad), lens_enc,
+            batch, np.ascontiguousarray(ti), np.ascontiguousarray(rw))
+
+    @staticmethod
+    def _decode_python(tables, toks8, lens_enc, batch, ti, rw, removed):
+        """Python fallback: numpy batch verify + per-pair entry union."""
+        lengths = np.abs(lens_enc.astype(np.int32))
+        dollar = lens_enc < 0
+        dtype, pad = _compact_dtype(tables)
+        toks32 = toks8.astype(np.int32)
+        if dtype is not np.int32:
+            toks32[toks32 == pad] = -1
+        ok = verify_pairs(tables, toks32, lengths, dollar, ti, rw)
+        ti, rw = ti[ok], rw[ok]
+        out = [SubscriberSet() for _ in range(batch)]
+        if removed is None:
+            _union_pairs(out, ti, rw, tables)
+        else:
+            _union_pairs_removed(out, ti, rw, tables, removed)
+        return out
 
     def _overlay_fallback_pass(self, topics, out, fall, overlay):
         """Overlay/fallback post-pass; the overwhelmingly common case

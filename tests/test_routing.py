@@ -2,7 +2,6 @@
 trie; growing past ROUTE_SUBS_MAX flips to the device path — with
 exact results either side of the flip."""
 
-import pytest
 
 from maxmq_tpu.matching import TopicIndex
 from maxmq_tpu.matching.sig import SigEngine
