@@ -94,6 +94,13 @@ class MicroBatcher:
         self.largest_batch = 0
         self.bypasses = 0                 # topics served by the bypass
 
+    @property
+    def device_rtt(self) -> float:
+        """Measured device round-trip EWMA (seconds; 0 until the first
+        post-warm sample) — the public face of the bypass estimate,
+        scraped by the metrics bridge."""
+        return self._device_rtt or 0.0
+
     # Delegate the sync surface so the batcher is a drop-in matcher.
     def subscribers(self, topic: str) -> "SubscriberSet":
         return self.engine.subscribers(topic)

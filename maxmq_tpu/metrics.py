@@ -271,6 +271,28 @@ def register_broker_metrics(registry: Registry, broker) -> None:
                 "maxmq_matcher_cache_hits_total",
                 "Matches served from the version-keyed topic cache",
                 lambda: matcher.cache_hits)
+        if hasattr(matcher, "bypasses"):
+            registry.counter_func(
+                "maxmq_matcher_bypassed_topics_total",
+                "Topics served inline from the CPU trie by the "
+                "adaptive bypass (ADR 008)",
+                lambda: matcher.bypasses)
+            registry.gauge_func(
+                "maxmq_matcher_device_rtt_seconds",
+                "Measured device round-trip EWMA driving the bypass",
+                lambda: matcher.device_rtt)
+        eng = getattr(matcher, "engine", matcher)
+        if hasattr(eng, "trie_routed"):
+            registry.counter_func(
+                "maxmq_matcher_trie_routed_total",
+                "Topics served from the CPU trie by the small-corpus "
+                "router (ADR 008)",
+                lambda: eng.trie_routed)
+        if hasattr(matcher, "reconnects"):
+            registry.counter_func(
+                "maxmq_matcher_service_reconnects_total",
+                "Matcher-service transport reconnects",
+                lambda: matcher.reconnects)
     if matcher is not None:
         # ANY attached matcher drives the ADR-006 pipeline; scrapes run
         # on the metrics thread while close() may null the queue on the

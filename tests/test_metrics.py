@@ -192,3 +192,27 @@ async def test_http_stats_listener():
         assert "clients_connected" in data
     finally:
         await broker.close()
+
+
+def test_matcher_metrics_series_render():
+    """The ADR-007/008 matcher series (bypass, trie-route, RTT) appear
+    in the exposition when a batcher-wrapped engine is attached."""
+    from maxmq_tpu.matching.batcher import MicroBatcher
+    from maxmq_tpu.matching.sig import SigEngine
+
+    broker = Broker(BrokerOptions(
+        capabilities=Capabilities(sys_topic_interval=0)))
+    broker.topics.subscribe("m1", Subscription(filter="mx/+", qos=0))
+    eng = SigEngine(broker.topics)
+    mb = MicroBatcher(eng)
+    broker.attach_matcher(mb)
+    mb.bypasses = 3
+    mb._device_rtt = 0.012   # seed the EWMA the property exposes
+    eng.trie_routed = 5
+    reg = Registry()
+    register_broker_metrics(reg, broker)
+    text = reg.expose()
+    assert "maxmq_matcher_matches_total" in text
+    assert "maxmq_matcher_bypassed_topics_total 3" in text
+    assert "maxmq_matcher_device_rtt_seconds 0.012" in text
+    assert "maxmq_matcher_trie_routed_total 5" in text
