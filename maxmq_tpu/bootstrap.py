@@ -80,6 +80,7 @@ def build_matcher(conf: Config, broker: Broker):
             # corpus (DEPTH_CAP-bounded); matcher_max_levels is a
             # word-path/nfa/dense knob
             engine = ShardedSigEngine(broker.topics, mesh=mesh)
+            engine.emit_intents = conf.matcher_intents   # ADR 007
     elif conf.matcher == "nfa":
         from .matching.engine import NFAEngine
         engine = NFAEngine(broker.topics,

@@ -183,16 +183,36 @@ def _sharded_match(tables_dev, toks, lengths, dollar, *, width, table_mask,
     return rows[None], overflow[None]   # re-add the 'subs' axis
 
 
-def compile_sig_shards(subs, n_shards: int, version: int):
-    """Partition subscriptions round-robin and compile one signature table
-    per shard with a shared token-intern pool (uniform token ids across the
-    mesh, so topics are tokenized once and replicated over 'subs')."""
+def compile_sig_shards(subs, n_shards: int, version: int,
+                       by_client: bool = True):
+    """Partition subscriptions BY CLIENT (stable crc32 hash of client id)
+    and compile one signature table per shard with a shared token-intern
+    pool (uniform token ids across the mesh, so topics are tokenized once
+    and replicated over 'subs').
+
+    Client-hash partitioning is the load-bearing choice: every entry of
+    one client lives on exactly ONE shard, so per-shard decode results
+    are disjoint by construction and the host can CHAIN them per topic
+    (ChainedIntents) with no cross-shard merge — the sharded equivalent
+    of ADR 007's no-merged-dict rule. IoT corpora carry ~1 subscription
+    per client, so balance matches round-robin to within hash noise.
+    ``by_client=False`` restores round-robin (the refresh fallback when
+    one heavy client's wildcard shapes overflow a bucket's MAX_GROUPS —
+    spreading keeps the device path alive at the cost of chaining)."""
+    import zlib
+
     from ..matching.sig import compile_sig_subscriptions
 
     vocab: dict[str, int] = {}
-    return [compile_sig_subscriptions(subs[i::n_shards], version,
-                                      vocab=vocab)
-            for i in range(n_shards)]
+    if by_client:
+        buckets: list[list] = [[] for _ in range(n_shards)]
+        for entry in subs:
+            cid = entry[1]              # (filter, client_id, sub, group)
+            buckets[zlib.crc32(cid.encode()) % n_shards].append(entry)
+    else:
+        buckets = [subs[i::n_shards] for i in range(n_shards)]
+    return [compile_sig_subscriptions(b, version, vocab=vocab)
+            for b in buckets]
 
 
 def _sharded_sig_match(tables_dev, toks, lens_enc, *, sel_blocks, max_rows):
@@ -219,11 +239,99 @@ def _sharded_sig_match(tables_dev, toks, lens_enc, *, sel_blocks, max_rows):
 from ..matching.sig import OverlayedEngine
 
 
+def _shard_pairs(out_s, hr, batch, col, fall):
+    """One shard's UNVERIFIED candidate (topic, row) pairs: device slots
+    + host-probe rows, with overflowed (trie-served) topics' pairs
+    dropped before the C verify."""
+    cnt = out_s[:, 0].astype(np.int64)
+    cnt = np.where(cnt == 0xF, 0, cnt)          # fall slots replaced later
+    mask = col[None, :] < cnt[:, None]
+    ti_dev = np.repeat(np.arange(batch), cnt)
+    rw_dev = out_s[:, 1:][mask].astype(np.int64)
+    offs = getattr(hr, "offsets", None)
+    if offs is not None:                        # HostRows CSR
+        ti_h = np.repeat(np.arange(batch), np.diff(offs[:batch + 1]))
+        rw_h = hr.rows[:offs[batch]].astype(np.int64)
+    else:
+        ti_h = np.repeat(np.arange(batch), [len(h) for h in hr])
+        rw_h = (np.concatenate([np.asarray(h) for h in hr])
+                .astype(np.int64) if len(ti_h)
+                else np.empty(0, dtype=np.int64))
+    ti = np.concatenate([ti_dev, ti_h])
+    rw = np.concatenate([rw_dev, rw_h])
+    if fall.any():                  # overflowed topics are served by the
+        keep = ~fall[ti]            # trie; don't union their pairs
+        ti, rw = ti[keep], rw[keep]
+    return np.ascontiguousarray(ti), np.ascontiguousarray(rw)
+
+
+class ChainedIntents:
+    """Per-topic cluster-mode delivery result: the per-shard
+    DeliveryIntents chained, NOT merged. Valid because subscriptions
+    partition by client hash (compile_sig_shards) — one client's entries
+    live on exactly one shard, so the chained iteration can never name a
+    client twice and no cross-shard per-client merge exists to do.
+    Duck-types the ADR-007 consumer surface (__iter__/n/__len__/shared/
+    has_client/to_set); shared-group candidate maps MAY span shards (a
+    group's members hash apart), so ``shared`` is a lazy outer-merged
+    view. Immutable, like every cached match result."""
+
+    __slots__ = ("parts", "_shared", "_set")
+
+    def __init__(self, parts: list) -> None:
+        self.parts = parts
+        self._shared = None
+        self._set = None
+
+    def __iter__(self):
+        for p in self.parts:
+            yield from p
+
+    @property
+    def n(self) -> int:
+        return sum(p.n for p in self.parts)
+
+    def __len__(self) -> int:
+        return sum(len(p) for p in self.parts)
+
+    @property
+    def shared(self) -> dict:
+        if self._shared is None:
+            merged: dict = {}
+            for p in self.parts:
+                if len(p) == p.n:        # no shared members on this shard
+                    continue
+                for key, members in p.shared.items():
+                    cur = merged.get(key)
+                    if cur is None:
+                        merged[key] = members
+                    else:                # group spans shards: union view
+                        cur = dict(cur)
+                        cur.update(members)
+                        merged[key] = cur
+            self._shared = merged
+        return self._shared
+
+    def has_client(self, cid: str) -> bool:
+        return any(p.has_client(cid) for p in self.parts)
+
+    def to_set(self) -> SubscriberSet:
+        if self._set is None:
+            subs: dict = {}
+            for cid, sub in self:
+                subs[cid] = sub          # disjoint by construction
+            self._set = SubscriberSet(subs, dict(self.shared))
+        return self._set
+
+
 class ShardedSigEngine(OverlayedEngine):
     """Signature matcher sharded over a ('data', 'subs') mesh — cluster
     mode of the production `sig` path.
 
-    Subscriptions partition round-robin over 'subs': each device holds one
+    Subscriptions partition by CLIENT HASH over 'subs'
+    (compile_sig_shards — the invariant ChainedIntents' merge-free
+    chaining rests on; refresh falls back to round-robin, chaining off,
+    if a heavy client overflows a bucket): each device holds one
     shard's group constants + row-signature planes and matches the full
     topic batch slice against them; per-shard fixed match slots come back
     over the ICI and the host unions shard-local decodes (the reference's
@@ -245,6 +353,9 @@ class ShardedSigEngine(OverlayedEngine):
         self._refresh_lock = threading.Lock()
         self.matches = 0
         self.fallbacks = 0
+        # cluster-mode ADR 007: per-shard native DeliveryIntents chained
+        # per topic (client-hash sharding makes chaining merge-free)
+        self.emit_intents = False
         self._init_overlay()
         self.refresh(force=True)
 
@@ -274,14 +385,12 @@ class ShardedSigEngine(OverlayedEngine):
                     and state[0] == subs_version(self.index)):
                 return False
             version = subs_version(self.index)
-            shards = compile_sig_shards(self.index.all_subscriptions(),
-                                        self.sp, version)
-            from ..matching.sig import MAX_GROUPS
-            if any(len(t.groups) > MAX_GROUPS for t in shards):
-                # pathological corpus: serve exactly via the CPU trie
-                # (same discipline as SigEngine.refresh)
-                self._state = (version, shards, None, None, 0, {},
-                               self.dp)
+            shards, chain_ok = self._compile_shards(version)
+            if shards is None or chain_ok is None:
+                # pathological corpus under EITHER partitioning: serve
+                # exactly via the CPU trie (as SigEngine.refresh)
+                self._state = (version, shards or [], None, None, 0, {},
+                               self.dp, False)
                 return True
 
             stacked, d_max = _pad_and_stack_shards(shards, self.sp)
@@ -303,23 +412,45 @@ class ShardedSigEngine(OverlayedEngine):
             union_exact = {}
             for t in shards:
                 union_exact.update(t.host_exact or {})
-            # dp rides in the state tuple: a concurrent match must pad
-            # with the SAME data-axis factor the compiled fn expects,
-            # even while reshard() is swapping meshes
+            # dp and chain_ok ride in the state tuple: a concurrent
+            # match must pad with the SAME data-axis factor the compiled
+            # fn expects, and chaining must pair atomically with the
+            # partitioning that makes it merge-free, even while
+            # reshard()/refresh() swap states
             self._state = (version, shards, dev, fn, d_max, union_exact,
-                           self.dp)
+                           self.dp, chain_ok)
             return True
+
+    def _compile_shards(self, version: int):
+        """Compile per-shard tables: client-hash first (chaining ok);
+        round-robin fallback when a heavy client overflows a bucket's
+        MAX_GROUPS (spreads shapes across shards, keeping the DEVICE
+        path alive at the cost of merge-free chaining); (None, None)
+        when even round-robin overflows."""
+        from ..matching.sig import MAX_GROUPS
+
+        subs = self.index.all_subscriptions()
+        shards = compile_sig_shards(subs, self.sp, version)
+        if all(len(t.groups) <= MAX_GROUPS for t in shards):
+            return shards, True
+        shards = compile_sig_shards(subs, self.sp, version,
+                                    by_client=False)
+        if all(len(t.groups) <= MAX_GROUPS for t in shards):
+            return shards, False
+        return None, None
 
     # ------------------------------------------------------------------
 
     def match_raw(self, topics: list[str]):
         """Sharded device match. Returns (out uint32[sp, B, 1+max_rows],
-        hostrows list[sp][B], shards), batch-trimmed."""
+        hostrows list[sp][B], shards, toks[B, W], lens_enc[B]),
+        batch-trimmed; toks/lens_enc feed the per-shard native decode."""
         from ..matching.sig import (host_exact_rows_from_sig,
                                     host_plus_rows, prepare_batch_sig)
 
         self.refresh_soon()
-        _version, shards, dev, fn, d_max, union_exact, dp = self._state
+        (_version, shards, dev, fn, d_max, union_exact, dp,
+         _chain_ok) = self._state
         if fn is None:
             raise RuntimeError(
                 "device matching disabled for this corpus (> MAX_GROUPS "
@@ -340,28 +471,38 @@ class ShardedSigEngine(OverlayedEngine):
             hr = host_exact_rows_from_sig(t, esig, lengths)
             host_plus_rows(t, toks, lengths, dollar, into=hr)
             hostrows.append(hr)
-        return np.asarray(out)[:, :batch], \
-            [h[:batch] for h in hostrows], shards
+        return (np.asarray(out)[:, :batch],
+                [h[:batch] for h in hostrows], shards,
+                toks[:batch], lens_enc[:batch])
+
+    def _trie_all(self, topics: list[str]) -> list[SubscriberSet]:
+        self.matches += len(topics)
+        self.fallbacks += len(topics)
+        return [self.index.subscribers(t) for t in topics]
 
     def subscribers_batch(self, topics: list[str]) -> list[SubscriberSet]:
-        from ..matching.sig import SigEngine
-
         self.refresh_soon()
         if self._state[3] is None:      # pathological corpus: CPU trie
-            self.matches += len(topics)
-            self.fallbacks += len(topics)
-            return [self.index.subscribers(t) for t in topics]
+            return self._trie_all(topics)
         try:
-            out, hostrows, shards = self.match_raw(topics)
+            out, hostrows, shards, toks, lens_enc = self.match_raw(topics)
         except RuntimeError:            # state swapped to disabled mid-call
-            self.matches += len(topics)
-            self.fallbacks += len(topics)
-            return [self.index.subscribers(t) for t in topics]
+            return self._trie_all(topics)
         overlay = self.overlay_for(shards[0].version)
         if overlay == "resync":
-            self.matches += len(topics)
-            self.fallbacks += len(topics)
-            return [self.index.subscribers(t) for t in topics]
+            return self._trie_all(topics)
+        if self.emit_intents and overlay is None and self._state[7]:
+            chained = self._decode_intents(topics, out, hostrows, shards,
+                                           toks, lens_enc)
+            if chained is not None:
+                return chained
+        return self._decode_sets(topics, out, hostrows, shards, overlay)
+
+    def _decode_sets(self, topics, out, hostrows, shards, overlay):
+        """Per-topic python union across shards (the set form; also the
+        overlay-window path, which needs merge_delta's mutation)."""
+        from ..matching.sig import SigEngine
+
         removed = overlay.removed if overlay else None
         results = []
         for i, topic in enumerate(topics):
@@ -378,6 +519,43 @@ class ShardedSigEngine(OverlayedEngine):
                 SigEngine.decode_rows(topic, hostrows[s][i], tables,
                                       into=result, removed=removed)
             results.append(SigEngine.merge_delta(topic, result, overlay))
+        return results
+
+    def _decode_intents(self, topics, out, hostrows, shards, toks,
+                        lens_enc):
+        """Cluster-mode ADR 007: one native decode_batch_intents pass PER
+        SHARD (verify + union + row-set caching in C against that
+        shard's table), then chain the per-shard results per topic —
+        client-hash sharding guarantees disjointness. None when any
+        shard lacks the native extension (python set path serves)."""
+        from ..matching.sig import _compact_dtype, _native_decode
+
+        nds = [_native_decode(t) for t in shards]
+        if any(nd is None for nd in nds):
+            return None
+        batch = len(topics)
+        self.matches += batch
+        fall = (out[:, :, 0] == 0xF).any(axis=0)
+        max_rows = out.shape[2] - 1
+        col = np.arange(max_rows)
+        per_shard: list = []
+        toks = np.ascontiguousarray(toks)
+        lens_enc = np.ascontiguousarray(lens_enc)
+        for s, (tables, nd) in enumerate(zip(shards, nds)):
+            mod, cap = nd
+            ti, rw = _shard_pairs(out[s], hostrows[s], batch, col, fall)
+            _dt, pad = _compact_dtype(tables)
+            per_shard.append(mod.decode_batch_intents(
+                cap, toks, toks.dtype.itemsize, int(pad), lens_enc,
+                batch, ti, rw))
+        results: list = []
+        fall_list = fall.tolist()
+        for i, topic in enumerate(topics):
+            if fall_list[i]:
+                self.fallbacks += 1
+                results.append(self.index.subscribers(topic))
+            else:
+                results.append(ChainedIntents([ps[i] for ps in per_shard]))
         return results
 
     def subscribers(self, topic: str) -> SubscriberSet:
@@ -479,6 +657,24 @@ class ShardedNFAEngine:
             out_specs=(P("subs", "data", None), P("subs", "data")),
         )
         return jax.jit(fn)
+
+    def _compile_shards(self, version: int):
+        """Compile per-shard tables: client-hash first (chaining ok);
+        round-robin fallback when a heavy client overflows a bucket's
+        MAX_GROUPS (spreads shapes across shards, keeping the DEVICE
+        path alive at the cost of merge-free chaining); (None, None)
+        when even round-robin overflows."""
+        from ..matching.sig import MAX_GROUPS
+
+        subs = self.index.all_subscriptions()
+        shards = compile_sig_shards(subs, self.sp, version)
+        if all(len(t.groups) <= MAX_GROUPS for t in shards):
+            return shards, True
+        shards = compile_sig_shards(subs, self.sp, version,
+                                    by_client=False)
+        if all(len(t.groups) <= MAX_GROUPS for t in shards):
+            return shards, False
+        return None, None
 
     # ------------------------------------------------------------------
 

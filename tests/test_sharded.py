@@ -140,7 +140,7 @@ def test_sharded_sig_padding_words_cannot_fire():
     filters, _topics = random_corpus(60, 0, seed=3)
     index = build_index(filters)
     engine = ShardedSigEngine(index, mesh=make_mesh(shape=(1, 8)))
-    _v, shards, dev, fn, _d, _ue, _dp = engine._state
+    _v, shards, dev, fn, _d, _ue, _dp, _ck = engine._state
     assert fn is not None
     topo = np.asarray(dev[0])           # [sp, G, D] coefficients
     dc = np.asarray(dev[1])             # [sp, G] depth coefficients
@@ -284,3 +284,107 @@ async def test_cluster_broker_qos12_offline_redelivery():
         await s2.disconnect()
         await p.disconnect()
         await mb.close()
+
+
+@pytest.mark.parametrize("seed", [21, 22])
+def test_sharded_intents_parity(seed):
+    """Cluster-mode ADR 007: chained per-shard DeliveryIntents must
+    match the CPU trie exactly (client-hash sharding makes the chain
+    merge-free), including $share groups spanning shards and the
+    to_set()/has_client surface."""
+    from test_nfa_parity import normalize
+
+    from maxmq_tpu.native import decode_module
+    if decode_module() is None:
+        pytest.skip("maxmq_decode extension unavailable")
+    from maxmq_tpu.parallel.sharded import ChainedIntents, ShardedSigEngine
+
+    filters, topics = random_corpus(250, 120, seed)
+    idx = TopicIndex()
+    from maxmq_tpu.matching.topics import valid_filter
+    rng = random.Random(seed)
+    for i, f in enumerate(filters):
+        if not valid_filter(f):
+            continue
+        idx.subscribe(f"cl{i % 60}",
+                      Subscription(filter=f, qos=rng.randint(0, 2),
+                                   identifier=rng.randint(0, 3)))
+    eng = ShardedSigEngine(idx, mesh=make_mesh())
+    eng.emit_intents = True
+    got = eng.subscribers_batch(topics)
+    saw_chained = 0
+    for topic, r in zip(topics, got):
+        want = idx.subscribers(topic)
+        if isinstance(r, ChainedIntents):
+            saw_chained += 1
+            by_iter = {cid: sub for cid, sub in r}
+            assert len(by_iter) == r.n, f"client chained twice: {topic}"
+            assert set(by_iter) == set(want.subscriptions), topic
+            for cid in by_iter:
+                assert r.has_client(cid)
+            s = r.to_set()
+            assert normalize(s) == normalize(want), topic
+        else:
+            to_set = getattr(r, "to_set", None)
+            s = to_set() if to_set is not None else r
+            assert normalize(s) == normalize(want), topic
+    assert saw_chained, "chained intents path never engaged"
+
+
+async def test_sharded_intents_broker_delivery():
+    """The broker consumes ChainedIntents end-to-end (QoS1 + $share)."""
+    from test_broker_system import connect, running_broker
+
+    from maxmq_tpu.matching.batcher import MicroBatcher
+    from maxmq_tpu.parallel.sharded import ShardedSigEngine
+
+    async with running_broker() as broker:
+        eng = ShardedSigEngine(broker.topics, mesh=make_mesh())
+        eng.emit_intents = True
+        mb = MicroBatcher(eng, window_us=0, cpu_bypass=False)
+        broker.attach_matcher(mb)
+        s = await connect(broker, "ci-sub", version=5)
+        await s.subscribe(("ci/+/x", 1))
+        g1 = await connect(broker, "ci-g1")
+        await g1.subscribe(("$share/g/ci/sh", 0))
+        p = await connect(broker, "ci-pub")
+        await p.publish("ci/a/x", b"one", qos=1)
+        m = await s.next_message(timeout=60)
+        assert (m.topic, m.payload, m.qos) == ("ci/a/x", b"one", 1)
+        await p.publish("ci/sh", b"sh")
+        m = await g1.next_message(timeout=60)
+        assert m.payload == b"sh"
+        for c in (s, g1, p):
+            await c.disconnect()
+        await mb.close()
+
+
+
+
+def test_heavy_client_falls_back_to_round_robin(monkeypatch):
+    """One client whose wildcard shapes overflow a client-hash bucket's
+    MAX_GROUPS must not disable device matching: refresh re-partitions
+    round-robin (spreading the shapes) and turns chaining off, with
+    exact results either way."""
+    import maxmq_tpu.matching.sig as sigmod
+    from test_nfa_parity import normalize
+
+    from maxmq_tpu.parallel.sharded import ChainedIntents, ShardedSigEngine
+
+    monkeypatch.setattr(sigmod, "MAX_GROUPS", 4)
+    idx = TopicIndex()
+    # a bridge client with 8 distinct '#'-shapes (device groups; depth
+    # varies — trailing-'+' shapes would be host-probed, not grouped)
+    for d in range(2, 10):
+        idx.subscribe("bridge", Subscription(
+            filter="/".join(["alpha"] * d) + "/#", qos=1))
+    idx.subscribe("plain", Subscription(filter="alpha/beta", qos=0))
+    eng = ShardedSigEngine(idx, mesh=make_mesh(shape=(1, 8)))
+    eng.emit_intents = True
+    assert eng._state[3] is not None, "device path must stay alive"
+    assert eng._state[7] is False, "chaining must be off under round-robin"
+    topics = ["alpha/beta", "alpha/alpha/x", "alpha/alpha/alpha/y"]
+    got = eng.subscribers_batch(topics)
+    for t, r in zip(topics, got):
+        assert not isinstance(r, ChainedIntents)
+        assert normalize(r) == normalize(idx.subscribers(t)), t
