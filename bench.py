@@ -541,10 +541,12 @@ from maxmq_tpu.parallel.sharded import ShardedSigEngine, make_mesh
 filters, topic_gen = bench.build_corpus(%(subs)d, share_frac=0.1)
 index = bench.build_index(filters)
 engine = ShardedSigEngine(index, mesh=make_mesh(shape=(2, 4)))
+engine.emit_intents = True        # production cluster path (ADR 007)
 topics = topic_gen(%(batch)d, seed2=5)
 got = engine.subscribers_batch(topics[:64])          # warm + parity
 for t, s in zip(topics[:64], got):
     want = index.subscribers(t)
+    s = s.to_set() if hasattr(s, "to_set") else s
     assert set(s.subscriptions) == set(want.subscriptions), t
     assert set(s.shared) == set(want.shared), t
 t0 = time.perf_counter()
@@ -569,6 +571,7 @@ async def delivery_bench():
     await b.serve()
     port = lst._server.sockets[0].getsockname()[1]
     eng2 = ShardedSigEngine(b.topics, mesh=make_mesh(shape=(2, 4)))
+    eng2.emit_intents = True
     mb = MicroBatcher(eng2, window_us=200, cpu_bypass=False)
     b.attach_matcher(mb)
     n_subs_c, n_msgs = 8, 400
