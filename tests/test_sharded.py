@@ -388,3 +388,24 @@ def test_heavy_client_falls_back_to_round_robin(monkeypatch):
     for t, r in zip(topics, got):
         assert not isinstance(r, ChainedIntents)
         assert normalize(r) == normalize(idx.subscribers(t)), t
+
+
+def test_client_hash_empty_buckets_ok():
+    """Client-hash partitioning with fewer clients than shards leaves
+    empty buckets — matching and chaining must work regardless."""
+    from maxmq_tpu.native import decode_module
+    if decode_module() is None:
+        pytest.skip("maxmq_decode extension unavailable")
+    from maxmq_tpu.parallel.sharded import ShardedSigEngine
+
+    idx = TopicIndex()
+    idx.subscribe("only-a", Subscription(filter="eb/+/t", qos=1))
+    idx.subscribe("only-b", Subscription(filter="eb/#", qos=0))
+    eng = ShardedSigEngine(idx, mesh=make_mesh(shape=(1, 8)))
+    eng.emit_intents = True
+    got = eng.subscribers_batch(["eb/x/t", "eb/y", "zz"])
+    s0 = got[0].to_set() if hasattr(got[0], "to_set") else got[0]
+    assert set(s0.subscriptions) == {"only-a", "only-b"}
+    s1 = got[1].to_set() if hasattr(got[1], "to_set") else got[1]
+    assert set(s1.subscriptions) == {"only-b"}
+    assert len(got[2]) == 0
