@@ -586,22 +586,42 @@ struct DecodeTable {
   Py_ssize_t cache_pairs = 0;  // subscriber entries in the row-set cache
   Py_ssize_t frag_pairs = 0;   // subscriber entries in the fragment cache
   Py_ssize_t icache_pairs = 0;  // entries in the intents cache
+  // hits since the last clear, per result cache: a full cache that is
+  // EARNING hits clears and rebuilds (hot set shifted); a full cache on
+  // a unique-topic stream has nothing to rebuild FOR, so new entries
+  // are simply not admitted — wholesale clear+refill churn was slower
+  // than not caching at all (cold 1M stream measured 24K topics/s
+  // thrashing vs 42K without the churn)
+  Py_ssize_t cache_hits = 0;
+  Py_ssize_t icache_hits = 0;
+  Py_ssize_t cache_skips = 0;   // admissions refused since last clear
+  Py_ssize_t icache_skips = 0;
   std::vector<PyObject *> key, cid, sub;  // borrowed from the lists
   // intents union scratch: per-action interned client index + an
-  // epoch-stamped per-client slot map (no per-topic clearing). The
+  // epoch-stamped per-client slot map (no per-topic clearing). Epoch
+  // and slot PACK into one uint64 per client — at 1M clients the
+  // scratch lives in DRAM and the random per-action lookup is the
+  // cold-union wall, so one cache miss per action beats two. The
   // scratch is SINGLE-BUILDER: merge_subscription callbacks (and any
   // allocation-triggered GC) can release the GIL mid-build, letting a
   // second executor thread enter cached_intents_result on the same
   // table — scratch_busy hands that builder a local-map fallback so
   // the stamps cannot be corrupted into duplicate deliveries.
   std::vector<int32_t> act_cidx;  // [A]; -1 for shared actions
-  std::vector<int64_t> stamp;     // [n_clients] last epoch seen
-  std::vector<int32_t> slot;      // [n_clients] entry index this epoch
+  std::vector<uint64_t> mark;     // [n_clients] (epoch32 << 32) | slot
   int64_t epoch = 0;
   bool scratch_busy = false;
   PyObject *empty_intents = nullptr;  // shared zero-entry result
   Py_ssize_t R, W, A;
 };
+
+// A full cache whose entries earn no hits refuses new admissions (a
+// unique-topic stream would otherwise clear+refill wholesale — measured
+// SLOWER than not caching), but refusal is not forever: after
+// kAdmissionRetry refused misses the cache clears and rebuilds anyway,
+// so a hot set that shifted to uncached topics gets in within one
+// bounded window instead of being locked out.
+constexpr Py_ssize_t kAdmissionRetry = 65536;
 
 // Each cache (fragments, row-set unions) is bounded by the TOTAL
 // subscriber entries it physically holds (hot corpora cache few, fat
@@ -729,8 +749,7 @@ PyObject *table_new(PyObject *, PyObject *args) {
       }
     }
     Py_DECREF(interned);
-    t->stamp.assign(C, 0);
-    t->slot.resize(C);
+    t->mark.assign(C, 0);
   }
   return capsule;
 }
@@ -750,6 +769,8 @@ PyObject *table_release(PyObject *, PyObject *cap) {
   if (t->icache) PyDict_Clear(t->icache);
   Py_CLEAR(t->empty_intents);
   t->cache_pairs = t->frag_pairs = t->icache_pairs = 0;
+  t->cache_hits = t->icache_hits = 0;
+  t->cache_skips = t->icache_skips = 0;
   Py_RETURN_NONE;
 }
 
@@ -876,6 +897,8 @@ SubSetObject *fragment_for_row(DecodeTable *t, int32_t r) {
     PyDict_Clear(t->cache);
     t->frag_pairs = 0;
     t->cache_pairs = 0;
+    t->cache_hits = 0;
+    t->cache_skips = 0;
   }
   const int rc = PyDict_SetItem(t->frag, rk,
                                 reinterpret_cast<PyObject *>(res));
@@ -898,6 +921,7 @@ PyObject *cached_rowset_result(DecodeTable *t, const int32_t *rows,
   if (!key) return nullptr;
   PyObject *hit = PyDict_GetItemWithError(t->cache, key);
   if (hit) {
+    t->cache_hits++;
     Py_DECREF(key);
     return Py_NewRef(hit);
   }
@@ -979,8 +1003,14 @@ PyObject *cached_rowset_result(DecodeTable *t, const int32_t *rows,
   // real copied dict and is charged in full against the row-set budget
   const Py_ssize_t pairs = n_rows == 1 ? 0 : subset_pairs(res);
   if (t->cache_pairs + pairs > kDecodeCachePairsCap) {
+    if (t->cache_hits == 0 && ++t->cache_skips < kAdmissionRetry) {
+      Py_DECREF(key);              // cold stream: stop churning
+      return reinterpret_cast<PyObject *>(res);
+    }
     PyDict_Clear(t->cache);
     t->cache_pairs = 0;
+    t->cache_hits = 0;
+    t->cache_skips = 0;
   }
   int rc = PyDict_SetItem(t->cache, key, reinterpret_cast<PyObject *>(res));
   Py_DECREF(key);
@@ -1004,6 +1034,7 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
   if (!key) return nullptr;
   PyObject *hit = PyDict_GetItemWithError(t->icache, key);
   if (hit) {
+    t->icache_hits++;
     Py_DECREF(key);
     return Py_NewRef(hit);
   }
@@ -1041,17 +1072,31 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
   } guard(t);
   std::unordered_map<int32_t, Py_ssize_t> local_slot;
   const bool fast = guard.owned;
-  const int64_t e = fast ? ++t->epoch : 0;
+  uint32_t e32 = 0;
+  if (fast) {
+    ++t->epoch;
+    if ((t->epoch & 0xFFFFFFFFll) == 0) {
+      // epoch32 wrapped: a mark stamped exactly 2^32 unions ago would
+      // falsely read as current — clear and skip the zero epoch
+      std::fill(t->mark.begin(), t->mark.end(), 0);
+      ++t->epoch;
+    }
+    e32 = static_cast<uint32_t>(t->epoch & 0xFFFFFFFFll);
+  }
   auto slot_of = [&](int32_t c) -> Py_ssize_t {
-    if (fast)
-      return t->stamp[c] == e ? (Py_ssize_t)t->slot[c] : -1;
+    if (fast) {
+      const uint64_t m = t->mark[c];
+      return static_cast<uint32_t>(m >> 32) == e32
+                 ? (Py_ssize_t)(uint32_t)m
+                 : -1;
+    }
     auto f = local_slot.find(c);
     return f == local_slot.end() ? -1 : f->second;
   };
   auto record_slot = [&](int32_t c, Py_ssize_t j) {
     if (fast) {
-      t->stamp[c] = e;
-      t->slot[c] = static_cast<int32_t>(j);
+      t->mark[c] = (static_cast<uint64_t>(e32) << 32) |
+                   static_cast<uint32_t>(j);
     } else {
       local_slot[c] = j;
     }
@@ -1113,8 +1158,14 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
   }
   const Py_ssize_t charge = n + sh_pairs;
   if (t->icache_pairs + charge > kDecodeCachePairsCap) {
+    if (t->icache_hits == 0 && ++t->icache_skips < kAdmissionRetry) {
+      Py_DECREF(key);              // cold stream: stop churning
+      return reinterpret_cast<PyObject *>(it);
+    }
     PyDict_Clear(t->icache);
     t->icache_pairs = 0;
+    t->icache_hits = 0;
+    t->icache_skips = 0;
   }
   const int rc =
       PyDict_SetItem(t->icache, key, reinterpret_cast<PyObject *>(it));
