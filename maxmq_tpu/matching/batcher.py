@@ -85,7 +85,17 @@ class MicroBatcher:
         # first round trip must not poison the estimate).
         self._device_rtt: float | None = None
         self._rtt_samples = 0
+        # two host-serving cost models, each updated only from its own
+        # measured passes (one blended EWMA mispredicted both ways —
+        # batch-size mix made it flap): the trie walk is pure
+        # per-topic; the sig host path is fixed per-call (ctypes +
+        # numpy glue) plus a small per-topic term. Seeds are the
+        # 100K-sub measurements; both adapt.
         self._trie_cost = 100e-6          # seed: ~100us/topic
+        self._host_fixed = 90e-6          # seed: ~90us/call
+        self._host_per = 5e-6             # seed: ~5us/topic
+        self._trie_stale = 0              # host-served passes since the
+                                          # last trie cost sample
         self._since_probe = 0
         self._probe_task: asyncio.Task | None = None
         # stats (scraped by the metrics bridge)
@@ -250,31 +260,71 @@ class MicroBatcher:
 
     # -- adaptive CPU bypass -------------------------------------------
 
+    def _host_est(self, n: int) -> float:
+        """Predicted cost of serving ``n`` topics via the engine's
+        device-free sig path (fixed per-call + per-topic)."""
+        return self._host_fixed + n * self._host_per
+
+    def _bypass_cost(self, n: int) -> float:
+        """Cheapest host-serving cost for ``n`` topics — the same
+        min() _run_bypass takes, so prediction and execution agree."""
+        if getattr(self.engine, "subscribers_host_batch", None) is None:
+            return n * self._trie_cost
+        return min(n * self._trie_cost, self._host_est(n))
+
     def _should_bypass(self, n: int) -> bool:
-        """True when serving ``n`` topics from the CPU trie inline is
-        (measured-)cheaper than half a device round trip. RTT-estimate
-        refresh rides SHADOW probes (background duplicates of bypassed
-        batches), never the caller path — a p99 budget of 25ms cannot
-        absorb a periodic full round trip."""
+        """True when serving ``n`` topics inline on the host (trie or
+        sig host path, whichever is measured-cheaper) undercuts half a
+        device round trip. RTT-estimate refresh rides SHADOW probes
+        (background duplicates of bypassed batches), never the caller
+        path — a p99 budget of 25ms cannot absorb a periodic full
+        round trip."""
         if not self.cpu_bypass or n > self.BYPASS_CAP \
                 or self._device_rtt is None:
             return False
-        return n * self._trie_cost < 0.5 * self._device_rtt
+        return self._bypass_cost(n) < 0.5 * self._device_rtt
 
     def _run_bypass(self, batch, topics, ver) -> None:
-        """Serve one small batch from the CPU trie, inline on the loop
-        (bounded by BYPASS_CAP x trie cost), updating the trie-cost
-        estimate from the measured pass."""
+        """Serve one small batch on the host, inline on the loop
+        (bounded by BYPASS_CAP x per-topic cost), updating whichever
+        cost model served it. Engines exposing the device-free probe
+        path (subscribers_host_batch: exact/'+'/'#' signature probes +
+        the same C decode) serve from it when its fixed+per-topic
+        estimate undercuts the trie's per-topic one (tiny batches over
+        small corpora are the trie's remaining win); others always
+        walk the CPU trie."""
+        n = len(topics)
+        host = getattr(self.engine, "subscribers_host_batch", None)
+        if host is not None and n * self._trie_cost < self._host_est(n):
+            host = None
+        elif host is not None and n <= 8 and self._trie_stale >= 64:
+            host = None          # periodic trie sample: a winning host
+            self._trie_stale = 0  # path must not let the trie estimate
+                                  # go stale (it may have gotten cheaper)
         t0 = time.perf_counter()
         try:
-            results = [self.engine.index.subscribers(t) for t in topics]
+            results = (host(topics) if host is not None else
+                       [self.engine.index.subscribers(t) for t in topics])
         except Exception as exc:
             for _, fut in batch:
                 if not fut.done():
                     fut.set_exception(exc)
             return
-        per = (time.perf_counter() - t0) / max(1, len(topics))
-        self._trie_cost += 0.3 * (per - self._trie_cost)
+        took = time.perf_counter() - t0
+        if host is not None:
+            # decompose into the two-parameter model: big batches pin
+            # the per-topic slope, small ones the per-call intercept
+            if n >= 16:
+                self._host_per += 0.3 * (
+                    (took - self._host_fixed) / n - self._host_per)
+            else:
+                self._host_fixed += 0.3 * (
+                    max(took - n * self._host_per, 0.0)
+                    - self._host_fixed)
+            self._trie_stale += 1
+        else:
+            self._trie_cost += 0.3 * (took / max(1, n) - self._trie_cost)
+            self._trie_stale = 0
         self._since_probe += 1
         self.bypasses += len(topics)
         self._fill_cache(ver, batch, results)

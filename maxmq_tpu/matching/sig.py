@@ -149,6 +149,9 @@ class SigTables:
     host_exact: dict[int, HostExactGroup] = None   # depth -> group
     version: int = -1
     host_plus: dict = None    # depth -> HostPlusProbe ('+'-shape groups)
+    host_hash: dict = None    # depth -> HostPlusProbe over the DEVICE
+                              # '#'-groups (sorted views of the same
+                              # rows) — the device-free probe path
     probe_depth: int = 0      # deepest literal position ANY group reads
                               # (device or host_plus) = tokenizer window
 
@@ -249,6 +252,7 @@ def compile_sig_subscriptions(subs, version: int = 0,  # qa: complex
     row_entries: list[tuple[int, ...]] = []
     row_levels: list[tuple[str, ...] | None] = []
     sigs: list[np.ndarray] = []
+    hash_sig_list: list[tuple[GroupSpec, np.ndarray]] = []
     for gi, (g, rows) in enumerate(zip(groups, g_rows)):
         for c, pos in zip(g.coef, g.kept):
             topo_coef[gi, pos] = c
@@ -269,6 +273,7 @@ def compile_sig_subscriptions(subs, version: int = 0,  # qa: complex
         g.rows = list(range(len(row_entries) - len(rows),
                             len(row_entries)))
         s = g.signature(toks)
+        hash_sig_list.append((g, s))
         # padding rows get a poison signature: an all-zero pad sig would
         # match any topic whose (adjusted) signature is 0 and flood the
         # match stream; 0xFFFFFFFF collides only at the 2^-32 baseline rate
@@ -332,6 +337,33 @@ def compile_sig_subscriptions(subs, version: int = 0,  # qa: complex
         host_plus[d] = HostPlusProbe(depth=d, coef=coef, dc=dc, wildf=wildf,
                                      sigs=sig_arrs, rows=row_arrs)
 
+    # Sorted host views of the device '#'-groups (same rows, same
+    # signatures — just argsorted): the device-free probe path
+    # (host_hash_rows) used by the batcher's low-occupancy bypass, where
+    # a handful of binary searches beats a device round trip. dc=0
+    # (hash groups carry no depth term); applicability is depth >= d.
+    hash_by_depth: dict[int, list] = {}
+    for g, s in hash_sig_list:
+        hash_by_depth.setdefault(g.depth, []).append((g, s))
+    host_hash: dict[int, HostPlusProbe] = {}
+    for d, entries_d in hash_by_depth.items():
+        k_n = len(entries_d)
+        coef = np.zeros((k_n, max(d, 1)), dtype=np.uint32)
+        dc = np.zeros(k_n, dtype=np.uint32)
+        wildf = np.zeros(k_n, dtype=bool)
+        sig_arrs, row_arrs = [], []
+        for k, (g, s) in enumerate(entries_d):
+            for c, pos in zip(g.coef, g.kept):
+                coef[k, pos] = c
+            wildf[k] = g.wild_first
+            ids = np.asarray(g.rows, dtype=np.int32)
+            order = np.argsort(s, kind="stable")
+            sig_arrs.append(s[order])
+            row_arrs.append(ids[order])
+        host_hash[d] = HostPlusProbe(depth=d, coef=coef, dc=dc,
+                                     wildf=wildf, sigs=sig_arrs,
+                                     rows=row_arrs)
+
     # deep filters (beyond max_levels) only match topics the tokenizer
     # flags as overflow; they live in rows past the device region too so
     # decode can still resolve them after a CPU fallback
@@ -342,7 +374,7 @@ def compile_sig_subscriptions(subs, version: int = 0,  # qa: complex
         row_entries=row_entries, row_levels=row_levels,
         entries=builder.entries, vocab=vocab, n_rows=n_device_rows,
         max_depth=max_depth, host_exact=host_exact, version=version,
-        host_plus=host_plus,
+        host_plus=host_plus, host_hash=host_hash,
         # the tokenizer window must cover every literal position any
         # probe reads: device '#' prefixes, '+' shapes AND full-exact
         # depths (the unified native probe reads the narrow window)
@@ -428,24 +460,32 @@ _EMPTY_ROWS = np.zeros(0, dtype=np.int32)
 
 
 def host_plus_rows(tables: SigTables, toks: np.ndarray, lengths: np.ndarray,
-                   dollar: np.ndarray,
-                   into: list | None = None) -> list:
-    """Vectorized '+'-shape probe: for each topic, candidate rows among
-    the host-resident '+' groups of its depth (per group: one uint32
-    signature + one searchsorted; collisions verified in decode like
-    every other candidate). ``toks`` may be any integer dtype — unknown
-    -token padding just yields a non-matching signature, exactly as on
-    device. Appends into ``into`` (per-topic arrays) when given."""
+                   dollar: np.ndarray, into: list | None = None,
+                   ge: bool = False) -> list:
+    """Vectorized shape probe: for each topic, candidate rows by hashed
+    signature equality (per group: one uint32 signature + one
+    searchsorted; collisions verified in decode like every other
+    candidate). ``toks`` may be any integer dtype — unknown-token
+    padding just yields a non-matching signature, exactly as on device.
+    Appends into ``into`` (per-topic arrays) when given.
+
+    ``ge=False`` probes the host-resident '+'-shape groups
+    (tables.host_plus, applicability depth == d). ``ge=True`` probes
+    the '#'-groups instead (tables.host_hash, sorted host views of the
+    device rows): applicability becomes depth >= d — the trailing-'#'
+    rule incl. the depth-d parent match [MQTT-4.7.1.2] — and the dc
+    depth-term is zero by construction."""
     out: list = [_EMPTY_ROWS] * len(lengths) if into is None else into
     width = toks.shape[1]
     ti_parts: list[np.ndarray] = []
     row_parts: list[np.ndarray] = []
-    for d, p in (tables.host_plus or {}).items():
+    probes = tables.host_hash if ge else tables.host_plus
+    for d, p in (probes or {}).items():
         if d > width:
-            # deeper '+' groups only match topics the tokenizer flagged
+            # deeper shapes only match topics the tokenizer flagged
             # as overflow -> served by the CPU fallback
             continue
-        sel = np.nonzero(lengths == d)[0]
+        sel = np.nonzero(lengths >= d if ge else lengths == d)[0]
         if not sel.size:
             continue
         t = toks[sel, :max(d, 1)].astype(np.uint32)
@@ -472,6 +512,17 @@ def host_plus_rows(tables: SigTables, toks: np.ndarray, lengths: np.ndarray,
                 ti_parts.append(np.full(h - l0, sel[j], dtype=np.int64))
                 row_parts.append(rows_k[l0:h])
     return _scatter_hits(out, ti_parts, row_parts)
+
+
+def host_hash_rows(tables: SigTables, toks: np.ndarray,
+                   lengths: np.ndarray, dollar: np.ndarray,
+                   into: list | None = None) -> list:
+    """Host probe of the DEVICE '#'-groups: host_plus_rows in ge mode.
+    Completes the device-free match path — exact + '+' + '#' probes
+    together cover every group, so a batch too small to amortize a
+    device round trip never has to leave the host."""
+    return host_plus_rows(tables, toks, lengths, dollar, into=into,
+                          ge=True)
 
 
 def topic_signatures(consts, toks, lengths):
@@ -871,6 +922,25 @@ def _native_fused(tables):
         fused = None
     tables.__dict__["_native_fused"] = fused
     return fused
+
+
+def _native_hash_probe(tables):
+    """NativeProbe over the '#'-groups in depth->= mode (the C twin of
+    host_hash_rows), or None. Cached per compiled-table snapshot. Only
+    the device-free path runs it — the device still owns '#'-matching
+    for batched dispatches."""
+    probe = tables.__dict__.get("_native_hash_probe", False)
+    if probe is not False:
+        return probe
+    probe = None
+    try:
+        from ..native import NativeProbe, available
+        if available() and tables.host_hash is not None:
+            probe = NativeProbe({}, tables.host_hash, ge_depth=True)
+    except Exception:
+        probe = None
+    tables.__dict__["_native_hash_probe"] = probe
+    return probe
 
 
 def prepare_batch(tables, topics: list[str]):
@@ -1313,6 +1383,7 @@ class SigEngine(OverlayedEngine):
         self._refresh_lock = threading.Lock()
         self.fallbacks = 0
         self.matches = 0
+        self.host_matches = 0     # topics served by the device-free path
         # rows-count hint for the stream prefetch (see dispatch_fixed)
         self._stream_rows_hint = _STREAM_CHUNK
         self._init_overlay()
@@ -1717,6 +1788,50 @@ class SigEngine(OverlayedEngine):
             return self._resync_batch(topics)
         return self.collect_fixed(topics, ctx)
 
+    def subscribers_host_batch(self, topics: list[str]
+                               ) -> list[SubscriberSet]:
+        """Device-free full match: fused tokenize + exact/'+' probes,
+        the '#'-group host probe (host_hash_rows), then the same batch
+        verify + union decode — no dispatch, no device round trip.
+
+        Together the three probes cover every compiled group, so the
+        result is exactly subscribers_fixed_batch's (same caching, same
+        immutable-result contract) at a per-topic cost of a handful of
+        hashed binary searches — the batcher's low-occupancy bypass
+        serves from here instead of walking the CPU trie (~10x cheaper
+        at 100K subs). Overflow topics and router/declined corpora fall
+        back to the trie exactly like the device path."""
+        cpu = self._trie_batch(topics)
+        if cpu is not None:
+            return cpu
+        tables = self._state[0]
+        batch = len(topics)
+        toks, lens_enc, hostrows = prepare_batch(tables, topics)
+        lengths = np.abs(lens_enc.astype(np.int32))
+        fall = lengths >= 127
+        # overflow topics are served by the trie fallback pass and
+        # counted under fallbacks — not host matches
+        self.host_matches += batch - int(fall.sum())
+        # the '#' hits ride _pairs_with_host's device-pair slot
+        # (hostrows may be the fused path's CSR, which _scatter_hits
+        # cannot append into). The C probe keeps the per-call cost in
+        # the microseconds — small batches are the whole point here —
+        # with host_hash_rows as the numpy fallback.
+        hp = _native_hash_probe(tables)
+        if hp is not None:
+            ti_h, rw_h = hp.run(np.ascontiguousarray(toks), lens_enc)
+            rw_h = rw_h.astype(np.int64)
+        else:
+            hh = host_hash_rows(tables, toks, lengths, lens_enc < 0)
+            ti_h = np.repeat(np.arange(batch), [len(h) for h in hh])
+            rw_h = (np.concatenate([np.asarray(h) for h in hh])
+                    .astype(np.int64) if len(ti_h)
+                    else np.empty(0, dtype=np.int64))
+        ti, rw = _pairs_with_host(batch, ti_h, rw_h, hostrows,
+                                  fall, tables)
+        return self.decode_pairs(topics, fall, ti, rw, tables, toks,
+                                 lens_enc)
+
     def collect_fixed(self, topics: list[str], ctx) -> list[SubscriberSet]:
         """Decode half of the fixed-slot path: fetch + batch-verify +
         entry union for a previously dispatched batch. The stream wire
@@ -1919,8 +2034,19 @@ class SigEngine(OverlayedEngine):
                 out.append(self.merge_delta(topic, result, overlay))
         return out
 
+    # Below this corpus size a SINGLE topic's trie walk undercuts the
+    # host path's ~90us fixed per-call cost (ctypes + numpy glue);
+    # trie cost grows with the corpus, the fixed cost does not, so past
+    # it the host path wins even for one topic (~10x at 1M subs).
+    HOST_SINGLE_SUBS_MIN = 250_000
+
     def subscribers(self, topic: str) -> SubscriberSet:
-        return self.subscribers_batch([topic])[0]
+        # single-topic surface: never the device (one topic cannot
+        # amortize a round trip) — trie or host path by corpus size
+        if self.index.subscription_count < self.HOST_SINGLE_SUBS_MIN:
+            self.matches += 1
+            return self.index.subscribers(topic)
+        return self.subscribers_host_batch([topic])[0]
 
     async def subscribers_async(self, topic: str) -> SubscriberSet:
         import asyncio

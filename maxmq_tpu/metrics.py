@@ -274,14 +274,20 @@ def register_broker_metrics(registry: Registry, broker) -> None:
         if hasattr(matcher, "bypasses"):
             registry.counter_func(
                 "maxmq_matcher_bypassed_topics_total",
-                "Topics served inline from the CPU trie by the "
-                "adaptive bypass (ADR 008)",
+                "Topics served inline on the host by the adaptive "
+                "bypass (ADR 008)",
                 lambda: matcher.bypasses)
             registry.gauge_func(
                 "maxmq_matcher_device_rtt_seconds",
                 "Measured device round-trip EWMA driving the bypass",
                 lambda: matcher.device_rtt)
         eng = getattr(matcher, "engine", matcher)
+        if hasattr(eng, "host_matches"):
+            registry.counter_func(
+                "maxmq_matcher_host_matches_total",
+                "Topics matched by the device-free host sig path "
+                "(bypass + single-topic surface, ADR 008)",
+                lambda: eng.host_matches)
         if hasattr(eng, "trie_routed"):
             registry.counter_func(
                 "maxmq_matcher_trie_routed_total",

@@ -100,6 +100,7 @@ def _try_load():
             np.ctypeslib.ndpointer(np.int32), ctypes.c_int64,
             ctypes.c_int32]
         lib.mq_probe_run.restype = ctypes.c_int64
+        lib.mq_probe_set_ge.argtypes = [ctypes.c_void_p]
         lib.mq_tokenize_probe.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
@@ -239,7 +240,8 @@ class NativeProbe:
     of the topic's depth), threaded over topic ranges. Built once per
     compiled-table snapshot from tables.host_exact / tables.host_plus."""
 
-    def __init__(self, host_exact: dict, host_plus: dict) -> None:
+    def __init__(self, host_exact: dict, host_plus: dict,
+                 ge_depth: bool = False) -> None:
         lib = _try_load()
         if lib is None:
             raise RuntimeError("native library unavailable")
@@ -263,6 +265,11 @@ class NativeProbe:
                     np.ascontiguousarray(p.sigs[k], dtype=np.uint32),
                     np.ascontiguousarray(p.rows[k], dtype=np.int32),
                     len(p.sigs[k]))
+        if ge_depth:
+            # '#'-prefix semantics: groups apply to topics of depth >=
+            # their prefix depth (pass tables.host_hash as host_plus —
+            # same probe layout, dc=0). Must follow every add_group.
+            lib.mq_probe_set_ge(self._handle)
 
     def __del__(self):
         handle, self._handle = getattr(self, "_handle", None), None

@@ -156,6 +156,12 @@ struct ProbeGroup {
 struct ProbeSet {
   std::vector<ProbeGroup> groups;
   std::vector<std::vector<int32_t>> by_depth;  // depth -> group indices
+  // '#'-prefix mode (mq_probe_set_ge): a group applies to any topic of
+  // depth >= its prefix depth (the trailing-'#' rule incl. the depth-d
+  // parent match), not just == — groups iterate depth-ascending with an
+  // early break instead of through by_depth
+  bool ge_depth = false;
+  std::vector<int32_t> ge_sorted;              // group ids by depth asc
 };
 
 inline uint32_t tok_at(const void* toks, int32_t mode, int64_t idx) {
@@ -378,6 +384,20 @@ void mq_probe_add_group(void* h, int32_t depth, uint8_t wildf, uint32_t dc,
   set->groups.push_back(std::move(g));
 }
 
+// Flip the set to '#'-prefix (depth >=) semantics. Call AFTER every
+// add_group: the depth-ascending iteration order is frozen here.
+void mq_probe_set_ge(void* h) {
+  auto* set = static_cast<ProbeSet*>(h);
+  set->ge_depth = true;
+  set->ge_sorted.resize(set->groups.size());
+  for (size_t i = 0; i < set->groups.size(); ++i)
+    set->ge_sorted[i] = static_cast<int32_t>(i);
+  std::sort(set->ge_sorted.begin(), set->ge_sorted.end(),
+            [set](int32_t a, int32_t b) {
+              return set->groups[a].depth < set->groups[b].depth;
+            });
+}
+
 // Probe n topics (narrow tokens as in mq_tokenize_sig: tok_mode 1/2/4,
 // row-major [n, window]; lens_enc int8 sign='$' |v|=depth, 127=overflow).
 // Emits (topic id, row id) hit pairs in topic order. Returns the total
@@ -406,11 +426,16 @@ int64_t mq_probe_run(void* h, const void* toks, int32_t tok_mode,
       const int8_t le = lens_enc[i];
       const bool dollar = le < 0;
       const int32_t depth = le < 0 ? -le : le;
-      if (depth >= 127 ||
-          static_cast<size_t>(depth) >= set->by_depth.size())
+      if (depth >= 127)
         continue;  // overflow topics go to the CPU-trie fallback
-      for (const int32_t gi : set->by_depth[depth]) {
+      if (!set->ge_depth &&
+          static_cast<size_t>(depth) >= set->by_depth.size())
+        continue;
+      const auto& gids =
+          set->ge_depth ? set->ge_sorted : set->by_depth[depth];
+      for (const int32_t gi : gids) {
         const ProbeGroup& g = set->groups[gi];
+        if (set->ge_depth && g.depth > depth) break;  // depth-ascending
         if ((g.wildf && dollar) || g.depth > window) continue;
         uint32_t sig = g.dc;
         const int64_t base = i * window;

@@ -266,8 +266,11 @@ async def test_cluster_broker_qos12_offline_redelivery():
         assert [g.payload for g in got] == [b"m0", b"m1", b"m2"]
         assert all(g.qos == 2 for g in got)
 
-        # the sharded engine answered the matches (not a trie fallback)
-        assert eng.matches >= 4
+        # the sharded engine answered the matches (not a trie fallback).
+        # Only DISTINCT topics are guaranteed to reach the engine — the
+        # batcher's version-keyed cache may serve repeats (that's its
+        # job), so the floor is 2 (cs/q/a, cs/e/t), not one per publish.
+        assert eng.matches >= 2
         fallback_frac = eng.fallbacks / max(eng.matches, 1)
         assert fallback_frac < 0.5, (eng.fallbacks, eng.matches)
 

@@ -735,3 +735,79 @@ def test_table_release_breaks_cycle_on_rotation():
     for _ in range(3):
         gc.collect()
     assert tref() is None, "old snapshot still alive after rotation"
+
+
+# ---------------------------------------------------------------------------
+# Device-free host match (subscribers_host_batch): the batcher's
+# low-occupancy bypass path — exact/'+'/'#' signature probes + the same
+# C decode, no device dispatch at all.
+
+
+@pytest.mark.parametrize("seed", [31, 32, 33])
+def test_host_batch_parity_randomized(seed):
+    """The host-only path (host_hash_rows completing the probe set)
+    matches the trie exactly, in both result forms."""
+    rng = random.Random(seed)
+    idx = TopicIndex()
+    filters, topics = rand_corpus(rng, n_filters=150, n_clients=40)
+    from maxmq_tpu.matching.topics import valid_filter
+    for i, f in enumerate(filters):
+        if not valid_filter(f):
+            continue
+        idx.subscribe(f"c{i % 40}",
+                      Subscription(filter=f, qos=rng.randint(0, 2),
+                                   identifier=rng.randint(0, 5)))
+    for emit in (False, True):
+        eng = SigEngine(idx)
+        eng.emit_intents = emit
+        got = eng.subscribers_host_batch(topics)
+        for topic, result in zip(topics, got):
+            want = idx.subscribers(topic)
+            assert normalize(_as_set(result)) == normalize(want), \
+                (topic, emit)
+        assert eng.host_matches == len(topics)
+
+
+def test_host_batch_never_touches_device(monkeypatch):
+    """The host path must stay correct with the device program broken —
+    that independence is exactly what the bypass relies on when the
+    link is degraded."""
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="h/b/c", qos=1))
+    idx.subscribe("c2", Subscription(filter="h/+/c", qos=2))
+    idx.subscribe("c3", Subscription(filter="h/#"))
+    idx.subscribe("c4", Subscription(filter="#"))
+    idx.subscribe("c5", Subscription(filter="$share/g/h/#"))
+    eng = SigEngine(idx)
+    eng.refresh(force=True)
+
+    def boom(*a, **k):
+        raise AssertionError("device program invoked on the host path")
+
+    monkeypatch.setattr(eng, "dispatch_fixed", boom)
+    state = list(eng._state)
+    state[6] = boom                      # the jitted fixed program
+    eng._state = tuple(state)
+    topics = ["h/b/c", "h/x/c", "h", "h/deep/er/still", "x", "$SYS/x"]
+    got = eng.subscribers_host_batch(topics)
+    for topic, result in zip(topics, got):
+        assert normalize(_as_set(result)) == \
+            normalize(idx.subscribers(topic)), topic
+
+
+def test_single_topic_surface_serves_from_host():
+    """engine.subscribers() never touches the device: trie below the
+    measured corpus crossover, the device-free host path above it."""
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="s/+/t", qos=1))
+    eng = SigEngine(idx)
+    eng.route_small = False
+    # small corpus: trie (its walk undercuts the host call's fixed cost)
+    got = eng.subscribers(topic="s/x/t")
+    assert "c1" in _as_set(got).subscriptions
+    assert eng.host_matches == 0
+    # past the crossover: the host path
+    eng.HOST_SINGLE_SUBS_MIN = 0
+    got = eng.subscribers(topic="s/x/t")
+    assert "c1" in _as_set(got).subscriptions
+    assert eng.host_matches == 1
