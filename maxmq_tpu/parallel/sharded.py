@@ -353,6 +353,7 @@ class ShardedSigEngine(OverlayedEngine):
         self._refresh_lock = threading.Lock()
         self.matches = 0
         self.fallbacks = 0
+        self.host_matches = 0     # topics served by the device-free path
         # cluster-mode ADR 007: per-shard native DeliveryIntents chained
         # per topic (client-hash sharding makes chaining merge-free)
         self.emit_intents = False
@@ -557,6 +558,65 @@ class ShardedSigEngine(OverlayedEngine):
             else:
                 results.append(ChainedIntents([ps[i] for ps in per_shard]))
         return results
+
+    def subscribers_host_batch(self, topics: list[str]
+                               ) -> list[SubscriberSet]:
+        """Cluster-mode device-free match: one tokenize pass (shared
+        intern pool), per-shard exact/'+'/'#' host probes, then the
+        same per-shard native decode + merge-free chaining the device
+        path uses — no mesh dispatch at all. Serves the batcher's
+        low-occupancy bypass when a sharded engine backs the broker,
+        exactly like SigEngine.subscribers_host_batch single-node."""
+        from ..matching.sig import (_native_hash_probe, _scatter_hits,
+                                    host_exact_rows_from_sig,
+                                    host_hash_rows, host_plus_rows,
+                                    prepare_batch_sig)
+
+        self.refresh_soon()
+        state = self._state
+        (_version, shards, _dev, fn, d_max, union_exact, _dp,
+         _chain_ok) = state
+        if fn is None:                  # pathological corpus: CPU trie
+            return self._trie_all(topics)
+        batch = len(topics)
+        toks, lens_enc, esig, lengths = prepare_batch_sig(
+            shards[0], topics, window=max(d_max, 1),
+            host_exact=union_exact)
+        dollar = lens_enc < 0
+        over = lengths < 0    # prepare_batch_sig reports overflow as -1
+        toks_c = np.ascontiguousarray(toks)
+        hostrows = []
+        for t in shards:
+            hr = host_exact_rows_from_sig(t, esig, lengths)
+            host_plus_rows(t, toks, lengths, dollar, into=hr)
+            # '#'-probe: the cached C ge-depth probe when built (small
+            # batches are this path's whole point), numpy twin otherwise
+            hp = _native_hash_probe(t)
+            if hp is not None:
+                ti_h, rw_h = hp.run(toks_c, lens_enc)
+                if len(ti_h):
+                    _scatter_hits(hr, [ti_h], [rw_h.astype(np.int64)])
+            else:
+                host_hash_rows(t, toks, lengths, dollar, into=hr)
+            hostrows.append(hr)
+        # synthesized zero-count device matrix: every candidate rides
+        # the host-rows slot; overflow topics get the 0xF marker so
+        # the shared decode paths serve them from the trie
+        out = np.zeros((len(shards), batch, 1 + self.max_rows),
+                       dtype=np.uint32)
+        out[:, over, 0] = 0xF
+        overlay = self.overlay_for(shards[0].version)
+        if overlay == "resync":
+            return self._trie_all(topics)
+        # fallback-served topics (overflow now, resync above) are
+        # counted under matches/fallbacks, not host matches
+        self.host_matches += batch - int(over.sum())
+        if self.emit_intents and overlay is None and state[7]:
+            chained = self._decode_intents(topics, out, hostrows,
+                                           shards, toks, lens_enc)
+            if chained is not None:
+                return chained
+        return self._decode_sets(topics, out, hostrows, shards, overlay)
 
     def subscribers(self, topic: str) -> SubscriberSet:
         return self.subscribers_batch([topic])[0]

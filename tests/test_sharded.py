@@ -412,3 +412,54 @@ def test_client_hash_empty_buckets_ok():
     s1 = got[1].to_set() if hasattr(got[1], "to_set") else got[1]
     assert set(s1.subscriptions) == {"only-b"}
     assert len(got[2]) == 0
+
+
+@pytest.mark.parametrize("seed", [21, 22])
+def test_sharded_host_batch_parity(seed):
+    """Cluster-mode device-free path (subscribers_host_batch: per-shard
+    exact/'+'/'#' host probes + chained native decode, no mesh
+    dispatch) matches the CPU trie exactly in both result forms."""
+    from test_nfa_parity import normalize
+
+    from maxmq_tpu.parallel.sharded import ShardedSigEngine
+
+    filters, topics = random_corpus(250, 120, seed)
+    idx = TopicIndex()
+    from maxmq_tpu.matching.topics import valid_filter
+    rng = random.Random(seed)
+    for i, f in enumerate(filters):
+        if not valid_filter(f):
+            continue
+        idx.subscribe(f"cl{i % 60}",
+                      Subscription(filter=f, qos=rng.randint(0, 2),
+                                   identifier=rng.randint(0, 3)))
+    eng = ShardedSigEngine(idx, mesh=make_mesh())
+    for emit in (False, True):
+        eng.emit_intents = emit
+        got = eng.subscribers_host_batch(topics)
+        for topic, r in zip(topics, got):
+            want = idx.subscribers(topic)
+            to_set = getattr(r, "to_set", None)
+            s = to_set() if to_set is not None else r
+            assert normalize(s) == normalize(want), (topic, emit)
+    assert eng.host_matches == 2 * len(topics)
+
+
+def test_sharded_host_batch_overflow_topic_falls_back():
+    """Regression: prepare_batch_sig reports too-deep topics as
+    lengths == -1 (not >= 127) — the host path must still serve them
+    from the trie, exactly like the device path's 0xF marker."""
+    idx = TopicIndex()
+    idx.subscribe("deepwatch", Subscription(filter="#", qos=1))
+    idx.subscribe("plain", Subscription(filter="alpha/beta", qos=0))
+    eng = ShardedSigEngine(idx, mesh=make_mesh())
+    deep = "/".join(["alpha"] * 80)          # > DEPTH_CAP
+    for emit in (False, True):
+        eng.emit_intents = emit
+        before = eng.host_matches
+        got = eng.subscribers_host_batch([deep, "alpha/beta"])
+        to_set = getattr(got[0], "to_set", None)
+        s0 = to_set() if to_set is not None else got[0]
+        assert "deepwatch" in s0.subscriptions, "overflow topic lost"
+        # the overflow topic was trie-served, not a host match
+        assert eng.host_matches == before + 1
