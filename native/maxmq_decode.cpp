@@ -335,17 +335,40 @@ PyType_Spec subset_spec = {
 // SubscriberSet lazily for the hook path (on_select_subscribers) and
 // caches it — intents are cached per row-set and shared across
 // topics, so consumers treat them as immutable, like cached sets.
+//
+// CHAINED form (the cold-stream wall killer): on fan-out-heavy corpora
+// one shallow-'#' row carries hundreds of entries and the other rows a
+// handful, and a cold unique-topic stream makes every row SET distinct
+// — so the per-topic union re-copied those hundreds of pairs through
+// the DRAM-latency-bound mark table every single topic (measured
+// ~43ns/pair, 14us/topic at 1M subs). A chained intents instead holds
+// a strong ref to the fat row's SINGLE-ROW cached intents (immutable,
+// built once per table rotation) plus only the thin per-topic tail,
+// with same-client collisions against the base expressed as slot
+// OVERRIDES applied during iteration. Construction cost per topic
+// drops from O(total pairs) to O(tail pairs); iteration still yields
+// exactly the merged (client, Subscription) stream.
 
 struct IntentsObject {
   PyObject_HEAD
   PyObject *table_cap;  // strong ref: keeps borrowed cid/sub ptrs alive
-  Py_ssize_t n;         // plain (non-shared) delivery entries
+  Py_ssize_t n;         // OWN plain (non-shared) delivery entries
   PyObject **cids;      // [n] borrowed from the table's cid list
   PyObject **subs;      // [n] borrowed, or owned when owned[i]
   uint8_t *owned;       // [n] subs[i] is an owned merged Subscription
   PyObject *shared;     // (group, filter) -> {cid: sub}, or NULL
   PyObject *set_cache;  // lazily-built SubscriberSet twin
+  // chain: own entries are the tail; base holds the fat row's pairs
+  IntentsObject *base;  // strong; single-row intents (never chained)
+  int32_t *ovr_slots;   // [n_ovr] base slots shadowed, ascending
+  PyObject **ovr_subs;  // [n_ovr] owned merged Subscriptions
+  Py_ssize_t n_ovr;
 };
+
+// total plain entries a consumer sees (tail + base; overrides shadow)
+static inline Py_ssize_t intents_total(const IntentsObject *self) {
+  return self->n + (self->base ? self->base->n : 0);
+}
 
 PyTypeObject *g_intents_type = nullptr;
 PyTypeObject *g_intents_iter_type = nullptr;
@@ -360,6 +383,10 @@ IntentsObject *intents_alloc(PyObject *capsule, Py_ssize_t capacity) {
   self->owned = nullptr;
   self->shared = nullptr;
   self->set_cache = nullptr;
+  self->base = nullptr;
+  self->ovr_slots = nullptr;
+  self->ovr_subs = nullptr;
+  self->n_ovr = 0;
   if (capacity) {
     self->cids = static_cast<PyObject **>(
         PyMem_Malloc(capacity * sizeof(PyObject *)));
@@ -382,8 +409,11 @@ int intents_traverse(PyObject *self_o, visitproc visit, void *arg) {
   Py_VISIT(self->table_cap);
   Py_VISIT(self->shared);
   Py_VISIT(self->set_cache);
+  Py_VISIT(reinterpret_cast<PyObject *>(self->base));
   for (Py_ssize_t i = 0; i < self->n; i++)
     if (self->owned && self->owned[i]) Py_VISIT(self->subs[i]);
+  for (Py_ssize_t i = 0; i < self->n_ovr; i++)
+    Py_VISIT(self->ovr_subs[i]);
   return 0;
 }
 
@@ -398,6 +428,14 @@ int intents_clear_slot(PyObject *self_o) {
   PyMem_Free(self->owned);
   self->cids = self->subs = nullptr;
   self->owned = nullptr;
+  for (Py_ssize_t i = 0; i < self->n_ovr; i++)
+    Py_CLEAR(self->ovr_subs[i]);
+  self->n_ovr = 0;
+  PyMem_Free(self->ovr_slots);
+  PyMem_Free(self->ovr_subs);
+  self->ovr_slots = nullptr;
+  self->ovr_subs = nullptr;
+  Py_CLEAR(self->base);
   Py_CLEAR(self->table_cap);
   Py_CLEAR(self->shared);
   Py_CLEAR(self->set_cache);
@@ -414,7 +452,7 @@ void intents_dealloc(PyObject *self_o) {
 
 Py_ssize_t intents_len(PyObject *self_o) {
   auto *self = reinterpret_cast<IntentsObject *>(self_o);
-  Py_ssize_t n = self->n;
+  Py_ssize_t n = intents_total(self);
   if (self->shared) {
     PyObject *k, *v;
     Py_ssize_t pos = 0;
@@ -429,6 +467,21 @@ PyObject *intents_to_set(PyObject *self_o, PyObject *) {
   if (self->set_cache) return Py_NewRef(self->set_cache);
   PyObject *subs = PyDict_New();
   if (!subs) return nullptr;
+  if (self->base) {
+    // base entries first (overrides and tail shadow them below)
+    const IntentsObject *b = self->base;
+    for (Py_ssize_t j = 0; j < b->n; j++)
+      if (PyDict_SetItem(subs, b->cids[j], b->subs[j]) < 0) {
+        Py_DECREF(subs);
+        return nullptr;
+      }
+    for (Py_ssize_t k = 0; k < self->n_ovr; k++)
+      if (PyDict_SetItem(subs, b->cids[self->ovr_slots[k]],
+                         self->ovr_subs[k]) < 0) {
+        Py_DECREF(subs);
+        return nullptr;
+      }
+  }
   for (Py_ssize_t i = 0; i < self->n; i++)
     if (PyDict_SetItem(subs, self->cids[i], self->subs[i]) < 0) {
       Py_DECREF(subs);
@@ -454,11 +507,15 @@ PyObject *intents_to_set(PyObject *self_o, PyObject *) {
 // overlap check, on sets of a few hundred entries at most)
 PyObject *intents_has_client(PyObject *self_o, PyObject *cid) {
   auto *self = reinterpret_cast<IntentsObject *>(self_o);
-  for (Py_ssize_t i = 0; i < self->n; i++) {
-    if (self->cids[i] == cid) Py_RETURN_TRUE;
-    const int eq = PyObject_RichCompareBool(self->cids[i], cid, Py_EQ);
-    if (eq < 0) return nullptr;
-    if (eq) Py_RETURN_TRUE;
+  for (const IntentsObject *part = self; part;
+       part = (part == self ? self->base : nullptr)) {
+    for (Py_ssize_t i = 0; i < part->n; i++) {
+      if (part->cids[i] == cid) Py_RETURN_TRUE;
+      const int eq =
+          PyObject_RichCompareBool(part->cids[i], cid, Py_EQ);
+      if (eq < 0) return nullptr;
+      if (eq) Py_RETURN_TRUE;
+    }
   }
   Py_RETURN_FALSE;
 }
@@ -474,13 +531,19 @@ PyObject *intents_get_shared(PyObject *self_o, void *) {
 
 PyObject *intents_get_n(PyObject *self_o, void *) {
   return PyLong_FromSsize_t(
-      reinterpret_cast<IntentsObject *>(self_o)->n);
+      intents_total(reinterpret_cast<IntentsObject *>(self_o)));
+}
+
+PyObject *intents_get_chained(PyObject *self_o, void *) {
+  return PyBool_FromLong(
+      reinterpret_cast<IntentsObject *>(self_o)->base != nullptr);
 }
 
 struct IntentsIterObject {
   PyObject_HEAD
   IntentsObject *it;  // strong
   Py_ssize_t i;
+  Py_ssize_t oi;  // cursor into ovr_slots (ascending, so O(1) amort.)
 };
 
 PyObject *intents_iter(PyObject *self_o) {
@@ -488,15 +551,29 @@ PyObject *intents_iter(PyObject *self_o) {
   if (!iter) return nullptr;
   iter->it = reinterpret_cast<IntentsObject *>(Py_NewRef(self_o));
   iter->i = 0;
+  iter->oi = 0;
   PyObject_GC_Track(iter);
   return reinterpret_cast<PyObject *>(iter);
 }
 
 PyObject *intents_iternext(PyObject *self_o) {
   auto *self = reinterpret_cast<IntentsIterObject *>(self_o);
-  if (self->i >= self->it->n) return nullptr;  // StopIteration
-  const Py_ssize_t i = self->i++;
-  return PyTuple_Pack(2, self->it->cids[i], self->it->subs[i]);
+  IntentsObject *v = self->it;
+  const Py_ssize_t i = self->i;
+  if (i < v->n) {
+    self->i++;
+    return PyTuple_Pack(2, v->cids[i], v->subs[i]);
+  }
+  const IntentsObject *b = v->base;
+  if (!b) return nullptr;  // StopIteration
+  const Py_ssize_t j = i - v->n;
+  if (j >= b->n) return nullptr;
+  self->i++;
+  while (self->oi < v->n_ovr && v->ovr_slots[self->oi] < j) self->oi++;
+  PyObject *sub = (self->oi < v->n_ovr && v->ovr_slots[self->oi] == j)
+                      ? v->ovr_subs[self->oi]
+                      : b->subs[j];
+  return PyTuple_Pack(2, b->cids[j], sub);
 }
 
 int intents_iter_traverse(PyObject *self_o, visitproc visit, void *arg) {
@@ -514,6 +591,11 @@ void intents_iter_dealloc(PyObject *self_o) {
 
 PyObject *intents_repr(PyObject *self_o) {
   auto *self = reinterpret_cast<IntentsObject *>(self_o);
+  if (self->base)
+    return PyUnicode_FromFormat(
+        "DeliveryIntents(n=%zd, tail=%zd, overrides=%zd, shared=%zd)",
+        intents_total(self), self->n, self->n_ovr,
+        self->shared ? PyDict_Size(self->shared) : (Py_ssize_t)0);
   return PyUnicode_FromFormat(
       "DeliveryIntents(n=%zd, shared=%zd)", self->n,
       self->shared ? PyDict_Size(self->shared) : (Py_ssize_t)0);
@@ -530,6 +612,8 @@ PyGetSetDef intents_getset[] = {
     {"shared", intents_get_shared, nullptr,
      "(group, filter) -> {client_id: Subscription} candidates", nullptr},
     {"n", intents_get_n, nullptr, "plain delivery entry count", nullptr},
+    {"chained", intents_get_chained, nullptr,
+     "True when anchored on a cached fat-row base fragment", nullptr},
     {nullptr, nullptr, nullptr, nullptr, nullptr}};
 
 PyType_Slot intents_slots[] = {
@@ -571,6 +655,18 @@ PyObject *configure(PyObject *, PyObject *args) {
   if (!PyArg_ParseTuple(args, "OO", &merge, &copy)) return nullptr;
   Py_XSETREF(g_merge_fn, Py_NewRef(merge));
   Py_XSETREF(g_copy_sub, Py_NewRef(copy));
+  Py_RETURN_NONE;
+}
+
+// the chained union must be indistinguishable from the full union —
+// this test-only switch lets the suite A/B the two builds of the SAME
+// row set (flags included, not just the normalize() projection)
+bool g_chain_enabled = true;
+
+PyObject *set_chain_enabled(PyObject *, PyObject *arg) {
+  const int v = PyObject_IsTrue(arg);
+  if (v < 0) return nullptr;
+  g_chain_enabled = v != 0;
   Py_RETURN_NONE;
 }
 
@@ -631,6 +727,24 @@ struct DecodeTable {
   std::vector<PyObject *> rshared;  // [R]; nullptr until first touch
   std::vector<int32_t> shcount;     // [R] shared pairs in row's stream
   PyObject *empty_intents = nullptr;  // shared zero-entry result
+  // chained-intents base support: per fat row, client index ->
+  // (slot in the row's single-row intents, index of the row's action
+  // for that client). The slot addresses iteration overrides; the
+  // action index lets an override replay the base contribution through
+  // merge_subscription exactly where the ascending-row-order union
+  // would have applied it. Built lazily the first time a row anchors a
+  // chain; rows that qualify are the few hundred-entry shallow-'#'
+  // buckets, so the maps are small and live as long as the table (and
+  // are dropped by table_release on rotation). slot_entries caps total
+  // memory against pathological corpora (past it, new rows fall back
+  // to the full union — correctness is unaffected).
+  struct BaseSlot {
+    int32_t slot;
+    int64_t act;
+  };
+  std::unordered_map<int32_t, std::unordered_map<int32_t, BaseSlot>>
+      row_slot;
+  Py_ssize_t slot_entries = 0;
   Py_ssize_t R, W, A;
 };
 
@@ -804,6 +918,8 @@ PyObject *table_release(PyObject *, PyObject *cap) {
   t->cache_pairs = t->frag_pairs = t->icache_pairs = 0;
   t->cache_hits = t->icache_hits = 0;
   t->cache_skips = t->icache_skips = 0;
+  t->row_slot.clear();
+  t->slot_entries = 0;
   Py_RETURN_NONE;
 }
 
@@ -1124,12 +1240,97 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
     total += off[rows[i] + 1] - off[rows[i]];
     sh_pairs += t->shcount[rows[i]];
   }
-  IntentsObject *it = intents_alloc(cap, total - sh_pairs);
+  // chain decision: one fat row + a thin tail means the union can
+  // anchor on the fat row's immutable single-row intents and build
+  // only the tail — O(tail) per topic instead of O(total), which is
+  // the whole cold-stream game on shallow-'#' corpora where every
+  // topic's row set is distinct but shares the same fat bucket row.
+  constexpr Py_ssize_t kChainMinBase = 96;
+  constexpr Py_ssize_t kSlotMapCap = 512 * 1024;
+  Py_ssize_t bi = -1;
+  Py_ssize_t fat_plain = 0, tail_plain = 0;
+  if (n_rows > 1 && g_chain_enabled) {
+    Py_ssize_t total_plain = 0;
+    for (Py_ssize_t i = 0; i < n_rows; i++) {
+      const Py_ssize_t p =
+          (off[rows[i] + 1] - off[rows[i]]) - t->shcount[rows[i]];
+      total_plain += p;
+      if (p > fat_plain) {
+        fat_plain = p;
+        bi = i;
+      }
+    }
+    tail_plain = total_plain - fat_plain;
+    if (fat_plain < kChainMinBase || tail_plain * 4 > fat_plain)
+      bi = -1;
+  }
+  PyObject *base_res = nullptr;
+  std::unordered_map<int32_t, DecodeTable::BaseSlot> *sm = nullptr;
+  if (bi >= 0) {
+    const int32_t fat_row = rows[bi];
+    auto found = t->row_slot.find(fat_row);
+    if (found != t->row_slot.end()) {
+      sm = &found->second;
+    } else if (t->slot_entries + fat_plain <= kSlotMapCap) {
+      sm = &t->row_slot[fat_row];
+      sm->reserve(static_cast<size_t>(fat_plain) * 2);
+      int32_t slot = 0;
+      for (int64_t a = off[fat_row]; a < off[fat_row + 1]; a++) {
+        if (kind[a] == ACT_SHARED) continue;
+        sm->emplace(t->act_cidx[a], DecodeTable::BaseSlot{slot++, a});
+      }
+      t->slot_entries += fat_plain;
+    }
+    if (sm) {
+      base_res = cached_intents_result(t, cap, &rows[bi], 1);
+      if (!base_res) {
+        Py_DECREF(key);
+        return nullptr;
+      }
+    } else {
+      bi = -1;  // slot-map budget exhausted: full union instead
+    }
+  }
+  IntentsObject *it =
+      intents_alloc(cap, bi >= 0 ? tail_plain : total - sh_pairs);
   if (!it) {
+    Py_XDECREF(base_res);
     Py_DECREF(key);
     return nullptr;
   }
+  if (bi >= 0) {
+    it->base = reinterpret_cast<IntentsObject *>(base_res);  // owns it
+    if (tail_plain) {
+      it->ovr_slots = static_cast<int32_t *>(
+          PyMem_Malloc(tail_plain * sizeof(int32_t)));
+      it->ovr_subs = static_cast<PyObject **>(
+          PyMem_Malloc(tail_plain * sizeof(PyObject *)));
+      if (!it->ovr_slots || !it->ovr_subs) {
+        Py_DECREF(key);
+        Py_DECREF(it);
+        PyErr_NoMemory();
+        return nullptr;
+      }
+    }
+  }
+  // override build state: a chained union must produce EXACTLY what
+  // the ascending-row-order union produces for a client present in
+  // both the base row and tail rows — qos max and identifier union
+  // are order-free, but merge_subscription takes flags from the NEWER
+  // (= higher row id) filter, so the base contribution is folded in at
+  // its ordered position via its raw action, not merged first-come.
+  struct OvrBuild {
+    int32_t slot;      // base slot shadowed
+    int64_t base_act;  // the base row's action for this client
+    PyObject *cur;     // accumulated entry; owned iff owned
+    bool owned;
+    bool folded;       // base contribution already applied
+  };
+  std::vector<OvrBuild> ovr_build;
+  std::unordered_map<int32_t, size_t> ovr_index;  // slot -> build idx
   auto bail = [&]() -> PyObject * {
+    for (auto &ob : ovr_build)
+      if (ob.owned) Py_XDECREF(ob.cur);
     Py_DECREF(key);
     Py_DECREF(it);
     return nullptr;
@@ -1196,8 +1397,11 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
   // a SINGLE row's non-shared actions are distinct clients by
   // construction (one entry per (client, filter)), so the whole
   // dedupe apparatus — marks, epochs, prefetch — is skipped and the
-  // union degenerates to a straight sequential copy of the stream
-  const bool dedupe = n_rows > 1;
+  // union degenerates to a straight sequential copy of the stream.
+  // A chained build unions only the tail rows, so the same shortcut
+  // applies when the tail is a single row.
+  const Py_ssize_t n_union_rows = n_rows - (bi >= 0 ? 1 : 0);
+  const bool dedupe = n_union_rows > 1;
   const bool fast = dedupe && guard.owned;
   uint32_t e32 = 0;
   if (fast) {
@@ -1230,6 +1434,32 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
       local_slot[c] = j;
     }
   };
+  // fold the base row's contribution into an override at its ordered
+  // position (no-op pointer-equality skip mirrors the union's
+  // duplicate-filter-row shortcut)
+  auto fold_base = [&](OvrBuild &ob) -> bool {
+    if (ob.folded) return true;
+    ob.folded = true;
+    if (!ob.cur) {
+      // base is this client's first contribution: the entry form the
+      // union would hold after the base row (ACT_MERGE base actions
+      // are already pre-merged inside the base intents)
+      ob.cur = it->base->subs[ob.slot];
+      ob.owned = false;
+      return true;
+    }
+    if (kind[ob.base_act] == ACT_PLAIN && ob.cur == t->sub[ob.base_act])
+      return true;  // same record twice (duplicate filter rows)
+    PyObject *mg = PyObject_CallFunctionObjArgs(
+        g_merge_fn, ob.cur, t->sub[ob.base_act], t->key[ob.base_act],
+        nullptr);
+    if (!mg) return false;
+    if (ob.owned) Py_DECREF(ob.cur);
+    ob.cur = mg;
+    ob.owned = true;
+    return true;
+  };
+  const int32_t fat_row_id = bi >= 0 ? rows[bi] : -1;
   Py_ssize_t n = 0;
   // The union is DRAM-latency-bound: every action's mark[] slot is a
   // random 8-byte access into a table that is tens of MB at 1M clients
@@ -1252,12 +1482,56 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
     if (pc >= 0) PREFETCH_W(&t->mark[pc]);
   };
   for (Py_ssize_t i = 0; i < n_rows; i++) {
+    if (i == bi) continue;  // chained: the base carries the fat row
     const int64_t r = rows[i];
     for (int64_t a = off[r]; a < off[r + 1]; a++) {
       if (fast) prefetch_at(i, a);
       const uint8_t k = kind[a];
       if (k == ACT_SHARED) continue;   // prebuilt per-row maps above
       const int32_t c = t->act_cidx[a];
+      if (sm) {
+        // same client also in the base row: shadow the base slot with
+        // a merged record instead of adding a duplicate tail entry
+        auto f = sm->find(c);
+        if (f != sm->end()) {
+          const auto &bs = f->second;
+          size_t oi;
+          auto fi = ovr_index.find(bs.slot);
+          if (fi != ovr_index.end()) {
+            oi = fi->second;
+          } else {
+            oi = ovr_build.size();
+            ovr_index.emplace(bs.slot, oi);
+            ovr_build.push_back({bs.slot, bs.act, nullptr, false,
+                                 false});
+          }
+          OvrBuild &ob = ovr_build[oi];
+          if (fat_row_id < r && !fold_base(ob)) return bail();
+          if (!ob.cur) {
+            // first contribution, base row not yet due (r < fat)
+            if (k == ACT_MERGE) {
+              PyObject *mg = PyObject_CallFunctionObjArgs(
+                  g_merge_fn, Py_None, t->sub[a], t->key[a], nullptr);
+              if (!mg) return bail();
+              ob.cur = mg;
+              ob.owned = true;
+            } else {
+              ob.cur = t->sub[a];
+              ob.owned = false;
+            }
+          } else if (k == ACT_PLAIN && ob.cur == t->sub[a]) {
+            // same record twice (duplicate filter rows)
+          } else {
+            PyObject *mg = PyObject_CallFunctionObjArgs(
+                g_merge_fn, ob.cur, t->sub[a], t->key[a], nullptr);
+            if (!mg) return bail();
+            if (ob.owned) Py_DECREF(ob.cur);
+            ob.cur = mg;
+            ob.owned = true;
+          }
+          continue;
+        }
+      }
       const Py_ssize_t j = slot_of(c);
       if (j < 0) {
         record_slot(c, n);
@@ -1287,7 +1561,33 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
       }
     }
   }
-  const Py_ssize_t charge = n + sh_pairs;
+  // finalize overrides: fold any still-pending base contribution (all
+  // of that client's tail rows preceded the base row), drop no-op
+  // overrides that resolved back to the base entry, and emit the
+  // arrays ascending by slot for the iterator's single-cursor pass
+  if (!ovr_build.empty()) {
+    for (auto &ob : ovr_build)
+      if (!fold_base(ob)) return bail();
+    std::sort(ovr_build.begin(), ovr_build.end(),
+              [](const OvrBuild &x, const OvrBuild &y) {
+                return x.slot < y.slot;
+              });
+    for (auto &ob : ovr_build) {
+      if (ob.cur == it->base->subs[ob.slot]) {
+        if (ob.owned) Py_DECREF(ob.cur);
+        ob.cur = nullptr;
+        ob.owned = false;
+        continue;  // identical to the base entry: not an override
+      }
+      if (!ob.owned) Py_INCREF(ob.cur);
+      it->ovr_slots[it->n_ovr] = ob.slot;
+      it->ovr_subs[it->n_ovr] = ob.cur;
+      it->n_ovr++;
+      ob.cur = nullptr;  // ref transferred to the intents object
+      ob.owned = false;
+    }
+  }
+  const Py_ssize_t charge = n + it->n_ovr + sh_pairs;
   if (t->icache_pairs + charge > kDecodeCachePairsCap) {
     if (t->icache_hits == 0 && ++t->icache_skips < kAdmissionRetry) {
       Py_DECREF(key);              // cold stream: stop churning
@@ -1490,6 +1790,9 @@ PyMethodDef methods[] = {
     {"table_release", table_release, METH_O,
      "Drop a snapshot table's caches, breaking the intents->capsule "
      "reference cycle (call when the snapshot is dropped)."},
+    {"_set_chain_enabled", set_chain_enabled, METH_O,
+     "TEST ONLY: disable/enable the chained-union fast path so the "
+     "suite can A/B chained vs full unions of the same row sets."},
     {nullptr, nullptr, 0, nullptr}};
 
 PyModuleDef mod = {PyModuleDef_HEAD_INIT, "maxmq_decode",

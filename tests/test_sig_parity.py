@@ -713,6 +713,149 @@ def test_intents_overlay_window_degrades_to_sets():
     assert "late" in _as_set(got[0]).subscriptions
 
 
+def test_intents_chained_base_parity():
+    """Fat-row topics build CHAINED intents (immutable single-row base +
+    per-topic tail with slot overrides) — the cold-stream wall killer.
+    Every consumer surface must agree with the trie: iteration (dedup,
+    merged qos/identifiers), n, len, has_client, to_set, $share maps."""
+    _native_mod()
+    idx = TopicIndex()
+    # fat '#' bucket well past kChainMinBase (96)
+    for i in range(150):
+        idx.subscribe(f"fat{i}", Subscription(filter="iot/dev/#", qos=1))
+    # thin rows; fat3/fat5 overlap the fat row -> overrides (merged
+    # qos max + v5 identifier union); solo* are pure tail entries
+    idx.subscribe("fat3", Subscription(filter="iot/dev/a/b", qos=2,
+                                       identifier=7))
+    idx.subscribe("fat5", Subscription(filter="iot/dev/+/b", qos=0))
+    idx.subscribe("solo1", Subscription(filter="iot/dev/a/b", qos=2))
+    idx.subscribe("solo2", Subscription(filter="iot/dev/+/b", qos=1,
+                                        identifier=3))
+    idx.subscribe("sh1", Subscription(filter="$share/g/iot/dev/#", qos=1))
+    idx.subscribe("sh2", Subscription(filter="$share/g/iot/dev/a/b",
+                                      qos=1))
+    eng = _intents_engine(idx)
+    eng.route_small = False
+    topics = ["iot/dev/a/b",   # chain: 2 tail entries + 2 overrides
+              "iot/dev/x/b",   # chain: 1 tail + 1 override
+              "iot/dev/z",     # single fat row: plain (not chained)
+              "nope/x"]        # empty
+    got = eng.collect_fixed(topics, eng.dispatch_fixed(topics))
+    assert got[0].chained and got[1].chained
+    assert not got[2].chained and not got[3].chained
+    for topic, r in zip(topics, got):
+        want = idx.subscribers(topic)
+        by_iter = {}
+        for cid, sub in r:
+            assert cid not in by_iter, f"dup {cid} on {topic}"
+            by_iter[cid] = sub
+        assert len(by_iter) == r.n, topic
+        assert set(by_iter) == set(want.subscriptions), topic
+        for cid, sub in by_iter.items():
+            w = want.subscriptions[cid]
+            assert sub.qos == w.qos, (topic, cid)
+            assert dict(sub.identifiers) == dict(w.identifiers), \
+                (topic, cid)
+            assert r.has_client(cid)
+        assert not r.has_client("no-such-client")
+        assert len(r) == len(want.subscriptions) + sum(
+            len(m) for m in want.shared.values()), topic
+        assert normalize(r.to_set()) == normalize(want), topic
+    # chains are cached per row set and alias across topics
+    again = eng.collect_fixed(topics, eng.dispatch_fixed(topics))
+    assert again[0] is got[0] and again[1] is got[1]
+
+
+def test_intents_chained_randomized_fat_corpus():
+    """Randomized corpora with fat '#' buckets: chained vs trie parity
+    over many distinct row sets (cold-stream shape)."""
+    _native_mod()
+    rng = random.Random(99)
+    idx = TopicIndex()
+    for i in range(200):
+        idx.subscribe(f"f{i}", Subscription(filter="b/#",
+                                            qos=rng.randint(0, 2)))
+    # thin overlapping filters, some reusing fat clients
+    for i in range(60):
+        cid = f"f{rng.randrange(200)}" if i % 3 else f"solo{i}"
+        seg = rng.choice(["b/x", "b/+", f"b/{i}", f"b/x/{i}", "b/+/+"])
+        idx.subscribe(cid, Subscription(filter=seg,
+                                        qos=rng.randint(0, 2),
+                                        identifier=rng.randint(0, 4)))
+    eng = _intents_engine(idx)
+    eng.route_small = False
+    topics = [rng.choice(["b/x", "b/q", f"b/{i}", f"b/x/{i}",
+                          f"b/{i}/z"]) for i in range(120)]
+    got = eng.collect_fixed(topics, eng.dispatch_fixed(topics))
+    saw_chain = 0
+    for topic, r in zip(topics, got):
+        want = idx.subscribers(topic)
+        saw_chain += bool(getattr(r, "chained", False))
+        by_iter = {}
+        for cid, sub in r:
+            assert cid not in by_iter, (topic, cid)
+            by_iter[cid] = sub
+        assert len(by_iter) == r.n, topic
+        assert normalize(r.to_set()) == normalize(want), topic
+        for cid, sub in by_iter.items():
+            w = want.subscriptions[cid]
+            assert (sub.qos, dict(sub.identifiers)) == \
+                (w.qos, dict(w.identifiers)), (topic, cid)
+    assert saw_chain, "chained path never engaged"
+
+
+def test_intents_chained_equals_full_union_flags():
+    """A chained union must be INDISTINGUISHABLE from the full union of
+    the same row sets — including the flag fields normalize() ignores
+    (merge_subscription takes no_local/RAP/RH from the newer filter, so
+    a naive chain would reverse the donor when the fat row anchors
+    first). Full-field A/B via the test-only _set_chain_enabled."""
+    mod = _native_mod()
+    if not hasattr(mod, "_set_chain_enabled"):
+        pytest.skip("chain toggle unavailable")
+
+    def build_engine():
+        idx = TopicIndex()
+        for i in range(150):
+            idx.subscribe(f"fat{i}", Subscription(
+                filter="fl/dev/#", qos=1, retain_handling=0))
+        # overlapping clients with DISTINCT flag values per filter
+        idx.subscribe("fat3", Subscription(
+            filter="fl/dev/a/b", qos=2, retain_handling=2,
+            no_local=True, identifier=7))
+        idx.subscribe("fat5", Subscription(
+            filter="fl/dev/+/b", qos=0, retain_as_published=True,
+            retain_handling=1))
+        idx.subscribe("fat7", Subscription(
+            filter="fl/+/a/b", qos=1, retain_handling=2, identifier=2))
+        eng = _intents_engine(idx)
+        eng.route_small = False
+        return eng
+
+    topics = ["fl/dev/a/b", "fl/dev/x/b", "fl/dev/z/q"]
+
+    def snapshot(eng):
+        got = eng.collect_fixed(topics, eng.dispatch_fixed(topics))
+        out = []
+        for r in got:
+            out.append(sorted(
+                (cid, s.filter, s.qos, s.no_local,
+                 s.retain_as_published, s.retain_handling,
+                 s.identifier, tuple(sorted(s.identifiers.items())))
+                for cid, s in r))
+        return got, out
+
+    try:
+        chained_res, chained = snapshot(build_engine())
+        assert any(getattr(r, "chained", False) for r in chained_res)
+        mod._set_chain_enabled(False)
+        plain_res, plain = snapshot(build_engine())
+        assert not any(getattr(r, "chained", False) for r in plain_res)
+    finally:
+        mod._set_chain_enabled(True)
+    assert chained == plain
+
+
 def test_table_release_breaks_cycle_on_rotation():
     """Dropping a compiled snapshot must release its cached intents:
     the capsule<->icache cycle is not GC-collectible (VERDICT: leak
