@@ -267,7 +267,8 @@ def link_probe(size_mb: int = 8) -> dict:
 
 
 def stage_decomposition(engine, topics_batch: list[str],
-                        iters: int = 3) -> dict:
+                        iters: int = 3,
+                        cold_topics: list[str] | None = None) -> dict:
     """Per-stage rates for one batch of the headline config, so the
     artifact shows WHERE time goes instead of asserting it:
       host_prep      — C++/numpy tokenize + host probe (topics/s)
@@ -340,6 +341,18 @@ def stage_decomposition(engine, topics_batch: list[str],
                                 ctx[4], ctx[5])
         d[f"decode_{form}_topics_per_sec"] = round(
             batch * iters / (time.perf_counter() - t0), 1)
+    # the loop above repeats ONE batch, so (budget permitting) it
+    # measures the cache-hit regime; a never-seen batch pins the cold
+    # construction rate the unique-topic headline stream pays
+    if cold_topics:
+        engine.emit_intents = True
+        ctx2 = engine.dispatch_fixed(cold_topics)
+        cnt2, rows2, hr2, tbl2 = engine.match_fixed([], out=ctx2)
+        t0 = time.perf_counter()
+        engine.decode_fixed(cold_topics, cnt2, rows2, hr2, tbl2,
+                            ctx2[4], ctx2[5])
+        d["decode_intents_cold_topics_per_sec"] = round(
+            len(cold_topics) / (time.perf_counter() - t0), 1)
     engine.emit_intents = saved_emit
     d["decode_topics_per_sec"] = d["decode_intents_topics_per_sec"]
     log(f"[stages] prep {d['host_prep_topics_per_sec']:,.0f}/s  "
@@ -425,7 +438,9 @@ def bench_config(name: str, n_subs: int, batch: int, iters: int,
     stages = {}
     if decompose:
         try:
-            stages = stage_decomposition(engine, batches[0])
+            stages = stage_decomposition(
+                engine, batches[0],
+                cold_topics=topic_gen(batch, seed2=991))
         except Exception as exc:      # decomposition must never cost the
             stages = {"error": repr(exc)[:300]}      # headline number
     result = {

@@ -663,10 +663,38 @@ PyObject *configure(PyObject *, PyObject *args) {
 // row set (flags included, not just the normalize() projection)
 bool g_chain_enabled = true;
 
+// chain-decision thresholds (settable for measurement/tests): anchor
+// on the fattest row when it has >= min_base plain entries and the
+// tail is at most (tail_num/tail_den) of it. Cost model: a tail pair
+// costs one slot-map probe (~30ns) on top of the scratch work it pays
+// either way, while every base pair SKIPS its ~43ns mark-table visit —
+// so chaining pays off whenever fat*43 > tail*30, with min_base
+// amortizing the fixed per-chain overhead (base lookup + override
+// machinery). Defaults measured on the 1M bench corpus (see ADR 007).
+Py_ssize_t g_chain_min_base = 64;
+Py_ssize_t g_chain_tail_num = 1;
+Py_ssize_t g_chain_tail_den = 1;
+
 PyObject *set_chain_enabled(PyObject *, PyObject *arg) {
   const int v = PyObject_IsTrue(arg);
   if (v < 0) return nullptr;
   g_chain_enabled = v != 0;
+  Py_RETURN_NONE;
+}
+
+PyObject *set_chain_params(PyObject *, PyObject *args) {
+  Py_ssize_t mb, num, den;
+  if (!PyArg_ParseTuple(args, "nnn", &mb, &num, &den)) return nullptr;
+  // the ratio test multiplies pair counts by num/den — bound them so
+  // the products cannot overflow Py_ssize_t (pair counts < 2^40)
+  if (mb < 1 || num < 0 || num > (1 << 20) || den < 1 ||
+      den > (1 << 20)) {
+    PyErr_SetString(PyExc_ValueError, "invalid chain params");
+    return nullptr;
+  }
+  g_chain_min_base = mb;
+  g_chain_tail_num = num;
+  g_chain_tail_den = den;
   Py_RETURN_NONE;
 }
 
@@ -1245,7 +1273,6 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
   // only the tail — O(tail) per topic instead of O(total), which is
   // the whole cold-stream game on shallow-'#' corpora where every
   // topic's row set is distinct but shares the same fat bucket row.
-  constexpr Py_ssize_t kChainMinBase = 96;
   constexpr Py_ssize_t kSlotMapCap = 512 * 1024;
   Py_ssize_t bi = -1;
   Py_ssize_t fat_plain = 0, tail_plain = 0;
@@ -1261,7 +1288,8 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
       }
     }
     tail_plain = total_plain - fat_plain;
-    if (fat_plain < kChainMinBase || tail_plain * 4 > fat_plain)
+    if (fat_plain < g_chain_min_base ||
+        tail_plain * g_chain_tail_den > fat_plain * g_chain_tail_num)
       bi = -1;
   }
   PyObject *base_res = nullptr;
@@ -1339,6 +1367,8 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
   // Py_NewRef when a single row carries shared members, else a bulk
   // copy of the fattest row's map + per-group inserts (inner maps
   // merged copy-on-write on the rare duplicate-filter-row collision)
+  Py_ssize_t sh_owned_pairs = 0;  // shared pairs this result STORES
+                                  // (an aliased per-row map costs 0)
   if (sh_pairs) {
     Py_ssize_t sh_n = 0, base_i = -1;
     for (Py_ssize_t i = 0; i < n_rows; i++)
@@ -1351,8 +1381,9 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
     PyObject *b = row_shared(t, rows[base_i]);
     if (!b) return bail();
     if (sh_n == 1) {
-      it->shared = Py_NewRef(b);
+      it->shared = Py_NewRef(b);  // aliased: no storage of its own
     } else {
+      sh_owned_pairs = sh_pairs;
       PyObject *d = PyDict_Copy(b);
       if (!d) return bail();
       it->shared = d;            // owned; set before merging so a
@@ -1587,7 +1618,16 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
       ob.owned = false;
     }
   }
-  const Py_ssize_t charge = n + it->n_ovr + sh_pairs;
+  // charge the icache at TRUE storage cost (ADVICE r03 discipline):
+  // own entries + overrides + a COPIED shared map's pairs. Chains and
+  // single-shared-row results that alias immutable per-row structures
+  // cost the budget nothing for the aliased part — on $share-heavy
+  // corpora this is the difference between ~12K cacheable row sets
+  // and several hundred thousand. The floor prices the fixed per-entry
+  // overhead (object header + arrays + key bytes + dict slot ≈ 300B ≈
+  // 16 pair-equivalents) so tiny chains cannot balloon the dict.
+  const Py_ssize_t charge =
+      std::max<Py_ssize_t>(n + it->n_ovr + sh_owned_pairs, 16);
   if (t->icache_pairs + charge > kDecodeCachePairsCap) {
     if (t->icache_hits == 0 && ++t->icache_skips < kAdmissionRetry) {
       Py_DECREF(key);              // cold stream: stop churning
@@ -1793,6 +1833,10 @@ PyMethodDef methods[] = {
     {"_set_chain_enabled", set_chain_enabled, METH_O,
      "TEST ONLY: disable/enable the chained-union fast path so the "
      "suite can A/B chained vs full unions of the same row sets."},
+    {"_set_chain_params", set_chain_params, METH_VARARGS,
+     "TEST/TUNING: (min_base, tail_num, tail_den) — chain when the "
+     "fattest row has >= min_base plain entries and tail <= "
+     "fat*tail_num/tail_den."},
     {nullptr, nullptr, 0, nullptr}};
 
 PyModuleDef mod = {PyModuleDef_HEAD_INIT, "maxmq_decode",
