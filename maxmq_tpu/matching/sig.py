@@ -1465,7 +1465,41 @@ class SigEngine(OverlayedEngine):
                                                       planes, n_words)
             self._state = (tables, consts, fn, fn_many,
                            fn_compact, fn_compact_many, fn_fixed, fmt)
+            self._freeze_heap_if_large(tables)
             return True
+
+    # generational-GC hygiene for huge corpora: a compiled million-sub
+    # table is several MILLION long-lived acyclic objects (Subscription
+    # records, client-id strings, filter keys). Left in the normal
+    # generations, every full collection walks them all — measured as a
+    # recurring ~40x whole-batch decode stall (seconds) whenever the
+    # allocation surplus around a decode-cache fill tripped gen2.
+    # gc.freeze() moves the survivors to the permanent generation;
+    # refcounting still reclaims them (the table's only cycle runs
+    # through the decode capsule and is broken explicitly by
+    # table_release on rotation). Frozen once per PROCESS growth step:
+    # re-freezing on every rotation would progressively pin transient
+    # broker state, so we freeze only when the live table is at least
+    # twice as large as at the last freeze.
+    GC_FREEZE_MIN_SUBS = 100_000
+    _frozen_subs = 0
+
+    def _freeze_heap_if_large(self, tables) -> None:
+        try:
+            n = int(self.index.subscription_count)
+        except Exception:
+            n = 0
+        cls = SigEngine
+        if n >= self.GC_FREEZE_MIN_SUBS and n >= 2 * cls._frozen_subs:
+            import gc
+            # collect first: freeze() moves EVERYTHING tracked into the
+            # permanent generation, including any collectable cycles
+            # alive right now (e.g. a rotated-out snapshot whose
+            # weakref.finalize must still fire) — those would otherwise
+            # leak for the life of the process
+            gc.collect()
+            gc.freeze()
+            cls._frozen_subs = n
 
     def _build_fixed_program(self, tables, consts, planes, n_words):
         """The fixed-slot device program: the fused Pallas chunk kernels

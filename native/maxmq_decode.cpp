@@ -373,6 +373,23 @@ static inline Py_ssize_t intents_total(const IntentsObject *self) {
 PyTypeObject *g_intents_type = nullptr;
 PyTypeObject *g_intents_iter_type = nullptr;
 
+// Intents objects are deliberately NOT GC-tracked: the only reference
+// cycle they can sit on runs through the decode-table capsule, which
+// is itself invisible to the cycle collector (capsules are never
+// tracked) and is broken manually by table_release — so tracking buys
+// no collectable cycle while making every GC pass walk the hundreds
+// of thousands of cached results, and every cache clear a multi-second
+// GC storm (measured: a recurring ~40x whole-batch stall at each
+// icache fill). Nothing else can close a cycle onto an intents object:
+// its referents are str client ids, plain Subscription records, dicts
+// of those, the capsule, and an (acyclic) base intents. tp_traverse /
+// tp_clear remain implemented for the HAVE_GC protocol and dealloc.
+// COROLLARY OF THE EXISTING IMMUTABILITY CONTRACT (decode_pairs
+// docstring): consumers must never graft a reference back onto a
+// result's Subscription records (e.g. sub.attr = intents) — results
+// and their records are shared, immutable, and deep_copy()'d before
+// any mutation, so such a cycle cannot legally arise; an illegal one
+// would now be uncollectable.
 IntentsObject *intents_alloc(PyObject *capsule, Py_ssize_t capacity) {
   auto *self = PyObject_GC_New(IntentsObject, g_intents_type);
   if (!self) return nullptr;
@@ -394,13 +411,11 @@ IntentsObject *intents_alloc(PyObject *capsule, Py_ssize_t capacity) {
         PyMem_Malloc(capacity * sizeof(PyObject *)));
     self->owned = static_cast<uint8_t *>(PyMem_Malloc(capacity));
     if (!self->cids || !self->subs || !self->owned) {
-      PyObject_GC_Track(self);
       Py_DECREF(self);
       PyErr_NoMemory();
       return nullptr;
     }
   }
-  PyObject_GC_Track(self);
   return self;
 }
 
@@ -773,6 +788,11 @@ struct DecodeTable {
   std::unordered_map<int32_t, std::unordered_map<int32_t, BaseSlot>>
       row_slot;
   Py_ssize_t slot_entries = 0;
+  // strong ref per fat row to its single-row intents: chains fetch the
+  // base by one map probe instead of a key-bytes + icache round trip
+  // per topic, and the base survives icache churn. Same
+  // capsule<->cache cycle class as icache; table_release breaks it.
+  std::unordered_map<int32_t, PyObject *> row_base;
   Py_ssize_t R, W, A;
 };
 
@@ -783,6 +803,15 @@ struct DecodeTable {
 // so a hot set that shifted to uncached topics gets in within one
 // bounded window instead of being locked out.
 constexpr Py_ssize_t kAdmissionRetry = 65536;
+// ... and a full cache clears ONLY when its entries were genuinely
+// earning (a shifted hot set racks hits up fast). Requiring a single
+// hit was enough for round-3's fat entries, but true-cost charging
+// admits ~250K chains per budget — a mostly-cold stream with a few
+// incidental repeats then cleared + rebuilt hundreds of thousands of
+// GC-tracked objects at every fill (measured as a recurring ~40x
+// whole-batch stall: the alloc/dealloc storm drives repeated full GC
+// passes over a millions-of-objects heap).
+constexpr Py_ssize_t kClearMinHits = 4096;
 
 // Each cache (fragments, row-set unions) is bounded by the TOTAL
 // subscriber entries it physically holds (hot corpora cache few, fat
@@ -800,6 +829,7 @@ void table_destroy(PyObject *capsule) {
   auto *t = static_cast<DecodeTable *>(
       PyCapsule_GetPointer(capsule, "maxmq_decode.table"));
   if (!t) return;
+  for (auto &kv : t->row_base) Py_XDECREF(kv.second);
   for (PyObject *d : t->rshared) Py_XDECREF(d);
   PyBuffer_Release(&t->tok);
   PyBuffer_Release(&t->min_depth);
@@ -948,6 +978,8 @@ PyObject *table_release(PyObject *, PyObject *cap) {
   t->cache_skips = t->icache_skips = 0;
   t->row_slot.clear();
   t->slot_entries = 0;
+  for (auto &kv : t->row_base) Py_DECREF(kv.second);
+  t->row_base.clear();
   Py_RETURN_NONE;
 }
 
@@ -1180,7 +1212,8 @@ PyObject *cached_rowset_result(DecodeTable *t, const int32_t *rows,
   // real copied dict and is charged in full against the row-set budget
   const Py_ssize_t pairs = n_rows == 1 ? 0 : subset_pairs(res);
   if (t->cache_pairs + pairs > kDecodeCachePairsCap) {
-    if (t->cache_hits == 0 && ++t->cache_skips < kAdmissionRetry) {
+    if (t->cache_hits < kClearMinHits &&
+        ++t->cache_skips < kAdmissionRetry) {
       Py_DECREF(key);              // cold stream: stop churning
       return reinterpret_cast<PyObject *>(res);
     }
@@ -1310,10 +1343,21 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
       t->slot_entries += fat_plain;
     }
     if (sm) {
-      base_res = cached_intents_result(t, cap, &rows[bi], 1);
-      if (!base_res) {
-        Py_DECREF(key);
-        return nullptr;
+      auto fb = t->row_base.find(fat_row);
+      if (fb != t->row_base.end()) {
+        base_res = Py_NewRef(fb->second);
+      } else {
+        base_res = cached_intents_result(t, cap, &rows[bi], 1);
+        if (!base_res) {
+          Py_DECREF(key);
+          return nullptr;
+        }
+        // the recursive build can run Python (merge callbacks, GC
+        // finalizers) and re-enter this builder; only the emplace
+        // WINNER may deposit a reference, like row_shared's
+        // publish-once discipline
+        auto ins = t->row_base.emplace(fat_row, nullptr);
+        if (ins.second) ins.first->second = Py_NewRef(base_res);
       }
     } else {
       bi = -1;  // slot-map budget exhausted: full union instead
@@ -1629,7 +1673,8 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
   const Py_ssize_t charge =
       std::max<Py_ssize_t>(n + it->n_ovr + sh_owned_pairs, 16);
   if (t->icache_pairs + charge > kDecodeCachePairsCap) {
-    if (t->icache_hits == 0 && ++t->icache_skips < kAdmissionRetry) {
+    if (t->icache_hits < kClearMinHits &&
+        ++t->icache_skips < kAdmissionRetry) {
       Py_DECREF(key);              // cold stream: stop churning
       return reinterpret_cast<PyObject *>(it);
     }
