@@ -294,13 +294,7 @@ class MicroBatcher:
         small corpora are the trie's remaining win); others always
         walk the CPU trie."""
         n = len(topics)
-        host = getattr(self.engine, "subscribers_host_batch", None)
-        if host is not None and n * self._trie_cost < self._host_est(n):
-            host = None
-        elif host is not None and n <= 8 and self._trie_stale >= 64:
-            host = None          # periodic trie sample: a winning host
-            self._trie_stale = 0  # path must not let the trie estimate
-                                  # go stale (it may have gotten cheaper)
+        host = self._pick_bypass_host(n)
         t0 = time.perf_counter()
         try:
             results = (host(topics) if host is not None else
@@ -310,10 +304,38 @@ class MicroBatcher:
                 if not fut.done():
                     fut.set_exception(exc)
             return
-        took = time.perf_counter() - t0
-        if host is not None:
-            # decompose into the two-parameter model: big batches pin
-            # the per-topic slope, small ones the per-call intercept
+        self._update_cost_model(host is not None, n,
+                                time.perf_counter() - t0)
+        self._since_probe += 1
+        self.bypasses += len(topics)
+        self._fill_cache(ver, batch, results)
+        for (_, fut), result in zip(batch, results):
+            if not fut.done():
+                fut.set_result(result)
+        if self._since_probe >= self.BYPASS_PROBE_EVERY:
+            self._shadow_probe(topics)
+
+    def _pick_bypass_host(self, n: int):
+        """The engine's device-free probe path when its fixed+per-topic
+        estimate undercuts the trie's, else None (trie serves). Tiny
+        batches periodically re-sample the trie so a winning host path
+        cannot let the trie estimate go stale."""
+        host = getattr(self.engine, "subscribers_host_batch", None)
+        if host is None:
+            return None
+        if n * self._trie_cost < self._host_est(n):
+            return None
+        if n <= 8 and self._trie_stale >= 64:
+            self._trie_stale = 0
+            return None
+        return host
+
+    def _update_cost_model(self, via_host: bool, n: int,
+                           took: float) -> None:
+        """Fold one bypass timing into whichever path served it. The
+        host path keeps a two-parameter model: big batches pin the
+        per-topic slope, small ones the per-call intercept."""
+        if via_host:
             if n >= 16:
                 self._host_per += 0.3 * (
                     (took - self._host_fixed) / n - self._host_per)
@@ -325,14 +347,6 @@ class MicroBatcher:
         else:
             self._trie_cost += 0.3 * (took / max(1, n) - self._trie_cost)
             self._trie_stale = 0
-        self._since_probe += 1
-        self.bypasses += len(topics)
-        self._fill_cache(ver, batch, results)
-        for (_, fut), result in zip(batch, results):
-            if not fut.done():
-                fut.set_result(result)
-        if self._since_probe >= self.BYPASS_PROBE_EVERY:
-            self._shadow_probe(topics)
 
     def _shadow_probe(self, topics) -> None:
         """Duplicate one bypassed batch to the device in the background

@@ -437,22 +437,8 @@ def host_exact_rows_from_sig(tables: SigTables, esig: np.ndarray,
         sel = np.nonzero(lengths == d)[0]
         if not sel.size:
             continue
-        sig = esig[sel]
-        lo = np.searchsorted(g.sigs, sig, side="left")
-        # a hit needs sigs[lo] == sig; duplicates (collided filters) are
-        # rare, so probe the right edge lazily only for actual hits
-        hits = np.nonzero((lo < len(g.sigs)) & (g.sigs[
-            np.minimum(lo, len(g.sigs) - 1)] == sig))[0]
-        if not hits.size:
-            continue
-        hi = np.searchsorted(g.sigs, sig[hits], side="right")
-        lo = lo[hits]
-        single = hi - lo == 1                  # collided filters are rare
-        ti_parts.append(sel[hits[single]])
-        row_parts.append(g.rows[lo[single]])
-        for j, l0, h in zip(hits[~single], lo[~single], hi[~single]):
-            ti_parts.append(np.full(h - l0, sel[j], dtype=np.int64))
-            row_parts.append(g.rows[l0:h])
+        _probe_sorted_sigs(g.sigs, g.rows, esig[sel], sel, ti_parts,
+                           row_parts)
     return _scatter_hits(out, ti_parts, row_parts)
 
 
@@ -493,25 +479,45 @@ def host_plus_rows(tables: SigTables, toks: np.ndarray, lengths: np.ndarray,
             sig_all = t @ p.coef.T + p.dc[None, :]       # [n, K] wrapping
         dol = dollar[sel]
         for k in range(len(p.sigs)):
-            sigs_k, rows_k = p.sigs[k], p.rows[k]
-            sig = sig_all[:, k]
-            lo = np.searchsorted(sigs_k, sig, side="left")
-            ok = (lo < len(sigs_k)) & (sigs_k[
-                np.minimum(lo, len(sigs_k) - 1)] == sig)
-            if p.wildf[k]:
-                ok &= ~dol                # [MQTT-4.7.1-1] '$' exclusion
-            hits = np.nonzero(ok)[0]
-            if not hits.size:
-                continue
-            hi = np.searchsorted(sigs_k, sig[hits], side="right")
-            lo = lo[hits]
-            single = hi - lo == 1          # collided filters are rare
-            ti_parts.append(sel[hits[single]])
-            row_parts.append(rows_k[lo[single]])
-            for j, l0, h in zip(hits[~single], lo[~single], hi[~single]):
-                ti_parts.append(np.full(h - l0, sel[j], dtype=np.int64))
-                row_parts.append(rows_k[l0:h])
+            _probe_group_sigs(p, k, sig_all[:, k], sel, dol,
+                              ti_parts, row_parts)
     return _scatter_hits(out, ti_parts, row_parts)
+
+
+def _probe_group_sigs(p, k: int, sig: np.ndarray, sel: np.ndarray,
+                      dol: np.ndarray, ti_parts: list,
+                      row_parts: list) -> None:
+    """Binary-search one wildcard group's sorted signature view,
+    applying the [MQTT-4.7.1-1] '$' exclusion for wildcard-first
+    shapes."""
+    _probe_sorted_sigs(p.sigs[k], p.rows[k], sig, sel, ti_parts,
+                       row_parts, dol if p.wildf[k] else None)
+
+
+def _probe_sorted_sigs(sigs_k: np.ndarray, rows_k: np.ndarray,
+                       sig: np.ndarray, sel: np.ndarray, ti_parts: list,
+                       row_parts: list,
+                       dol: np.ndarray | None = None) -> None:
+    """Binary-search a sorted signature array, appending (topic, row)
+    hit arrays; signature collisions expand to every colliding row
+    (verified later like any candidate). ``dol`` masks '$'-prefixed
+    topics out when given."""
+    lo = np.searchsorted(sigs_k, sig, side="left")
+    ok = (lo < len(sigs_k)) & (sigs_k[
+        np.minimum(lo, len(sigs_k) - 1)] == sig)
+    if dol is not None:
+        ok &= ~dol                        # [MQTT-4.7.1-1] '$' exclusion
+    hits = np.nonzero(ok)[0]
+    if not hits.size:
+        return
+    hi = np.searchsorted(sigs_k, sig[hits], side="right")
+    lo = lo[hits]
+    single = hi - lo == 1                 # collided filters are rare
+    ti_parts.append(sel[hits[single]])
+    row_parts.append(rows_k[lo[single]])
+    for j, l0, h in zip(hits[~single], lo[~single], hi[~single]):
+        ti_parts.append(np.full(h - l0, sel[j], dtype=np.int64))
+        row_parts.append(rows_k[l0:h])
 
 
 def host_hash_rows(tables: SigTables, toks: np.ndarray,

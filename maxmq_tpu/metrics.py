@@ -247,6 +247,10 @@ def register_broker_metrics(registry: Registry, broker) -> None:
         registry.gauge_func(f"maxmq_mqtt_{name}", help_,
                             lambda n=name: getattr(info, n))
     # matcher-side metrics (TPU path; no reference equivalent)
+    _register_matcher_metrics(registry, broker)
+
+
+def _register_matcher_metrics(registry: Registry, broker) -> None:
     matcher = getattr(broker, "matcher", None)
     if matcher is not None and hasattr(matcher, "matches"):
         registry.counter_func(
