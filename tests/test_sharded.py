@@ -289,6 +289,59 @@ async def test_cluster_broker_qos12_offline_redelivery():
         await mb.close()
 
 
+def test_sharded_chain_in_chain_parity():
+    """Cluster chain composition: per-shard results that are themselves
+    CHAINED intents (fat '#' bucket split across client-hash shards)
+    iterate correctly inside the cluster-level ChainedIntents — no
+    duplicate clients, exact trie parity, n/len/to_set agree."""
+    from test_nfa_parity import normalize
+
+    from maxmq_tpu.native import decode_module
+    mod = decode_module()
+    if mod is None or not hasattr(mod, "_set_chain_params"):
+        pytest.skip("maxmq_decode extension unavailable")
+    from maxmq_tpu.parallel.sharded import ChainedIntents, ShardedSigEngine
+
+    idx = TopicIndex()
+    for i in range(200):
+        idx.subscribe(f"fat{i}", Subscription(filter="cc/dev/#", qos=1))
+    idx.subscribe("fat3", Subscription(filter="cc/dev/a/b", qos=2,
+                                       identifier=5))
+    idx.subscribe("solo", Subscription(filter="cc/dev/+/b", qos=1))
+    idx.subscribe("sh1", Subscription(filter="$share/g/cc/dev/#", qos=1))
+    # client-hash sharding splits the 200 fat clients ~25 per shard —
+    # drop the chain threshold so every shard's fat row anchors a chain
+    mod._set_chain_params(8, 4, 1)
+    try:
+        eng = ShardedSigEngine(idx, mesh=make_mesh())
+        eng.emit_intents = True
+        topics = ["cc/dev/a/b", "cc/dev/x/b", "cc/dev/z", "no/match"]
+        got = eng.subscribers_batch(topics)
+        saw_nested = 0
+        for topic, r in zip(topics, got):
+            want = idx.subscribers(topic)
+            if not isinstance(r, ChainedIntents):
+                assert normalize(getattr(r, "to_set", lambda: r)()) \
+                    == normalize(want), topic
+                continue
+            saw_nested += sum(
+                1 for p in r.parts if getattr(p, "chained", False))
+            by_iter = {}
+            for cid, sub in r:
+                assert cid not in by_iter, (topic, cid)
+                by_iter[cid] = sub
+            assert len(by_iter) == r.n, topic
+            assert set(by_iter) == set(want.subscriptions), topic
+            for cid, sub in by_iter.items():
+                w = want.subscriptions[cid]
+                assert (sub.qos, dict(sub.identifiers)) == \
+                    (w.qos, dict(w.identifiers)), (topic, cid)
+            assert normalize(r.to_set()) == normalize(want), topic
+        assert saw_nested, "no per-shard chained intents engaged"
+    finally:
+        mod._set_chain_params(64, 1, 1)
+
+
 @pytest.mark.parametrize("seed", [21, 22])
 def test_sharded_intents_parity(seed):
     """Cluster-mode ADR 007: chained per-shard DeliveryIntents must
