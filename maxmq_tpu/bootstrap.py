@@ -167,13 +167,28 @@ async def _maybe_attach_service(conf: Config, broker: Broker) -> None:
         await attach_matcher_service(broker, conf.matcher_socket)
 
 
+def _signal_stop_event() -> asyncio.Event:
+    """A stop event set by SIGINT/SIGTERM (start.go:71-77 analogue)."""
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:
+            pass
+    return stop
+
+
 async def run_server(conf: Config, logger: Logger,
                      ready: asyncio.Event | None = None,
-                     stop: asyncio.Event | None = None) -> None:
+                     stop: asyncio.Event | None = None,
+                     broker_out: list | None = None) -> None:
     """Run broker + metrics until ``stop`` is set or SIGINT/SIGTERM.
 
     ``ready``/``stop`` let tests drive the full bootstrap in-process the way
-    the reference's start_test.go runs runServer with a cancellable context.
+    the reference's start_test.go runs runServer with a cancellable context;
+    ``broker_out`` (a list the built Broker is appended to) lets them
+    assert on the wired components without reaching into module state.
     """
     boot = logger.with_prefix("bootstrap")
     boot.debug("effective configuration", **config_as_dict(conf))
@@ -184,19 +199,15 @@ async def run_server(conf: Config, logger: Logger,
     profiler = _start_profiling(conf)
 
     broker = build_broker(conf, logger)
+    if broker_out is not None:
+        broker_out.append(broker)
     # service matcher must attach BEFORE the metrics registry is built,
     # or the matcher/pipeline metrics never register in service mode
     await _maybe_attach_service(conf, broker)
     metrics = build_metrics(conf, broker, logger)
 
     if stop is None:
-        stop = asyncio.Event()
-        loop = asyncio.get_running_loop()
-        for sig in (signal.SIGINT, signal.SIGTERM):
-            try:
-                loop.add_signal_handler(sig, stop.set)
-            except NotImplementedError:
-                pass
+        stop = _signal_stop_event()
 
     if metrics is not None:
         metrics.start()

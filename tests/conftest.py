@@ -29,6 +29,9 @@ def pytest_pyfunc_call(pyfuncitem):
     if inspect.iscoroutinefunction(func):
         kwargs = {name: pyfuncitem.funcargs[name]
                   for name in pyfuncitem._fixtureinfo.argnames}
-        asyncio.run(asyncio.wait_for(func(**kwargs), timeout=30))
+        # tests that boot compile-heavy stages (mesh XLA programs) opt
+        # into a longer deadline via `_async_timeout` on the function
+        deadline = getattr(func, "_async_timeout", 30)
+        asyncio.run(asyncio.wait_for(func(**kwargs), timeout=deadline))
         return True
     return None

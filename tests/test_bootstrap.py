@@ -102,3 +102,40 @@ async def test_run_server_end_to_end(tmp_path, monkeypatch):
     await asyncio.wait_for(task, timeout=10)
     assert (tmp_path / "cpu.prof").exists()
     assert (tmp_path / "heap.prof").exists()
+
+
+async def test_run_server_cluster_mesh_matcher():
+    """Config-driven cluster mode: ``matcher_mesh = "2x4"`` boots a
+    ShardedSigEngine (intents on, ADR 007) behind the micro-batcher on
+    the 8-virtual-device mesh, and a live client round-trips through
+    the sharded match path."""
+    from maxmq_tpu.parallel.sharded import ShardedSigEngine
+
+    conf = Config(mqtt_tcp_address="127.0.0.1:18833",
+                  metrics_enabled=False, matcher="sig",
+                  matcher_mesh="2x4", matcher_batch_window_us=0,
+                  mqtt_sys_topic_interval=0)
+    ready, stop = asyncio.Event(), asyncio.Event()
+    task = asyncio.create_task(
+        run_server(conf, quiet_logger(), ready=ready, stop=stop,
+                   broker_out=(captured := [])))
+    try:
+        await asyncio.wait_for(ready.wait(), timeout=90)
+        broker = captured[0]
+        eng = broker.matcher.engine
+        assert isinstance(eng, ShardedSigEngine), eng
+        assert eng.emit_intents is True                # ADR 007 default
+
+        c = MQTTClient(client_id="mesh-c1")
+        await c.connect("127.0.0.1", 18833)
+        await c.subscribe(("mesh/+/t", 1))
+        await c.publish("mesh/a/t", b"sharded", qos=1)
+        msg = await c.next_message(timeout=20)
+        assert (msg.payload, msg.topic) == (b"sharded", "mesh/a/t")
+        await c.disconnect()
+    finally:
+        stop.set()
+    await asyncio.wait_for(task, timeout=15)
+
+
+test_run_server_cluster_mesh_matcher._async_timeout = 150

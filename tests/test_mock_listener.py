@@ -1,0 +1,64 @@
+"""MockListener: the in-memory listener fake (reference parity:
+vendor/.../v2/listeners/mock.go — engine tests there run without
+sockets; ours must too). Drives a real broker session over the paired
+in-memory streams with hand-assembled wire bytes."""
+
+import asyncio
+
+from test_golden_transcripts import (CONNACK_V4, CONNECT_V4, SUBACK_V4,
+                                     SUBSCRIBE_V4, expect)
+
+from maxmq_tpu.broker import Broker, BrokerOptions, Capabilities
+from maxmq_tpu.broker.listeners import MockListener
+from maxmq_tpu.hooks import AllowHook
+
+
+async def test_mock_listener_full_session():
+    b = Broker(BrokerOptions(capabilities=Capabilities(
+        sys_topic_interval=0, receive_maximum=0, topic_alias_maximum=0,
+        maximum_packet_size=0)))
+    b.add_hook(AllowHook())
+    lst = b.add_listener(MockListener("mock1", "mem://"))
+    await b.serve()
+    try:
+        assert lst.protocol == "mock"
+        assert lst.serving.is_set()
+        reader, writer = await lst.connect()
+        writer.write(CONNECT_V4)
+        await expect(reader, CONNACK_V4, "connack over mock")
+        writer.write(SUBSCRIBE_V4)
+        await expect(reader, SUBACK_V4, "suback over mock")
+        # PUBLISH "g/t" qos0 "hi" [MQTT-3.3] -> echoed to the subscriber
+        pub = bytes.fromhex("3007" + "0003" + "672f74" + "6869")
+        writer.write(pub)
+        await expect(reader, pub, "qos0 echo over mock")
+        # writer close semantics: feeds EOF to the broker side
+        assert not writer.is_closing()
+        writer.close()
+        assert writer.is_closing()
+        await writer.wait_closed()
+        await asyncio.sleep(0.05)
+        await lst.close()
+        assert not lst.serving.is_set()
+    finally:
+        await b.close()
+
+
+async def test_mock_writer_surface():
+    """_QueueWriter duck-types the StreamWriter bits the broker uses."""
+    lst = MockListener("m2", "mem://")
+
+    async def establish(lid, reader, writer):
+        data = await reader.readexactly(3)
+        writer.write(b"ok:" + data)
+        await writer.drain()
+        assert writer.get_extra_info("peername", "none") == "none"
+        writer.close()
+
+    await lst.serve(establish)
+    reader, writer = await lst.connect()
+    writer.write(b"abc")
+    assert await asyncio.wait_for(reader.readexactly(6), 5) == b"ok:abc"
+    assert await reader.read() == b""      # EOF after server close
+    writer.close()
+    await writer.wait_closed()
