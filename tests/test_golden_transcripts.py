@@ -89,6 +89,42 @@ async def test_v311_session_transcript():
         writer.close()
 
 
+# --- MQTT 3.1 (protocol level 3, "MQIsdp") session -------------------
+# The oldest dialect the reference accepts (its corpus carries MQIsdp
+# CONNECT vectors; the engine serves levels 3/4/5). Bytes per the
+# MQTT v3.1 specification (protocol name "MQIsdp", level 0x03).
+
+# CONNECT: fh 0x10, rem 17; "MQIsdp"; level 3; flags 0x02 (clean);
+# keepalive 60; client id "g31"
+CONNECT_V3 = bytes.fromhex("10110006" + "4d5149736470" + "03" + "02"
+                           + "003c" + "0003" + "673331")
+# SUBSCRIBE pid=2 filter "g/3" qos0
+SUBSCRIBE_V3 = bytes.fromhex("82080002" + "0003" + "672f33" + "00")
+SUBACK_V3 = bytes.fromhex("90030002" + "00")
+# PUBLISH qos0 "g/3" payload "31"
+PUBLISH_V3 = bytes.fromhex("3007" + "0003" + "672f33" + "3331")
+
+
+async def test_v31_mqisdp_session_transcript():
+    async with raw_broker() as port:
+        reader, writer = await open_raw(port)
+        writer.write(CONNECT_V3)
+        await writer.drain()
+        await expect(reader, CONNACK_V4, "v3.1 CONNACK")   # same bytes
+        writer.write(SUBSCRIBE_V3)
+        await writer.drain()
+        await expect(reader, SUBACK_V3, "v3.1 SUBACK")
+        writer.write(PUBLISH_V3)
+        await writer.drain()
+        await expect(reader, PUBLISH_V3, "v3.1 PUBLISH echo")
+        writer.write(PINGREQ)
+        await writer.drain()
+        await expect(reader, PINGRESP, "v3.1 PINGRESP")
+        writer.write(DISCONNECT_V4)
+        await writer.drain()
+        writer.close()
+
+
 # --- MQTT 3.1.1 QoS1 and QoS2 ack bytes ------------------------------
 
 # PUBLISH qos1 pid=5 "g/q" payload "a" [MQTT-3.3.1-2]: fh 0x32
