@@ -109,6 +109,38 @@ class MatcherService:
         self._conns: set = set()        # live client writers
         self.subs_applied = 0
         self.matches_served = 0
+        # encode memo: match results are cached, immutable objects
+        # shared across topics (row-set caches, topic caches), so the
+        # JSON fragment for one result is computed once and spliced
+        # into every reply that carries it — on fan-out-heavy corpora
+        # a single result serializes hundreds of entries. Keyed by
+        # object identity WITH a strong ref (keeps the id valid);
+        # bounded by entry count, dropped wholesale when full.
+        self._enc: dict[int, tuple] = {}
+        self._enc_version = -1
+        self.enc_hits = 0
+
+    _ENC_CAP = 4096
+
+    def _result_frag(self, s) -> str:
+        # a subscription change rotates every result object, so entries
+        # from older versions can never hit again — drop them as a
+        # group instead of letting them crowd live fragments to the cap
+        ver = self.index.sub_version
+        if ver != self._enc_version:
+            self._enc.clear()
+            self._enc_version = ver
+        key = id(s)
+        hit = self._enc.get(key)
+        if hit is not None and hit[0] is s:
+            self.enc_hits += 1
+            return hit[1]
+        full = s.to_set() if hasattr(s, "to_set") else s
+        frag = json.dumps(encode_result(full), separators=(",", ":"))
+        if len(self._enc) >= self._ENC_CAP:
+            self._enc.clear()
+        self._enc[key] = (s, frag)
+        return frag
 
     async def start(self) -> None:
         self.matcher = self._factory(self.index)
@@ -211,15 +243,21 @@ class MatcherService:
                 results = await asyncio.gather(
                     *(self.matcher.subscribers_async(t) for t in topics))
             self.matches_served += len(topics)
-            out = {"r": req_id, "s": [encode_result(s) for s in results]}
+            # req_id round-trips through json.dumps so any JSON-legal
+            # id a client sent (float, string) keys its reply correctly
+            payload = ('{"r":%s,"s":[%s]}' % (
+                json.dumps(req_id),
+                ",".join(self._result_frag(s) for s in results))
+            ).encode()
         except asyncio.CancelledError:
             raise
         except Exception as exc:
             # the client MUST get a reply — a silent drop leaves its
             # future (and that publish) pending forever; the broker
             # degrades an errored match to its CPU trie
-            out = {"r": req_id, "e": repr(exc)[:300]}
-        writer.write(_frame(OP_RESULT, json.dumps(out).encode()))
+            payload = json.dumps(
+                {"r": req_id, "e": repr(exc)[:300]}).encode()
+        writer.write(_frame(OP_RESULT, payload))
         try:
             await writer.drain()
         except (ConnectionError, OSError):

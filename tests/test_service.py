@@ -368,3 +368,35 @@ async def test_cli_matcher_service_command(tmp_path):
     finally:
         proc.send_signal(signal.SIGINT)
         proc.wait(timeout=10)
+
+
+async def test_service_encode_memo_reuses_fragments():
+    """Shared match results serialize once: repeated topics (same cached
+    result object) must reuse the JSON fragment, with byte-identical
+    decoded answers either way."""
+    path = _sock_path()
+    svc = MatcherService(path)
+    await svc.start()
+    try:
+        m = ServiceMatcher(path)
+        await m.connect()
+        # no index attached -> the client topic cache stays off and
+        # every match goes to the wire
+        for i in range(40):
+            m.forward_subscribe(f"f{i}", Subscription(filter="em/#",
+                                                      qos=1))
+        first = await m.subscribers_async("em/x")
+        assert svc.enc_hits == 0
+        for _ in range(3):
+            again = await m.subscribers_async("em/x")
+            assert normalize(again) == normalize(first)
+        assert svc.enc_hits >= 3, svc.enc_hits
+        # a subscription change rotates the result object -> fresh frag
+        m.forward_subscribe("late", Subscription(filter="em/x", qos=0))
+        hits_before = svc.enc_hits
+        got = await m.subscribers_async("em/x")
+        assert "late" in got.subscriptions
+        assert svc.enc_hits == hits_before  # new result: memo miss
+        await m.close()
+    finally:
+        await svc.close()
