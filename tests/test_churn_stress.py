@@ -161,6 +161,35 @@ def test_threaded_churn_sig_sets():
     assert total > 5 and checked > 0
 
 
+def test_threaded_churn_sig_chained():
+    """The chained-intents path under the same storm: a fat '#' bucket
+    forces chains (threshold lowered), so concurrent readers exercise
+    the row_base publish-once race, the per-row slot maps, and chained
+    iteration while the mutator rotates tables."""
+    from maxmq_tpu.native import decode_module
+    mod = decode_module()
+    if mod is None or not hasattr(mod, "_set_chain_params"):
+        pytest.skip("maxmq_decode extension unavailable")
+    idx = TopicIndex()
+    _seed(idx, n=800, clients=120)
+    for i in range(120):
+        idx.subscribe(f"fat{i}", Subscription(filter="s0/#", qos=1))
+    mod._set_chain_params(16, 4, 1)
+    try:
+        eng = SigEngine(idx)
+        eng.emit_intents = True
+        eng.route_small = False
+        checked, total, errors = _storm(eng, idx, duration_s=6,
+                                        n_readers=3)
+        assert not errors, errors
+        assert total > 5 and checked > 0
+        # the chained path must actually have engaged during the storm
+        got = eng.subscribers_fixed_batch(["s0/a/b"])
+        assert getattr(got[0], "chained", False) or got[0].n >= 120
+    finally:
+        mod._set_chain_params(64, 1, 1)
+
+
 def test_threaded_churn_sharded():
     """Sharded engine on the CPU mesh under the same storm (smaller
     corpus: 8 shard_map programs share one core here)."""
