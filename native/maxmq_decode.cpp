@@ -405,16 +405,21 @@ IntentsObject *intents_alloc(PyObject *capsule, Py_ssize_t capacity) {
   self->ovr_subs = nullptr;
   self->n_ovr = 0;
   if (capacity) {
-    self->cids = static_cast<PyObject **>(
-        PyMem_Malloc(capacity * sizeof(PyObject *)));
-    self->subs = static_cast<PyObject **>(
-        PyMem_Malloc(capacity * sizeof(PyObject *)));
-    self->owned = static_cast<uint8_t *>(PyMem_Malloc(capacity));
-    if (!self->cids || !self->subs || !self->owned) {
+    // one block for all three arrays (cids | subs | owned): chain
+    // tails allocate per cold topic, so two fewer malloc/free pairs
+    // per result is measurable; intents_clear_slot frees cids only
+    char *block = static_cast<char *>(
+        PyMem_Malloc(capacity * (2 * sizeof(PyObject *) + 1)));
+    if (!block) {
       Py_DECREF(self);
       PyErr_NoMemory();
       return nullptr;
     }
+    self->cids = reinterpret_cast<PyObject **>(block);
+    self->subs = reinterpret_cast<PyObject **>(
+        block + capacity * sizeof(PyObject *));
+    self->owned = reinterpret_cast<uint8_t *>(
+        block + 2 * capacity * sizeof(PyObject *));
   }
   return self;
 }
@@ -438,16 +443,13 @@ int intents_clear_slot(PyObject *self_o) {
     for (Py_ssize_t i = 0; i < self->n; i++)
       if (self->owned[i]) Py_CLEAR(self->subs[i]);
   self->n = 0;
-  PyMem_Free(self->cids);
-  PyMem_Free(self->subs);
-  PyMem_Free(self->owned);
+  PyMem_Free(self->cids);  // one block carries cids+subs+owned
   self->cids = self->subs = nullptr;
   self->owned = nullptr;
   for (Py_ssize_t i = 0; i < self->n_ovr; i++)
     Py_CLEAR(self->ovr_subs[i]);
   self->n_ovr = 0;
-  PyMem_Free(self->ovr_slots);
-  PyMem_Free(self->ovr_subs);
+  PyMem_Free(self->ovr_subs);  // one block: ovr_subs | ovr_slots
   self->ovr_slots = nullptr;
   self->ovr_subs = nullptr;
   Py_CLEAR(self->base);
@@ -1373,16 +1375,18 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
   if (bi >= 0) {
     it->base = reinterpret_cast<IntentsObject *>(base_res);  // owns it
     if (tail_plain) {
-      it->ovr_slots = static_cast<int32_t *>(
-          PyMem_Malloc(tail_plain * sizeof(int32_t)));
-      it->ovr_subs = static_cast<PyObject **>(
-          PyMem_Malloc(tail_plain * sizeof(PyObject *)));
-      if (!it->ovr_slots || !it->ovr_subs) {
+      // one block: PyObject* array first (alignment), slots after
+      char *ob = static_cast<char *>(PyMem_Malloc(
+          tail_plain * (sizeof(PyObject *) + sizeof(int32_t))));
+      if (!ob) {
         Py_DECREF(key);
         Py_DECREF(it);
         PyErr_NoMemory();
         return nullptr;
       }
+      it->ovr_subs = reinterpret_cast<PyObject **>(ob);
+      it->ovr_slots = reinterpret_cast<int32_t *>(
+          ob + tail_plain * sizeof(PyObject *));
     }
   }
   // override build state: a chained union must produce EXACTLY what
