@@ -384,6 +384,19 @@ def bench_config(name: str, n_subs: int, batch: int, iters: int,
     batches = [topic_gen(batch, seed2=100 + i) for i in range(iters)]
 
     run_sig(engine, batches[:1], depth)          # warm compile + slices
+    frozen = n_subs >= 100_000
+    if frozen:
+        # post-warm-up freeze (ADR 009): the warmed caches and compile
+        # artifacts join the permanent generation so mid-run gen2
+        # passes stop walking them — the same discipline a production
+        # broker applies after its warm-up window. Unfrozen (and
+        # collected) before this config returns: on the CPU backend
+        # several configs share one process, and a permanent frozen
+        # heap per config would pin each one's tables for the rest of
+        # the run (accelerator runs isolate configs in subprocesses).
+        import gc
+        gc.collect()
+        gc.freeze()
     t0 = time.perf_counter()
     matched, n_over = run_sig(engine, batches, depth)
     raw_dt = time.perf_counter() - t0
@@ -461,6 +474,10 @@ def bench_config(name: str, n_subs: int, batch: int, iters: int,
     log(f"[{name}] decode-inclusive {dec_rate:,.0f}/s  "
         f"raw {raw_rate:,.0f}/s  trie {trie_rate:,.0f}/s  "
         f"pallas={engine.pallas_active}")
+    if frozen:
+        import gc
+        gc.unfreeze()
+        gc.collect()
     return result
 
 
