@@ -397,6 +397,22 @@ def bench_config(name: str, n_subs: int, batch: int, iters: int,
         import gc
         gc.collect()
         gc.freeze()
+    try:
+        return _bench_config_timed(
+            name, engine, index, batches, batch, iters, depth, n_subs,
+            decompose, topic_gen, compile_s)
+    finally:
+        # always unfreeze, even if a timed pass raises — a permanently
+        # frozen shared CPU-backend process would pin this config's
+        # tables for every subsequent config (ADVICE r4)
+        if frozen:
+            import gc
+            gc.unfreeze()
+            gc.collect()
+
+
+def _bench_config_timed(name, engine, index, batches, batch, iters,
+                        depth, n_subs, decompose, topic_gen, compile_s):
     t0 = time.perf_counter()
     matched, n_over = run_sig(engine, batches, depth)
     raw_dt = time.perf_counter() - t0
@@ -474,10 +490,6 @@ def bench_config(name: str, n_subs: int, batch: int, iters: int,
     log(f"[{name}] decode-inclusive {dec_rate:,.0f}/s  "
         f"raw {raw_rate:,.0f}/s  trie {trie_rate:,.0f}/s  "
         f"pallas={engine.pallas_active}")
-    if frozen:
-        import gc
-        gc.unfreeze()
-        gc.collect()
     return result
 
 

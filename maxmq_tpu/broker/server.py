@@ -166,11 +166,34 @@ class Broker:
         self.loop = asyncio.get_running_loop()
         self._running = True
         await self._restore_from_storage()
+        await self._compile_matcher_tables()
         await self.listeners.serve_all(self._establish)
         self._housekeeper = self.loop.create_task(self._housekeeping_loop())
         if self.capabilities.sys_topic_interval > 0:
             self._sys_task = self.loop.create_task(self._sys_topic_loop())
         self.hooks.notify("on_started")
+
+    async def _compile_matcher_tables(self) -> None:
+        """Compile the matcher's initial tables at the boot quiescent
+        point — after storage restore, before listeners accept traffic.
+        A restore that loaded a large subscription set would otherwise
+        defer the first table compile (and its gc.freeze, ADR 009) to
+        the first publish, freezing mid-traffic transients along with
+        the tables. Off the event loop: the compile can take seconds at
+        1M subscriptions, and nothing is being served yet."""
+        if self.matcher is None or self.topics.subscription_count == 0:
+            return
+        engine = getattr(self.matcher, "engine", self.matcher)
+        refresh = getattr(engine, "refresh", None)
+        if refresh is None:
+            return
+        try:
+            await self.loop.run_in_executor(None, refresh)
+        except Exception as exc:
+            # lazy refresh on first batch remains the fallback
+            if self.log is not None:
+                self.log.warn("boot-time matcher compile failed",
+                              error=repr(exc)[:200])
 
     async def close(self) -> None:
         if not self._running:

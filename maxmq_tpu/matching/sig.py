@@ -1498,9 +1498,18 @@ class SigEngine(OverlayedEngine):
         cls = SigEngine
         if n >= self.GC_FREEZE_MIN_SUBS and n >= 2 * cls._frozen_subs:
             import gc
-            # collect first: freeze() moves EVERYTHING tracked into the
-            # permanent generation, including any collectable cycles
-            # alive right now (e.g. a rotated-out snapshot whose
+            # On a GROWTH step everything previously frozen comes back
+            # out first: cycles formed through frozen objects since the
+            # last freeze (the permanent generation is never scanned)
+            # become collectable again for exactly one collection, then
+            # the whole surviving set re-freezes. Net effect: cycle
+            # garbage among frozen objects is bounded by one growth
+            # interval instead of the process lifetime (ADR 009).
+            if cls._frozen_subs:
+                gc.unfreeze()
+            # collect before freezing: freeze() moves EVERYTHING tracked
+            # into the permanent generation, including any collectable
+            # cycles alive right now (e.g. a rotated-out snapshot whose
             # weakref.finalize must still fire) — those would otherwise
             # leak for the life of the process
             gc.collect()

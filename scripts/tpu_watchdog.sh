@@ -24,12 +24,12 @@ while :; do
         touch "$FLAG"
         MAXMQ_BENCH_CONFIGS="${MAXMQ_BENCH_CONFIGS:-1,2,3,4,4h,lat,lath}" \
             timeout 7200 python bench.py \
-            > "/tmp/bench_r04_live_$n.json" 2> "/tmp/bench_r04_live_$n.err"
+            > "/tmp/bench_r05_live_$n.json" 2> "/tmp/bench_r05_live_$n.err"
         rc=$?
         rm -f "$FLAG"
         echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) capture #$n rc=$rc" >> "$LOG"
-        if [ "$rc" -eq 0 ] && [ -s "/tmp/bench_r04_live_$n.json" ]; then
-            cp "/tmp/bench_r04_live_$n.json" /tmp/bench_r04_live.json
+        if [ "$rc" -eq 0 ] && [ -s "/tmp/bench_r05_live_$n.json" ]; then
+            cp "/tmp/bench_r05_live_$n.json" /tmp/bench_r05_live.json
             echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) capture good - done" >> "$LOG"
             exit 0
         fi

@@ -17,6 +17,7 @@ well-formed (staleness there is bounded by the overlay contract, which
 test_sig_parity's randomized_churn_parity pins sequentially).
 """
 
+import dataclasses
 import random
 import threading
 import time
@@ -63,6 +64,29 @@ def _seed(idx, n=1500, clients=200, seed=3) -> None:
 def _as_set(r):
     to_set = getattr(r, "to_set", None)
     return to_set() if to_set is not None else r
+
+
+_SUB_FIELDS = frozenset(
+    f.name for f in dataclasses.fields(Subscription))
+
+
+def _assert_no_grafted_referents(engine, topics):
+    """Sampled enforcement of the no-cycles contract (ADR 009): intents
+    results are untracked by the GC, so a consumer that grafts a
+    reference onto a shared Subscription record would create a silent
+    permanent leak instead of collectable garbage. Sample the cached
+    records a real batch returns and assert they hold only their
+    declared dataclass fields, with ``identifiers`` still a pure
+    str->int map — any foreign attribute or grafted object fails
+    loudly here instead of leaking silently in production."""
+    for res in engine.subscribers_fixed_batch(topics):
+        subs = _as_set(res).subscriptions
+        for rec in subs.values():
+            extra = set(vars(rec)) - _SUB_FIELDS
+            assert not extra, f"grafted attributes on Subscription: {extra}"
+            for k, v in rec.identifiers.items():
+                assert type(k) is str and type(v) is int, (
+                    f"identifiers polluted: {k!r} -> {type(v)}")
 
 
 def _storm(engine, idx, duration_s: float, n_readers: int,
@@ -147,6 +171,8 @@ def test_threaded_churn_sig_intents():
     assert not errors, errors
     assert total > 5, "storm produced too few batches to mean anything"
     assert checked > 0, "no quiescent window ever checked parity"
+    rng = random.Random(7)
+    _assert_no_grafted_referents(eng, [_rand_topic(rng) for _ in range(64)])
 
 
 def test_threaded_churn_sig_sets():
@@ -191,6 +217,9 @@ def test_threaded_churn_sig_chained():
         eng.refresh(force=True)
         got = eng.subscribers_fixed_batch(["s0/a/b"])
         assert getattr(got[0], "chained", False), repr(got[0])
+        rng = random.Random(11)
+        _assert_no_grafted_referents(
+            eng, ["s0/a/b"] + [_rand_topic(rng) for _ in range(32)])
     finally:
         mod._set_chain_params(64, 1, 1)
 

@@ -720,7 +720,8 @@ def test_intents_chained_base_parity():
     merged qos/identifiers), n, len, has_client, to_set, $share maps."""
     _native_mod()
     idx = TopicIndex()
-    # fat '#' bucket well past kChainMinBase (96)
+    # fat '#' bucket well past g_chain_min_base (default 64,
+    # native/maxmq_decode.cpp)
     for i in range(150):
         idx.subscribe(f"fat{i}", Subscription(filter="iot/dev/#", qos=1))
     # thin rows; fat3/fat5 overlap the fat row -> overrides (merged
