@@ -6,7 +6,7 @@
 
 PY ?= python
 
-.PHONY: all check lint cyclo test coverage native bench clean hooks
+.PHONY: all check lint cyclo test test-asan coverage native bench clean hooks
 
 all: check
 
@@ -26,6 +26,36 @@ coverage:
 
 native:
 	$(MAKE) -C native
+
+# ASAN gate for the native boundary (the reference runs its unit tests
+# with the Go race detector on every invocation, Makefile:105; the C
+# extension's refcount/lifetime discipline gets the equivalent here).
+# LD_PRELOAD because the python binary itself is not ASAN-built;
+# detect_leaks=0 because CPython intentionally leaks at interpreter
+# exit and the interceptor would drown real findings in that noise.
+# libstdc++ is preloaded alongside libasan: python itself links no C++
+# runtime, so at preload-init dlsym(RTLD_NEXT, "__cxa_throw") finds
+# nothing and the interceptor CHECK-fails the first time a dlopen'd
+# C++ library (jaxlib) throws. Loading libstdc++ up front fixes the
+# symbol resolution order.
+ASAN_LIB = $(shell $(CXX) -print-file-name=libasan.so)
+STDCXX_LIB = $(shell $(CXX) -print-file-name=libstdc++.so.6)
+test-asan:
+	$(MAKE) -C native asan
+	# preflight: the gate must FAIL, not silently skip, if the
+	# instrumented extensions don't load under the ASAN runtime
+	LD_PRELOAD="$(ASAN_LIB) $(STDCXX_LIB)" \
+	ASAN_OPTIONS=detect_leaks=0:abort_on_error=1 \
+	MAXMQ_NATIVE_DIR=$(CURDIR)/native/asan \
+	$(PY) -c "from maxmq_tpu import native; \
+	    assert native.available(), 'asan ctypes lib failed to load'; \
+	    assert native.decode_module(build=False), 'asan decode ext failed to load'"
+	LD_PRELOAD="$(ASAN_LIB) $(STDCXX_LIB)" \
+	ASAN_OPTIONS=detect_leaks=0:abort_on_error=1 \
+	MAXMQ_NATIVE_DIR=$(CURDIR)/native/asan \
+	JAX_PLATFORMS=cpu \
+	$(PY) -m pytest tests/test_sig_parity.py tests/test_churn_stress.py \
+	    tests/test_native.py -x -q
 
 bench:
 	$(PY) bench.py

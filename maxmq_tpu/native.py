@@ -23,8 +23,8 @@ import threading
 
 import numpy as np
 
-_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "native")
+_NATIVE_DIR = os.environ.get("MAXMQ_NATIVE_DIR") or os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
 _SO_PATH = os.path.join(_NATIVE_DIR, "libmaxmq_native.so")
 
 _lib = None
@@ -40,7 +40,10 @@ def _try_load():
         _load_attempted = True
         if os.environ.get("MAXMQ_NO_NATIVE"):
             return None
-        if not os.path.exists(_SO_PATH) and os.path.isdir(_NATIVE_DIR):
+        # on-demand build only where a Makefile exists — an override dir
+        # (MAXMQ_NATIVE_DIR, e.g. native/asan) holds prebuilt .so only
+        if (not os.path.exists(_SO_PATH)
+                and os.path.exists(os.path.join(_NATIVE_DIR, "Makefile"))):
             try:
                 subprocess.run(["make", "-C", _NATIVE_DIR, "-s"],
                                check=True, capture_output=True, timeout=120)
@@ -139,7 +142,8 @@ def decode_module(build: bool = True):
             return None
         path = os.path.join(_NATIVE_DIR, "maxmq_decode.so")
         if not os.path.exists(path):
-            if not build or not os.path.isdir(_NATIVE_DIR):
+            if (not build or not os.path.exists(
+                    os.path.join(_NATIVE_DIR, "Makefile"))):
                 return None            # stay retriable for build=True
             try:
                 subprocess.run(["make", "-C", _NATIVE_DIR, "-s",
