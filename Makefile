@@ -55,7 +55,7 @@ test-asan:
 	MAXMQ_NATIVE_DIR=$(CURDIR)/native/asan \
 	JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/test_sig_parity.py tests/test_churn_stress.py \
-	    tests/test_native.py -x -q
+	    tests/test_native.py tests/test_refdecode.py -x -q
 
 bench:
 	$(PY) bench.py

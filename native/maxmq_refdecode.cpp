@@ -1,0 +1,691 @@
+// maxmq_refdecode — an INDEPENDENT MQTT wire-format decoder used only to
+// differentially validate the production codec (maxmq_tpu/protocol/).
+//
+// Role (VERDICT r4 #6): the reference validates its codec against a
+// foreign implementation (Eclipse Paho, tests/system/mqtt_test.go:35-253
+// and the engine's interop-suite claim). No second MQTT implementation is
+// installable in this image, so this file is the strongest available
+// substitute: a decoder-only re-derivation of the OASIS MQTT 3.1.1
+// (mqtt-v3.1.1-os) and 5.0 (mqtt-v5.0-os) specifications — plus the
+// 3.1 "MQIsdp" dialect — in a different language, sharing ZERO code,
+// tables, or constants with maxmq_tpu/protocol/{codec,packets,
+// properties}.py. The differential fuzzer (tests/test_refdecode.py)
+// decodes every conformance-corpus case and thousands of randomized /
+// mutated packets through both and requires byte-identical canonical
+// output (or agreement that the bytes are invalid).
+//
+// Deliberately NOT shared with the production codec: this file reads
+// the spec's tables (2.2.2 property identifiers, 3.x packet layouts)
+// directly into switch statements; a transcription error here that
+// disagrees with protocol/ is exactly what the fuzzer exists to surface.
+//
+// Canonical output format (the comparison contract, mirrored by the
+// canonicalizer in tests/test_refdecode.py): "key=value\n" lines in a
+// fixed order; strings/bytes as lowercase hex; properties as
+// "p.<id>=<v>" ascending by id (will-properties "w.p.<id>=<v>");
+// empty-string/empty-bytes property values canonicalize to absent,
+// matching the production encoder's absence semantics.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- reader
+
+struct Reader {
+  const uint8_t *p;
+  int64_t len;
+  int64_t off = 0;
+  bool err = false;
+
+  bool need(int64_t n) {
+    if (err || off + n > len) {
+      err = true;
+      return false;
+    }
+    return true;
+  }
+  uint8_t u8() {
+    if (!need(1)) return 0;
+    return p[off++];
+  }
+  uint16_t u16() {
+    if (!need(2)) return 0;
+    uint16_t v = (uint16_t)((p[off] << 8) | p[off + 1]);
+    off += 2;
+    return v;
+  }
+  uint32_t u32() {
+    if (!need(4)) return 0;
+    uint32_t v = ((uint32_t)p[off] << 24) | ((uint32_t)p[off + 1] << 16) |
+                 ((uint32_t)p[off + 2] << 8) | (uint32_t)p[off + 3];
+    off += 4;
+    return v;
+  }
+  // Variable Byte Integer, spec 1.5.5: at most 4 bytes; non-minimal
+  // encodings are accepted (the spec forbids ENCODERS from emitting
+  // them but places no requirement on decoders; the production codec
+  // and the Go reference both accept them).
+  uint32_t varint() {
+    uint32_t v = 0;
+    for (int i = 0; i < 4; i++) {
+      if (!need(1)) return 0;
+      uint8_t b = p[off++];
+      v |= (uint32_t)(b & 0x7F) << (7 * i);
+      if (!(b & 0x80)) return v;
+    }
+    err = true;  // continuation bit on the 4th byte: malformed (1.5.5)
+    return 0;
+  }
+  // length-prefixed binary data, spec 1.5.6
+  bool bin(const uint8_t **out, int64_t *n) {
+    uint16_t ln = u16();
+    if (!need(ln)) return false;
+    *out = p + off;
+    *n = ln;
+    off += ln;
+    return true;
+  }
+};
+
+// UTF-8 validity per spec 1.5.4: well-formed UTF-8, no U+0000, no
+// UTF-16 surrogates (U+D800..U+DFFF), no overlong encodings, max
+// U+10FFFF. (Noncharacters U+FFFE/U+FFFF "should not" appear — not a
+// MUST, so they are accepted, as the production codec accepts them.)
+bool utf8_ok(const uint8_t *s, int64_t n) {
+  int64_t i = 0;
+  while (i < n) {
+    uint8_t b = s[i];
+    if (b == 0x00) return false;
+    if (b < 0x80) {
+      i++;
+    } else if ((b & 0xE0) == 0xC0) {
+      if (i + 1 >= n || (s[i + 1] & 0xC0) != 0x80) return false;
+      uint32_t cp = ((b & 0x1Fu) << 6) | (s[i + 1] & 0x3Fu);
+      if (cp < 0x80) return false;  // overlong
+      i += 2;
+    } else if ((b & 0xF0) == 0xE0) {
+      if (i + 2 >= n || (s[i + 1] & 0xC0) != 0x80 ||
+          (s[i + 2] & 0xC0) != 0x80)
+        return false;
+      uint32_t cp = ((b & 0x0Fu) << 12) | ((s[i + 1] & 0x3Fu) << 6) |
+                    (s[i + 2] & 0x3Fu);
+      if (cp < 0x800) return false;                  // overlong
+      if (cp >= 0xD800 && cp <= 0xDFFF) return false;  // surrogate
+      i += 3;
+    } else if ((b & 0xF8) == 0xF0) {
+      if (i + 3 >= n || (s[i + 1] & 0xC0) != 0x80 ||
+          (s[i + 2] & 0xC0) != 0x80 || (s[i + 3] & 0xC0) != 0x80)
+        return false;
+      uint32_t cp = ((b & 0x07u) << 18) | ((s[i + 1] & 0x3Fu) << 12) |
+                    ((s[i + 2] & 0x3Fu) << 6) | (s[i + 3] & 0x3Fu);
+      if (cp < 0x10000 || cp > 0x10FFFF) return false;  // overlong / range
+      i += 4;
+    } else {
+      return false;  // stray continuation byte or 0xF8+
+    }
+  }
+  return true;
+}
+
+// UTF-8 string (1.5.4): length-prefixed + validity
+bool str(Reader &r, const uint8_t **out, int64_t *n) {
+  if (!r.bin(out, n)) return false;
+  if (!utf8_ok(*out, *n)) {
+    r.err = true;
+    return false;
+  }
+  return true;
+}
+
+// ------------------------------------------------------------- canonical
+
+void emit_kv(std::string &out, const char *k, int64_t v) {
+  char buf[48];
+  snprintf(buf, sizeof buf, "%s=%lld\n", k, (long long)v);
+  out += buf;
+}
+
+void emit_hex_nonl(std::string &out, const uint8_t *p, int64_t n) {
+  static const char *hexd = "0123456789abcdef";
+  for (int64_t i = 0; i < n; i++) {
+    out += hexd[p[i] >> 4];
+    out += hexd[p[i] & 0xF];
+  }
+}
+
+void emit_khex(std::string &out, const char *k, const uint8_t *p, int64_t n) {
+  out += k;
+  out += '=';
+  emit_hex_nonl(out, p, n);
+  out += '\n';
+}
+
+void emit_khex(std::string &out, const char *k, const std::string &s) {
+  emit_khex(out, k, (const uint8_t *)s.data(), (int64_t)s.size());
+}
+
+// ------------------------------------------------------------ properties
+
+// Control packet type codes, spec table 2-1 (re-derived, not imported).
+enum {
+  kConnect = 1,
+  kConnack = 2,
+  kPublish = 3,
+  kPuback = 4,
+  kPubrec = 5,
+  kPubrel = 6,
+  kPubcomp = 7,
+  kSubscribe = 8,
+  kSuback = 9,
+  kUnsubscribe = 10,
+  kUnsuback = 11,
+  kPingreq = 12,
+  kPingresp = 13,
+  kDisconnect = 14,
+  kAuth = 15,
+};
+// Will-properties context marker for the validity check (spec 3.1.3.2).
+constexpr int kWillCtx = 0;
+
+// Property validity, spec 5.0 table 2-4 ("Valid Packets" column),
+// encoded as a bitmask over packet-type codes; bit 0 = will properties.
+uint32_t prop_mask(uint32_t pid) {
+  auto M = [](std::initializer_list<int> types) {
+    uint32_t m = 0;
+    for (int t : types) m |= 1u << t;
+    return m;
+  };
+  switch (pid) {
+    case 0x01: return M({kPublish, kWillCtx});             // Payload Format
+    case 0x02: return M({kPublish, kWillCtx});             // Message Expiry
+    case 0x03: return M({kPublish, kWillCtx});             // Content Type
+    case 0x08: return M({kPublish, kWillCtx});             // Response Topic
+    case 0x09: return M({kPublish, kWillCtx});             // Correlation Data
+    case 0x0B: return M({kPublish, kSubscribe});           // Subscription Id
+    case 0x11: return M({kConnect, kConnack, kDisconnect});  // Session Expiry
+    case 0x12: return M({kConnack});                       // Assigned Client Id
+    case 0x13: return M({kConnack});                       // Server Keep Alive
+    case 0x15: return M({kConnect, kConnack, kAuth});      // Auth Method
+    case 0x16: return M({kConnect, kConnack, kAuth});      // Auth Data
+    case 0x17: return M({kConnect});                       // Req Problem Info
+    case 0x18: return M({kWillCtx});                       // Will Delay
+    case 0x19: return M({kConnect});                       // Req Response Info
+    case 0x1A: return M({kConnack});                       // Response Info
+    case 0x1C: return M({kConnack, kDisconnect});          // Server Reference
+    case 0x1F:
+      return M({kConnack, kPuback, kPubrec, kPubrel, kPubcomp, kSuback,
+                kUnsuback, kDisconnect, kAuth});           // Reason String
+    case 0x21: return M({kConnect, kConnack});             // Receive Maximum
+    case 0x22: return M({kConnect, kConnack});             // Topic Alias Max
+    case 0x23: return M({kPublish});                       // Topic Alias
+    case 0x24: return M({kConnack});                       // Maximum QoS
+    case 0x25: return M({kConnack});                       // Retain Available
+    case 0x26:
+      return M({kConnect, kConnack, kPublish, kPuback, kPubrec, kPubrel,
+                kPubcomp, kSubscribe, kSuback, kUnsubscribe, kUnsuback,
+                kDisconnect, kAuth, kWillCtx});            // User Property
+    case 0x27: return M({kConnect, kConnack});             // Max Packet Size
+    case 0x28: return M({kConnack});                       // Wildcard Sub Avail
+    case 0x29: return M({kConnack});                       // Sub Id Available
+    case 0x2A: return M({kConnack});                       // Shared Sub Avail
+    default: return 0;
+  }
+}
+
+struct Props {
+  // -1 = absent for integer-valued properties (all values fit 32 bits)
+  int64_t vals[0x2B];
+  bool has_str[0x2B];
+  std::string strs[0x2B];  // string/binary-valued property payloads
+  std::vector<uint32_t> sub_ids;
+  std::vector<std::pair<std::string, std::string>> user_props;
+
+  Props() {
+    for (auto &v : vals) v = -1;
+    for (auto &h : has_str) h = false;
+  }
+};
+
+bool is_str_prop(uint32_t pid) {
+  switch (pid) {
+    case 0x03: case 0x08: case 0x09: case 0x12: case 0x15: case 0x16:
+    case 0x1A: case 0x1C: case 0x1F:
+      return true;
+    default:
+      return false;
+  }
+}
+// binary-data properties (no UTF-8 requirement), spec table 2-4 types
+bool is_bin_prop(uint32_t pid) { return pid == 0x09 || pid == 0x16; }
+
+// integer width per property id (1, 2, 4 bytes, or 0 for varint)
+int int_prop_width(uint32_t pid) {
+  switch (pid) {
+    case 0x01: case 0x17: case 0x19: case 0x24: case 0x25: case 0x28:
+    case 0x29: case 0x2A:
+      return 1;
+    case 0x13: case 0x21: case 0x22: case 0x23:
+      return 2;
+    case 0x02: case 0x11: case 0x18: case 0x27:
+      return 4;
+    default:
+      return -1;
+  }
+}
+
+// Decode one property block (spec 2.2.2): length varint + properties.
+// ctx is the packet-type code, or kWillCtx for the will block.
+bool decode_props(Reader &r, int ctx, Props &out) {
+  uint32_t plen = r.varint();
+  if (r.err) return false;
+  int64_t end = r.off + plen;
+  if (end > r.len) {
+    r.err = true;
+    return false;
+  }
+  bool seen[0x2B] = {false};
+  while (r.off < end) {
+    uint32_t pid = r.varint();
+    if (r.err) return false;
+    if (pid > 0x2A || !(prop_mask(pid) & (1u << ctx))) {
+      r.err = true;  // unknown / invalid-in-this-packet property
+      return false;
+    }
+    // 2.2.2.2: a property may appear at most once, except User
+    // Property; Subscription Identifier repeats in PUBLISH delivery
+    if (pid != 0x26 && pid != 0x0B) {
+      if (seen[pid]) {
+        r.err = true;
+        return false;
+      }
+      seen[pid] = true;
+    }
+    if (pid == 0x0B) {  // Subscription Identifier: varint, nonzero
+      uint32_t sid = r.varint();
+      if (r.err) return false;
+      if (sid == 0) {
+        r.err = true;
+        return false;
+      }
+      out.sub_ids.push_back(sid);
+    } else if (pid == 0x26) {  // User Property: two UTF-8 strings
+      const uint8_t *k;
+      int64_t kn;
+      const uint8_t *v;
+      int64_t vn;
+      if (!str(r, &k, &kn) || !str(r, &v, &vn)) return false;
+      out.user_props.emplace_back(std::string((const char *)k, kn),
+                                  std::string((const char *)v, vn));
+    } else if (is_str_prop(pid)) {
+      const uint8_t *s;
+      int64_t n;
+      if (is_bin_prop(pid)) {
+        if (!r.bin(&s, &n)) return false;
+      } else {
+        if (!str(r, &s, &n)) return false;
+      }
+      out.has_str[pid] = true;
+      out.strs[pid].assign((const char *)s, n);
+    } else {
+      int w = int_prop_width(pid);
+      int64_t v;
+      if (w == 1) v = r.u8();
+      else if (w == 2) v = r.u16();
+      else v = r.u32();
+      if (r.err) return false;
+      // value constraints the production codec also enforces at decode
+      if (pid == 0x21 && v == 0) r.err = true;  // Receive Max 0 (3.1.2.11.3)
+      if (pid == 0x23 && v == 0) r.err = true;  // Topic Alias 0 (3.3.2.3.4)
+      if (pid == 0x27 && v == 0) r.err = true;  // Max Packet Size 0
+      if (pid == 0x24 && v > 1) r.err = true;   // Maximum QoS in {0,1}
+      if (r.err) return false;
+      out.vals[pid] = v;
+    }
+  }
+  if (r.off != end) {  // property value crossed the declared block end
+    r.err = true;
+    return false;
+  }
+  return true;
+}
+
+void emit_props(std::string &out, const Props &p, const char *prefix) {
+  for (uint32_t pid = 1; pid <= 0x2A; pid++) {
+    char key[24];
+    snprintf(key, sizeof key, "%sp.%u", prefix, pid);
+    if (pid == 0x0B) {
+      for (uint32_t sid : p.sub_ids) emit_kv(out, key, sid);
+    } else if (pid == 0x26) {
+      for (const auto &kv : p.user_props) {
+        out += key;
+        out += '=';
+        emit_hex_nonl(out, (const uint8_t *)kv.first.data(),
+                      (int64_t)kv.first.size());
+        out += ',';
+        emit_hex_nonl(out, (const uint8_t *)kv.second.data(),
+                      (int64_t)kv.second.size());
+        out += '\n';
+      }
+    } else if (is_str_prop(pid)) {
+      // empty values canonicalize to absent (comparison contract)
+      if (p.has_str[pid] && !p.strs[pid].empty())
+        emit_khex(out, key, p.strs[pid]);
+    } else if (p.vals[pid] >= 0) {
+      emit_kv(out, key, p.vals[pid]);
+    }
+  }
+}
+
+// ------------------------------------------------------------- per-type
+
+bool dec_connect(Reader &r, std::string &out) {
+  const uint8_t *nm;
+  int64_t nn;
+  if (!str(r, &nm, &nn)) return false;
+  uint8_t ver = r.u8();
+  if (r.err) return false;
+  // 3.1.2.1/3.1.2.2 + the 3.1 dialect: name/level pairs
+  bool known = (ver == 3 && nn == 6 && !memcmp(nm, "MQIsdp", 6)) ||
+               ((ver == 4 || ver == 5) && nn == 4 && !memcmp(nm, "MQTT", 4));
+  if (!known) return false;
+  bool v5 = ver == 5;
+  uint8_t flags = r.u8();
+  if (r.err) return false;
+  if (flags & 0x01) return false;  // reserved bit [MQTT-3.1.2-3]
+  bool clean = flags & 0x02;
+  bool will_flag = flags & 0x04;
+  uint8_t will_qos = (flags >> 3) & 0x3;
+  bool will_retain = flags & 0x20;
+  bool pass_flag = flags & 0x40;
+  bool user_flag = flags & 0x80;
+  if (!will_flag && (will_qos || will_retain)) return false;  // 3.1.2-11..15
+  if (will_qos > 2) return false;                             // 3.1.2-14
+  // [MQTT-3.1.2-22] (3.1.1): password requires username; v5 lifts it
+  if (pass_flag && !user_flag && !v5) return false;
+  uint16_t keepalive = r.u16();
+  if (r.err) return false;
+  Props props;
+  if (v5 && !decode_props(r, kConnect, props)) return false;
+  const uint8_t *cid;
+  int64_t cidn;
+  if (!str(r, &cid, &cidn)) return false;
+
+  emit_kv(out, "v", ver);
+  emit_kv(out, "clean", clean ? 1 : 0);
+  emit_kv(out, "ka", keepalive);
+  emit_props(out, props, "");
+  emit_khex(out, "cid", cid, cidn);
+  if (will_flag) {
+    Props wprops;
+    if (v5 && !decode_props(r, kWillCtx, wprops)) return false;
+    const uint8_t *wt;
+    int64_t wtn;
+    if (!str(r, &wt, &wtn)) return false;
+    const uint8_t *wp;
+    int64_t wpn;
+    if (!r.bin(&wp, &wpn)) return false;
+    if (wtn == 0) return false;  // empty will topic
+    emit_kv(out, "w", 1);
+    emit_kv(out, "w.qos", will_qos);
+    emit_kv(out, "w.retain", will_retain ? 1 : 0);
+    emit_props(out, wprops, "w.");
+    emit_khex(out, "w.topic", wt, wtn);
+    emit_khex(out, "w.payload", wp, wpn);
+  }
+  emit_kv(out, "uf", user_flag ? 1 : 0);
+  if (user_flag) {
+    const uint8_t *u;
+    int64_t un;
+    if (!r.bin(&u, &un)) return false;
+    emit_khex(out, "un", u, un);
+  }
+  emit_kv(out, "pf", pass_flag ? 1 : 0);
+  if (pass_flag) {
+    const uint8_t *pw;
+    int64_t pn;
+    if (!r.bin(&pw, &pn)) return false;
+    emit_khex(out, "pw", pw, pn);
+  }
+  if (r.off != r.len) return false;  // trailing bytes after payload
+  return true;
+}
+
+bool dec_publish(Reader &r, bool v5, int qos, std::string &out) {
+  const uint8_t *t;
+  int64_t tn;
+  if (!str(r, &t, &tn)) return false;
+  int64_t pid = 0;
+  if (qos > 0) {
+    pid = r.u16();
+    if (r.err) return false;
+    if (pid == 0) return false;  // [MQTT-2.3.1-1]
+  }
+  Props props;
+  if (v5 && !decode_props(r, kPublish, props)) return false;
+  emit_khex(out, "topic", t, tn);
+  emit_kv(out, "pid", pid);
+  emit_props(out, props, "");
+  emit_khex(out, "pl", r.p + r.off, r.len - r.off);
+  return true;
+}
+
+bool dec_sub_unsub(Reader &r, bool v5, bool subscribe, std::string &out) {
+  int64_t pid = r.u16();
+  if (r.err) return false;
+  if (pid == 0) return false;  // [MQTT-2.3.1-1]
+  Props props;
+  if (v5 &&
+      !decode_props(r, subscribe ? kSubscribe : kUnsubscribe, props))
+    return false;
+  if (subscribe && props.sub_ids.size() > 1) return false;
+  emit_kv(out, "pid", pid);
+  emit_props(out, props, "");
+  int nfilters = 0;
+  while (r.off < r.len) {
+    const uint8_t *f;
+    int64_t fn;
+    if (!str(r, &f, &fn)) return false;
+    if (subscribe) {
+      uint8_t opts = r.u8();
+      if (r.err) return false;  // filter missing options byte
+      if ((opts & 0x3) == 3) return false;  // QoS 3 [MQTT-3.8.3-4]
+      if (v5) {
+        if (opts & 0xC0) return false;         // reserved bits (3.8.3.1)
+        if (((opts >> 4) & 0x3) == 3) return false;  // retain handling 3
+      } else {
+        if (opts & 0xFC) return false;  // 3.1.1: upper 6 bits reserved
+      }
+      out += "f=";
+      emit_hex_nonl(out, f, fn);
+      char buf[32];
+      if (v5)
+        snprintf(buf, sizeof buf, ",%d,%d,%d,%d\n", opts & 0x3,
+                 (opts >> 2) & 1, (opts >> 3) & 1, (opts >> 4) & 0x3);
+      else
+        snprintf(buf, sizeof buf, ",%d,0,0,0\n", opts & 0x3);
+      out += buf;
+    } else {
+      emit_khex(out, "f", f, fn);
+    }
+    nfilters++;
+  }
+  if (nfilters == 0) return false;  // [MQTT-3.8.3-3] / [MQTT-3.10.3-2]
+  return true;
+}
+
+}  // namespace
+
+// --------------------------------------------------------------- C ABI
+
+// Decode one packet: first_byte + declared remaining length + body.
+// proto_ver is the session protocol level (3, 4, or 5); a CONNECT body
+// carries its own. Writes the canonical text form to out.
+// Returns: >=0 canonical length; -1 reject (malformed / protocol
+// error); -2 out buffer too small.
+extern "C" int64_t mq_ref_decode(uint8_t first_byte, int64_t remaining,
+                                 const uint8_t *body, int64_t body_len,
+                                 int32_t proto_ver, char *out,
+                                 int64_t out_cap) {
+  int type = (first_byte >> 4) & 0xF;
+  int flags = first_byte & 0xF;
+  bool v5 = proto_ver == 5;
+
+  // fixed-header flag rules, spec table 2-2
+  int qos = 0;
+  bool dup = false, retain = false;
+  if (type == kPublish) {
+    dup = flags & 0x8;
+    qos = (flags >> 1) & 0x3;
+    retain = flags & 0x1;
+    if (qos == 3) return -1;  // [MQTT-3.3.1-4]
+    // dup on a QoS-0 message violates the SENDER rule [MQTT-3.3.1-2];
+    // receivers tolerate it (mochi's TPublishDup is a pass case)
+  } else {
+    int required;
+    switch (type) {
+      case kConnect: case kConnack: case kPuback: case kPubrec:
+      case kPubcomp: case kSuback: case kUnsuback: case kPingreq:
+      case kPingresp: case kDisconnect: case kAuth:
+        required = 0;
+        break;
+      case kPubrel: case kSubscribe: case kUnsubscribe:
+        required = 2;  // spec table 2-2: bit 1 set
+        break;
+      default:
+        return -1;  // reserved packet type 0
+    }
+    if (flags != required) return -1;
+  }
+  if (remaining > body_len) return -1;  // truncated body
+
+  Reader r{body, body_len};
+  std::string canon;
+  emit_kv(canon, "t", type);
+  if (type == kPublish) {
+    emit_kv(canon, "dup", dup ? 1 : 0);
+    emit_kv(canon, "qos", qos);
+    emit_kv(canon, "retain", retain ? 1 : 0);
+  }
+
+  bool ok = true;
+  Props props;
+  switch (type) {
+    case kConnect:
+      ok = dec_connect(r, canon);
+      break;
+    case kConnack: {
+      uint8_t ack = r.u8();
+      uint8_t rc = r.u8();
+      if (r.err) {
+        ok = false;
+        break;
+      }
+      emit_kv(canon, "sp", ack & 0x1);  // bit 0; upper bits tolerated
+      emit_kv(canon, "rc", rc);
+      if (v5) ok = decode_props(r, kConnack, props);
+      if (ok) emit_props(canon, props, "");
+      break;
+    }
+    case kPublish:
+      ok = dec_publish(r, v5, qos, canon);
+      break;
+    case kPuback:
+    case kPubrec:
+    case kPubrel:
+    case kPubcomp: {
+      int64_t pid = r.u16();
+      if (r.err) {
+        ok = false;
+        break;
+      }
+      int64_t rc = 0;
+      if (v5 && r.len > r.off) {
+        rc = r.u8();
+        if (r.len > r.off) ok = decode_props(r, type, props);
+      }
+      emit_kv(canon, "pid", pid);
+      emit_kv(canon, "rc", rc);
+      if (ok) emit_props(canon, props, "");
+      break;
+    }
+    case kSubscribe:
+      ok = dec_sub_unsub(r, v5, true, canon);
+      break;
+    case kUnsubscribe:
+      ok = dec_sub_unsub(r, v5, false, canon);
+      break;
+    case kSuback: {
+      int64_t pid = r.u16();
+      if (r.err) {
+        ok = false;
+        break;
+      }
+      if (v5) ok = decode_props(r, kSuback, props);
+      if (!ok) break;
+      emit_kv(canon, "pid", pid);
+      emit_props(canon, props, "");
+      emit_khex(canon, "rcs", r.p + r.off, r.len - r.off);
+      break;
+    }
+    case kUnsuback: {
+      int64_t pid = r.u16();
+      if (r.err) {
+        ok = false;
+        break;
+      }
+      emit_kv(canon, "pid", pid);
+      if (v5) {
+        ok = decode_props(r, kUnsuback, props);
+        if (!ok) break;
+        emit_props(canon, props, "");
+        emit_khex(canon, "rcs", r.p + r.off, r.len - r.off);
+      }
+      // 3.1.1: UNSUBACK carries no payload; trailing bytes tolerated
+      break;
+    }
+    case kPingreq:
+    case kPingresp:
+      break;  // no variable header, no payload
+    case kDisconnect: {
+      int64_t rc = 0;
+      if (v5 && r.len > 0) {
+        rc = r.u8();
+        if (r.err) {
+          ok = false;
+          break;
+        }
+        if (r.len > 1) ok = decode_props(r, kDisconnect, props);
+      }
+      emit_kv(canon, "rc", rc);
+      if (ok) emit_props(canon, props, "");
+      break;
+    }
+    case kAuth: {
+      if (!v5) return -1;  // type 15 reserved before MQTT 5
+      int64_t rc = 0;
+      if (r.len > 0) {
+        rc = r.u8();
+        if (r.err) {
+          ok = false;
+          break;
+        }
+        if (r.len > 1) ok = decode_props(r, kAuth, props);
+      }
+      emit_kv(canon, "rc", rc);
+      if (ok) emit_props(canon, props, "");
+      break;
+    }
+    default:
+      return -1;
+  }
+  if (!ok || r.err) return -1;
+  if ((int64_t)canon.size() > out_cap) return -2;
+  memcpy(out, canon.data(), canon.size());
+  return (int64_t)canon.size();
+}
