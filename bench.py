@@ -457,6 +457,27 @@ def _bench_config_timed(name, engine, index, batches, batch, iters,
     engine.route_small = saved_route
     engine.emit_intents = True
 
+    # hook-present fan-out boundary (VERDICT r4 #4): an installed
+    # on_select_subscribers / persistence consumer rides intents ->
+    # select_set() (one C-side materialization; re-hit row sets cache
+    # the twin and pay a dict copy) -> the modify chain — never a
+    # per-record deep copy and never the merged-set decode path.
+    # Mirrors Broker._select_subscribers' default tier exactly.
+    def run_hooked(bs):
+        total = 0
+        for b in bs:
+            for res in engine.subscribers_fixed_batch(b):
+                ss = getattr(res, "select_set", None)
+                sel = ss() if ss is not None else res.select_copy()
+                sel.subscriptions.pop("hooked-absent", None)  # the hook
+                total += len(sel.subscriptions)
+        return total
+
+    run_hooked(batches[:1])        # warm engine caches + mark re-hits
+    t0 = time.perf_counter()
+    run_hooked(batches)
+    hooked_rate = batch * iters / (time.perf_counter() - t0)
+
     # our python CPU trie on the same corpus: secondary reference point
     sample = batches[0][:2000]
     t0 = time.perf_counter()
@@ -480,6 +501,7 @@ def _bench_config_timed(name, engine, index, batches, batch, iters,
         "boundary_form": ("trie_routed" if routed
                           else "delivery_intents"),
         "mergedset_matches_per_sec": round(set_rate, 1),
+        "hooked_matches_per_sec": round(hooked_rate, 1),
         "raw_slot_matches_per_sec": round(raw_rate, 1),
         "delivered_pairs": delivered,
         "matched_rows": matched, "overflow_topics": n_over,

@@ -553,24 +553,44 @@ class Broker:
     def _select_subscribers(self, subscribers: SubscriberSet,
                             packet: Packet) -> SubscriberSet:
         """Run the on_select_subscribers modify chain without exposing
-        the (possibly cached) matched set to mutation. Hooks declaring
-        ``select_subscribers_shared_only`` (e.g. the worker-pool $share
-        ownership filter) only drop keys from the OUTER shared dict, so
-        shared-free publishes skip the per-record deep copy entirely and
-        shared ones get a shallow re-wrap."""
-        shared_only = all(
-            getattr(h, "select_subscribers_shared_only", False)
-            for h in self.hooks._overriders("on_select_subscribers"))
-        if shared_only and not subscribers.shared:
-            return subscribers
-        if shared_only:
-            sel = type(subscribers)(subscribers.subscriptions,
-                                    dict(subscribers.shared))
-            return self.hooks.modify("on_select_subscribers", sel, packet)
-        # matchers alias live Subscription records for speed; a hook may
-        # mutate both the set and its records, so it gets copies
-        return self.hooks.modify("on_select_subscribers",
-                                 subscribers.deep_copy(), packet)
+        the (possibly cached) matched set to mutation.
+
+        Accepts a materialized SubscriberSet or a DeliveryIntents
+        (ADR 007) and materializes the cheapest safe form per tier:
+
+        * ``select_subscribers_shared_only`` on every overrider (the
+          worker-pool $share ownership filter): the hook only drops
+          keys from the OUTER shared dict — shared-free publishes pass
+          the set through untouched, shared ones re-wrap that one dict.
+        * default: fresh dicts (hooks may add/drop/replace entries
+          anywhere) over ALIASED Subscription records — records are
+          immutable by contract (hooks/base.py, ADR 009; the churn
+          suite's graft check enforces it), so selection-style hooks
+          pay O(entries) dict copies built in C, never per-record
+          copies.
+        * ``select_subscribers_mutates_records`` on any overrider: the
+          hook rewrites record fields (qos downgrades etc.) and gets a
+          full ``deep_copy()`` per publish."""
+        overriders = self.hooks._overriders("on_select_subscribers")
+        intents_select = getattr(subscribers, "select_set", None)
+        if any(getattr(h, "select_subscribers_mutates_records", False)
+               for h in overriders):
+            base = (subscribers.to_set() if intents_select is not None
+                    else subscribers)
+            return self.hooks.modify("on_select_subscribers",
+                                     base.deep_copy(), packet)
+        if all(getattr(h, "select_subscribers_shared_only", False)
+               for h in overriders):
+            base = (subscribers.to_set() if intents_select is not None
+                    else subscribers)
+            if not base.shared:
+                return base
+            sel = type(base)(base.subscriptions, dict(base.shared))
+        elif intents_select is not None:
+            sel = intents_select()
+        else:
+            sel = subscribers.select_copy()
+        return self.hooks.modify("on_select_subscribers", sel, packet)
 
     def _check_publish_qos(self, client: Client, packet: Packet) -> bool:
         """Capability limits + QoS2 dedup + receive quota; False means
@@ -590,8 +610,10 @@ class Broker:
         return True
 
     def _match_cached(self, topic: str) -> SubscriberSet:
-        # safe even with on_select_subscribers hooks installed: _fan_out
-        # deep-copies the set before the only mutating hook sees it
+        # safe even with on_select_subscribers hooks installed:
+        # _select_subscribers hands hooks fresh dicts (records aliased
+        # but immutable per the ADR 009 contract; a declared
+        # select_subscribers_mutates_records hook gets a deep copy)
         version = self.topics.sub_version
         hit = self._match_cache.get(topic, version)
         if hit is not None:
@@ -742,25 +764,22 @@ class Broker:
         (ADR 007: the native decode's fan-out-ready form — iterable of
         (cid, sub) with a ``shared`` dict and ``has_client``). Intents
         skip the merged-dict materialization on the hot path; the hook
-        override path materializes via ``to_set()`` since hooks expect
-        the full SubscriberSet surface."""
+        override path materializes the cheapest safe SubscriberSet form
+        via _select_subscribers' tiers."""
         to_set = getattr(subscribers, "to_set", None)
-        if to_set is not None and self.hooks.overrides(
-                "on_select_subscribers"):
+        if self.hooks.overrides("on_select_subscribers"):
             # shared_only hooks (the worker-pool $share ownership
             # filter) only drop keys from the outer shared dict: on a
             # shared-free intents result they are identity, so the fast
             # path survives — pool deployments must not pay set
             # materialization on every publish
-            shared_only = all(
+            shared_only = to_set is not None and all(
                 getattr(h, "select_subscribers_shared_only", False)
                 for h in self.hooks._overriders("on_select_subscribers"))
             if not (shared_only and len(subscribers) == subscribers.n):
-                subscribers = to_set()
+                subscribers = self._select_subscribers(subscribers, packet)
                 to_set = None
         if to_set is None:
-            if self.hooks.overrides("on_select_subscribers"):
-                subscribers = self._select_subscribers(subscribers, packet)
             shared = subscribers.shared
             if shared:
                 plain = subscribers.subscriptions

@@ -79,6 +79,20 @@ class Hook:
 
     def on_select_subscribers(self, subscribers: "SubscriberSet",
                               packet: "Packet") -> "SubscriberSet":
+        """Intercept the matched subscriber set before shared-group
+        selection (reference: hooks.go:334-345 OnSelectSubscribers).
+
+        Contract: the set's OUTER dicts are the hook's to mutate
+        (add/drop/replace entries), but the Subscription RECORDS are
+        aliased from the matcher's caches and immutable — mutating one
+        corrupts every concurrent delivery sharing it (ADR 009; the
+        churn suite samples records for grafted state). A hook that
+        needs to rewrite record fields must set the class attribute
+        ``select_subscribers_mutates_records = True``; it then receives
+        a deep copy and pays that cost per publish. Hooks that only
+        filter $share groups can set
+        ``select_subscribers_shared_only = True`` for the cheapest
+        path."""
         return subscribers
 
     def on_unsubscribe(self, packet: "Packet", client) -> "Packet":

@@ -143,6 +143,15 @@ class SubscriberSet:
             shared={k: {c: cp(s) for c, s in m.items()}
                     for k, m in self.shared.items()})
 
+    def select_copy(self) -> "SubscriberSet":
+        """Fresh outer dicts over ALIASED records — what the
+        on_select_subscribers modify chain receives by default (hooks
+        may add/drop/replace entries; records are immutable by
+        contract, ADR 009)."""
+        return SubscriberSet(
+            subscriptions=dict(self.subscriptions),
+            shared={k: dict(m) for k, m in self.shared.items()})
+
     def add_shared(self, group: str, filter_: str, client_id: str,
                    sub: Subscription) -> None:
         self.shared.setdefault((group, filter_), {})[client_id] = sub

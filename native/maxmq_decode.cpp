@@ -247,6 +247,37 @@ PyObject *subset_deep_copy(PyObject *self_o, PyObject *) {
   return reinterpret_cast<PyObject *>(out);
 }
 
+// select_copy() — the hook modify-chain form: FRESH outer dicts (the
+// hook may add/drop/replace entries anywhere) over ALIASED Subscription
+// records (immutable by contract, ADR 009). One C call replaces the
+// per-publish python dict copies on the hook-present fan-out path.
+PyObject *subset_select_copy(PyObject *self_o, PyObject *) {
+  auto *self = reinterpret_cast<SubSetObject *>(self_o);
+  PyObject *subs = PyDict_Copy(self->subscriptions);
+  if (!subs) return nullptr;
+  PyObject *shared = PyDict_New();
+  if (!shared) {
+    Py_DECREF(subs);
+    return nullptr;
+  }
+  PyObject *k, *v;
+  Py_ssize_t pos = 0;
+  while (PyDict_Next(self->shared, &pos, &k, &v)) {
+    PyObject *m = PyDict_Copy(v);
+    if (!m || PyDict_SetItem(shared, k, m) < 0) {
+      Py_XDECREF(m);
+      Py_DECREF(subs);
+      Py_DECREF(shared);
+      return nullptr;
+    }
+    Py_DECREF(m);
+  }
+  auto *out = subset_new_fast(subs, shared);
+  Py_DECREF(subs);
+  Py_DECREF(shared);
+  return reinterpret_cast<PyObject *>(out);
+}
+
 Py_ssize_t subset_len(PyObject *self_o) {
   auto *self = reinterpret_cast<SubSetObject *>(self_o);
   Py_ssize_t n = PyDict_Size(self->subscriptions);
@@ -290,6 +321,8 @@ PyMethodDef subset_methods[] = {
      METH_FASTCALL, "Insert a shared-group candidate."},
     {"deep_copy", subset_deep_copy, METH_NOARGS,
      "Subscription-deep copy for hooks that may mutate."},
+    {"select_copy", subset_select_copy, METH_NOARGS,
+     "Fresh outer dicts over aliased records (hook modify-chain form)."},
     {nullptr, nullptr, 0, nullptr}};
 
 PyType_Slot subset_slots[] = {
@@ -363,6 +396,7 @@ struct IntentsObject {
   int32_t *ovr_slots;   // [n_ovr] base slots shadowed, ascending
   PyObject **ovr_subs;  // [n_ovr] owned merged Subscriptions
   Py_ssize_t n_ovr;
+  uint8_t sel_seen;     // select_set() ran once (cache on the re-hit)
 };
 
 // total plain entries a consumer sees (tail + base; overrides shadow)
@@ -404,6 +438,7 @@ IntentsObject *intents_alloc(PyObject *capsule, Py_ssize_t capacity) {
   self->ovr_slots = nullptr;
   self->ovr_subs = nullptr;
   self->n_ovr = 0;
+  self->sel_seen = 0;
   if (capacity) {
     // one block for all three arrays (cids | subs | owned): chain
     // tails allocate per cold topic, so two fewer malloc/free pairs
@@ -478,14 +513,12 @@ Py_ssize_t intents_len(PyObject *self_o) {
   return n;
 }
 
-// to_set() -> SubscriberSet (cached): the hook-path materialization
-PyObject *intents_to_set(PyObject *self_o, PyObject *) {
-  auto *self = reinterpret_cast<IntentsObject *>(self_o);
-  if (self->set_cache) return Py_NewRef(self->set_cache);
+// fresh plain-delivery dict: base entries first, shadowed by slot
+// overrides, then the own tail
+PyObject *intents_build_subs(const IntentsObject *self) {
   PyObject *subs = PyDict_New();
   if (!subs) return nullptr;
   if (self->base) {
-    // base entries first (overrides and tail shadow them below)
     const IntentsObject *b = self->base;
     for (Py_ssize_t j = 0; j < b->n; j++)
       if (PyDict_SetItem(subs, b->cids[j], b->subs[j]) < 0) {
@@ -504,6 +537,15 @@ PyObject *intents_to_set(PyObject *self_o, PyObject *) {
       Py_DECREF(subs);
       return nullptr;
     }
+  return subs;
+}
+
+// to_set() -> SubscriberSet (cached): the hook-path materialization
+PyObject *intents_to_set(PyObject *self_o, PyObject *) {
+  auto *self = reinterpret_cast<IntentsObject *>(self_o);
+  if (self->set_cache) return Py_NewRef(self->set_cache);
+  PyObject *subs = intents_build_subs(self);
+  if (!subs) return nullptr;
   // outer dict is fresh (callers re-wrap/copy it before dropping keys);
   // inner member dicts may be shared — consumers never mutate them
   PyObject *shared =
@@ -518,6 +560,52 @@ PyObject *intents_to_set(PyObject *self_o, PyObject *) {
   if (!res) return nullptr;
   self->set_cache = reinterpret_cast<PyObject *>(res);
   return Py_NewRef(self->set_cache);
+}
+
+// select_set() -> a fresh hook-ready SubscriberSet straight from the
+// intents arrays: new outer dicts AND new inner shared dicts (the
+// modify chain may add/drop/replace entries anywhere) over aliased
+// records. Caching policy: the FIRST call builds directly without
+// populating set_cache (a cold unique-topic stream would pay a double
+// build for a cache it never rehits); a SECOND call proves the row set
+// repeats, so it materializes the to_set() twin once and every later
+// call is a PyDict_Copy — one materialization per re-hit row set.
+PyObject *intents_select_set(PyObject *self_o, PyObject *) {
+  auto *self = reinterpret_cast<IntentsObject *>(self_o);
+  if (self->set_cache) return subset_select_copy(self->set_cache, nullptr);
+  if (self->sel_seen) {
+    PyObject *twin = intents_to_set(self_o, nullptr);
+    if (!twin) return nullptr;
+    PyObject *res = subset_select_copy(twin, nullptr);
+    Py_DECREF(twin);
+    return res;
+  }
+  self->sel_seen = 1;
+  PyObject *subs = intents_build_subs(self);
+  if (!subs) return nullptr;
+  PyObject *shared = PyDict_New();
+  if (!shared) {
+    Py_DECREF(subs);
+    return nullptr;
+  }
+  if (self->shared) {
+    PyObject *k, *v;
+    Py_ssize_t pos = 0;
+    while (PyDict_Next(self->shared, &pos, &k, &v)) {
+      PyObject *m = PyDict_Copy(v);
+      if (!m || PyDict_SetItem(shared, k, m) < 0) {
+        Py_XDECREF(m);
+        Py_DECREF(subs);
+        Py_DECREF(shared);
+        return nullptr;
+      }
+      Py_DECREF(m);
+    }
+  }
+  auto *res = subset_new_fast(subs, shared);
+  Py_DECREF(subs);
+  Py_DECREF(shared);
+  return reinterpret_cast<PyObject *>(res);
 }
 
 // has_client(cid) -> bool; linear scan (used only by the rare $share
@@ -621,6 +709,8 @@ PyObject *intents_repr(PyObject *self_o) {
 PyMethodDef intents_methods[] = {
     {"to_set", intents_to_set, METH_NOARGS,
      "Materialize (and cache) the SubscriberSet twin for hook paths."},
+    {"select_set", intents_select_set, METH_NOARGS,
+     "Fresh hook-ready SubscriberSet (new dicts, aliased records)."},
     {"has_client", intents_has_client, METH_O,
      "True when the client id has a plain (non-shared) delivery entry."},
     {nullptr, nullptr, 0, nullptr}};

