@@ -515,12 +515,62 @@ def _bench_config_timed(name, engine, index, batches, batch, iters,
     return result
 
 
+def _stage_latency_ms(engine, topics: list, batch_size: int,
+                      reps: int = 9) -> dict:
+    """Median per-stage wall time at one batch shape: host prep
+    (tokenize + pack), device round trip (upload + kernel + fetch),
+    and decode — the decomposition of a device-served batch's latency.
+    Repeats one sample batch, so decode runs cache-warm; the prep and
+    device stages are shape-bound either way."""
+    sample = (topics * (batch_size // len(topics) + 1))[:batch_size]
+    saved = engine.emit_intents
+    engine.emit_intents = True
+    prep, dev, dec = [], [], []
+    try:
+        for i in range(reps + 1):
+            t0 = time.perf_counter()
+            ctx = engine.dispatch_fixed(sample)
+            t1 = time.perf_counter()
+            if ctx[3]["kind"] == "stream":
+                # production stream path (collect_fixed's split): the
+                # fetch IS the device stage; pair assembly + union is
+                # the decode stage — no [B, max_rows] matrix detour
+                fetched = engine._fetch_stream(ctx[0])
+                t2 = time.perf_counter()
+                engine._decode_stream(sample, ctx, *fetched)
+            else:
+                cnt, rows, hr, tbl = engine.match_fixed([], out=ctx)
+                t2 = time.perf_counter()
+                engine.decode_fixed(sample, cnt, rows, hr, tbl,
+                                    ctx[4], ctx[5])
+            t3 = time.perf_counter()
+            if i == 0:
+                continue                 # first rep absorbs compile
+            prep.append(t1 - t0)
+            dev.append(t2 - t1)
+            dec.append(t3 - t2)
+    finally:
+        engine.emit_intents = saved
+    for series in (prep, dev, dec):
+        series.sort()
+    m = reps // 2
+    return {"decomposed_batch": batch_size,
+            "stage_prep_ms": round(prep[m] * 1e3, 2),
+            "stage_device_ms": round(dev[m] * 1e3, 2),
+            "stage_decode_ms": round(dec[m] * 1e3, 2)}
+
+
 def bench_latency(n_subs: int = 100_000, n_requests: int = 2000,
-                  concurrency: int = 64, topic_pool: int = 0) -> dict:
+                  concurrency: int = 64, topic_pool: int = 0,
+                  force_device: bool = False) -> dict:
     """p50/p99 PUBLISH fan-out latency through the MicroBatcher.
     ``topic_pool``: draw request topics from a bounded pool (repeat-
     heavy broker stream — the version-keyed cache short-circuits hits,
-    so this measures the latency a hot topic actually sees)."""
+    so this measures the latency a hot topic actually sees).
+    ``force_device``: disable the ADR 008 adaptive CPU bypass so every
+    batch crosses the device — the honest latency of the device-served
+    path (VERDICT r4 #2), with the p99 decomposed into host prep +
+    device round trip + decode and the tunnel RTT reported alongside."""
     import asyncio
 
     from maxmq_tpu.matching.batcher import MicroBatcher
@@ -530,12 +580,15 @@ def bench_latency(n_subs: int = 100_000, n_requests: int = 2000,
     filters, topic_gen = build_corpus(n_subs, topic_pool=topic_pool)
     index = build_index(filters)
     engine = SigEngine(index, auto_refresh=False)
+    if force_device:
+        engine.emit_intents = True       # the production ADR 007 shape
     # production attach precompiles the dispatch bucket ladder
     # (bootstrap.build_matcher -> warm_buckets); without it the first
     # batch at a new bucket shape pays its XLA compile on the caller
     # path and the p99 measures compilation, not steady state
     engine.warm_buckets(max(256, concurrency), background=False)
-    batcher = MicroBatcher(engine, window_us=200, max_batch=4096)
+    batcher = MicroBatcher(engine, window_us=200, max_batch=4096,
+                           cpu_bypass=not force_device)
     topics = topic_gen(n_requests, seed2=7)
     lats: list[float] = []
     hits_base = [0]
@@ -575,9 +628,14 @@ def bench_latency(n_subs: int = 100_000, n_requests: int = 2000,
 
     asyncio.run(main())
     lats.sort()
+    if force_device:
+        name = "latency_fanout_device"
+        if concurrency != 64:
+            name += f"_c{concurrency}"
+    else:
+        name = "latency_fanout_hot" if topic_pool else "latency_fanout"
     out = {
-        "config": "latency_fanout_hot" if topic_pool else
-                  "latency_fanout", "subs": n_subs,
+        "config": name, "subs": n_subs,
         "requests": n_requests, "concurrency": concurrency,
         **({"topic_pool": topic_pool,
             "cache_hits": batcher.cache_hits - hits_base[0]}
@@ -590,7 +648,14 @@ def bench_latency(n_subs: int = 100_000, n_requests: int = 2000,
         "bypassed_topics": batcher.bypasses,
         "device_rtt_ms": round((batcher._device_rtt or 0) * 1e3, 2),
     }
-    log(f"[lat] p50 {out['p50_ms']}ms p99 {out['p99_ms']}ms "
+    if force_device:
+        # decompose a device-served batch at the shape this run formed
+        try:
+            out.update(_stage_latency_ms(
+                engine, topics, max(1, int(out["mean_batch"]))))
+        except Exception as exc:   # decomposition never costs the row
+            out["stage_error"] = repr(exc)[:200]
+    log(f"[lat] {name} p50 {out['p50_ms']}ms p99 {out['p99_ms']}ms "
         f"(mean batch {out['mean_batch']}, "
         f"bypassed {out['bypassed_topics']})")
     return out
@@ -764,7 +829,7 @@ def cpu_sanity_rows() -> dict:
 
 def main() -> None:
     which = os.environ.get("MAXMQ_BENCH_CONFIGS",
-                           "1,2,3,4,4h,5,lat,lath")
+                           "1,2,3,4,4h,5,lat,lath,latd,latdo")
     which = [w.strip() for w in which.split(",")]
     n_subs4 = int(os.environ.get("MAXMQ_BENCH_SUBS", 1_000_000))
     batch4 = int(os.environ.get("MAXMQ_BENCH_BATCH", 262_144))
@@ -906,6 +971,20 @@ def main() -> None:
         runs.append(("latency_fanout_hot",
                      lambda: bench_latency(n_subs=s(100_000),
                                            topic_pool=64)))
+    if "latd" in which:
+        # bypass disabled: every batch crosses the device — the honest
+        # device-served p50/p99 (VERDICT r4 #2), stage-decomposed
+        runs.append(("latency_fanout_device",
+                     lambda: bench_latency(n_subs=s(100_000),
+                                           force_device=True)))
+    if "latdo" in which:
+        # device-forced at production batch occupancy: enough callers
+        # in flight that the window forms real device-sized batches
+        runs.append(("latency_fanout_device_c1024",
+                     lambda: bench_latency(n_subs=s(100_000),
+                                           n_requests=s(8_192),
+                                           concurrency=1024,
+                                           force_device=True)))
     if "5" in which:
         runs.append(("cluster", lambda: bench_cluster(subs=s(100_000))))
 
@@ -983,7 +1062,8 @@ def assemble_result(configs: list, link: dict, backend_name: str,
 # corpus build + compile + measurement, with generous headroom — a
 # config that blows its deadline is recorded as wedged, not waited on
 CONFIG_DEADLINES = {"1": 900, "2": 900, "3": 1200, "4": 2400,
-                    "4h": 2400, "lat": 900, "lath": 900, "5": 1200}
+                    "4h": 2400, "lat": 900, "lath": 900, "latd": 900,
+                    "latdo": 1200, "5": 1200}
 
 
 def run_supervised(which: list[str]) -> None:

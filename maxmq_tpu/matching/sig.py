@@ -1891,21 +1891,29 @@ class SigEngine(OverlayedEngine):
         if fmt["kind"] == "stream":
             if self.overlay_for(tables.version) == "resync":
                 return self._resync_batch(topics)   # skip the flatten
-            cnt, real, flat = self._fetch_stream(out)
-            batch = len(topics)
-            if len(cnt) > batch:        # bucket-padded dispatch: pads
-                cnt, real = cnt[:batch], real[:batch]   # carry no rows
-            fall = cnt == 15
-            ti_dev = np.repeat(np.arange(batch), real)
-            rw_dev = (flat.astype(np.int64) if flat is not None
-                      else np.empty(0, dtype=np.int64))
-            ti, rw = _pairs_with_host(batch, ti_dev, rw_dev, hostrows,
-                                      fall, tables)
-            return self.decode_pairs(topics, fall, ti, rw, tables,
-                                     toks8, lens_enc)
+            fetched = self._fetch_stream(out)
+            return self._decode_stream(topics, ctx, *fetched)
         cnt, rows, hostrows, tables = self.match_fixed([], out=ctx)
         return self.decode_fixed(topics, cnt, rows, hostrows, tables,
                                  toks8, lens_enc)
+
+    def _decode_stream(self, topics: list[str], ctx, cnt, real, flat):
+        """Host half of the stream wire format after the fetch: pair
+        assembly + batch verify + entry union. Split from collect_fixed
+        so latency harnesses can time fetch and decode separately on
+        the SAME path production runs."""
+        _, hostrows, tables, _fmt = ctx[:4]
+        batch = len(topics)
+        if len(cnt) > batch:            # bucket-padded dispatch: pads
+            cnt, real = cnt[:batch], real[:batch]   # carry no rows
+        fall = cnt == 15
+        ti_dev = np.repeat(np.arange(batch), real)
+        rw_dev = (flat.astype(np.int64) if flat is not None
+                  else np.empty(0, dtype=np.int64))
+        ti, rw = _pairs_with_host(batch, ti_dev, rw_dev, hostrows,
+                                  fall, tables)
+        return self.decode_pairs(topics, fall, ti, rw, tables,
+                                 ctx[4], ctx[5])
 
     def decode_fixed(self, topics: list[str], cnt, rows, hostrows, tables,
                      toks8, lens_enc) -> list[SubscriberSet]:
