@@ -400,7 +400,7 @@ def bench_config(name: str, n_subs: int, batch: int, iters: int,
     try:
         return _bench_config_timed(
             name, engine, index, batches, batch, iters, depth, n_subs,
-            decompose, topic_gen, compile_s)
+            decompose, topic_gen, compile_s, engine_kw)
     finally:
         # always unfreeze, even if a timed pass raises — a permanently
         # frozen shared CPU-backend process would pin this config's
@@ -411,8 +411,47 @@ def bench_config(name: str, n_subs: int, batch: int, iters: int,
             gc.collect()
 
 
+def _chain_ab(index, engine_kw, batch, iters, depth, topic_gen) -> dict:
+    """Chain on/off A/B with per-arm engine isolation: the native
+    intents cache is keyed by row-set bytes alone (chain-agnostic), so
+    a shared engine would serve the 'off' arm results built while
+    chaining was on. Each arm gets a fresh engine and fresh topic
+    streams; chain_engaged_results counts how many results on the 'on'
+    arm actually chained (0 on exact corpora = chaining cannot tax
+    them by construction)."""
+    from maxmq_tpu.matching.sig import SigEngine
+    from maxmq_tpu.native import decode_module
+
+    mod = decode_module()
+    if mod is None or not hasattr(mod, "_set_chain_params"):
+        return {}
+    out = {}
+    try:
+        for mode, seed0 in (("on", 300), ("off", 400)):
+            if mode == "off":
+                mod._set_chain_params(1 << 30, 1, 1)
+            eng = SigEngine(index, auto_refresh=False, **engine_kw)
+            eng.emit_intents = True
+            eng.route_small = False
+            ab = [topic_gen(batch, seed2=seed0 + i) for i in range(iters)]
+            run_subscribers(eng, ab[:1], depth)      # warm compile
+            t0 = time.perf_counter()
+            run_subscribers(eng, ab, depth)
+            out[f"chain_{mode}_matches_per_sec"] = round(
+                batch * iters / (time.perf_counter() - t0), 1)
+            if mode == "on":
+                out["chain_engaged_results"] = sum(
+                    1 for r in eng.subscribers_fixed_batch(
+                        topic_gen(min(batch, 4096), seed2=555))
+                    if getattr(r, "chained", False))
+    finally:
+        mod._set_chain_params(64, 1, 1)
+    return out
+
+
 def _bench_config_timed(name, engine, index, batches, batch, iters,
-                        depth, n_subs, decompose, topic_gen, compile_s):
+                        depth, n_subs, decompose, topic_gen, compile_s,
+                        engine_kw):
     t0 = time.perf_counter()
     matched, n_over = run_sig(engine, batches, depth)
     raw_dt = time.perf_counter() - t0
@@ -485,6 +524,20 @@ def _bench_config_timed(name, engine, index, batches, batch, iters,
         index.subscribers(t)
     trie_rate = len(sample) / (time.perf_counter() - t0)
 
+    # exact_1k chain on/off A/B (VERDICT r4 #9): pins whether chained
+    # intents tax small corpora (the r4 capture's 574K->335K swing was
+    # attributed to tunnel variance; this rules chaining in or out).
+    # Skipped when the corpus routed to the trie (reduced-scale sanity
+    # runs): _set_chain_params has no effect there, so the fields
+    # would report pure trie variance as a chain signal.
+    chain_ab = {}
+    if name == "exact_1k" and not routed:
+        try:
+            chain_ab = _chain_ab(index, engine_kw, batch, iters, depth,
+                                 topic_gen)
+        except Exception as exc:   # diagnostic must never cost the row
+            chain_ab = {"chain_ab_error": repr(exc)[:300]}
+
     stages = {}
     if decompose:
         try:
@@ -502,6 +555,7 @@ def _bench_config_timed(name, engine, index, batches, batch, iters,
                           else "delivery_intents"),
         "mergedset_matches_per_sec": round(set_rate, 1),
         "hooked_matches_per_sec": round(hooked_rate, 1),
+        **chain_ab,
         "raw_slot_matches_per_sec": round(raw_rate, 1),
         "delivered_pairs": delivered,
         "matched_rows": matched, "overflow_topics": n_over,
