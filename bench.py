@@ -366,12 +366,90 @@ def stage_decomposition(engine, topics_batch: list[str],
             len(cold_topics) / (time.perf_counter() - t0), 1)
     engine.emit_intents = saved_emit
     d["decode_topics_per_sec"] = d["decode_intents_topics_per_sec"]
+    try:
+        d["roofline"] = kernel_roofline(
+            engine, batch, d["device_only_topics_per_sec"])
+    except Exception as exc:       # analysis must never cost the stages
+        d["roofline"] = {"error": repr(exc)[:200]}
     log(f"[stages] prep {d['host_prep_topics_per_sec']:,.0f}/s  "
         f"device {d['device_only_topics_per_sec']:,.0f}/s  "
         f"decode {d['decode_topics_per_sec']:,.0f}/s  "
         f"up {d['bytes_up_per_topic']}B  "
         f"down {d.get('bytes_down_per_topic', '?')}B per topic")
     return d
+
+
+def hbm_probe(mb: int = 256) -> dict:
+    """Measured on-device memory bandwidth: one fused elementwise pass
+    (read + write ``mb`` MB each way) on the default backend. On the
+    TPU this is HBM; on the CPU backend it is host RAM — the label
+    says which."""
+    import jax
+    import jax.numpy as jnp
+
+    n = mb * 1024 * 1024 // 4
+    x = jnp.zeros((n,), jnp.uint32)
+    f = jax.jit(lambda a: a + jnp.uint32(1))
+    f(x).block_until_ready()               # compile + first touch
+    t0 = time.perf_counter()
+    reps = 4
+    for _ in range(reps):
+        x = f(x)
+    x.block_until_ready()
+    dt = time.perf_counter() - t0
+    return {"backend": jax.default_backend(),
+            "gbps": round(2 * mb * reps / 1024 / dt, 1)}
+
+
+def kernel_roofline(engine, batch: int,
+                    measured_device_topics_per_sec: float) -> dict:
+    """Analytic HBM-traffic and VPU-op model of the fused signature
+    kernel at this corpus's compiled shape, against MEASURED device
+    memory bandwidth (VERDICT r4 #8): situates device_only_topics_per_sec
+    as a %% of the bandwidth roofline, and reports the op count that
+    bounds the compute side.
+
+    Traffic model per topic (stream wire format, chunked kernels):
+      inputs   — the [B, g_pad] split signatures re-read once per chunk
+                 (x2 arrays for the MXU expansion's lo/hi halves);
+      outputs  — each chunk writes [B, 1+max_rows] u32 candidates, the
+                 XLA merge reads them all back (x2 in the model);
+      constants— one-hot/group + 32 bit-planes, [*, w_full] u32/f32,
+                 read once per batch and amortized over B.
+    Compute model per topic: 32 plane compares + or/shift per word plus
+    max_rows min-extract passes per chunk column."""
+    from maxmq_tpu.matching.sig_pallas import SELECT_EXPAND_MAX, plan
+
+    tables = engine.tables
+    p = plan(tables)
+    if p is None:
+        return {"note": "XLA body in use (no pallas plan); model n/a"}
+    hbm = hbm_probe()
+    g_pad, chunk, n_chunks = p["g_pad"], p["chunk"], p["n_chunks"]
+    w_full = n_chunks * chunk
+    max_rows = engine.fixed_max_rows
+    select = len(tables.groups) <= SELECT_EXPAND_MAX
+    sig_arrays = 1 if select else 2
+    bytes_in = sig_arrays * g_pad * 4 * n_chunks + 4 * n_chunks
+    bytes_out = n_chunks * (1 + max_rows) * 4 * 2      # write + merge read
+    g_rows = 1 if select else g_pad
+    bytes_const = (32 + g_rows) * w_full * 4 / max(batch, 1)
+    bytes_per_topic = bytes_in + bytes_out + bytes_const
+    hbm_bound = hbm["gbps"] * 1e9 / bytes_per_topic
+    ops_per_topic = w_full * (32 * 2 + max_rows * 2)
+    return {
+        "kernel_shape": {"w_full": w_full, "g_pad": g_pad,
+                         "chunks": n_chunks, "max_rows": max_rows,
+                         "expand": "select" if select else "mxu"},
+        "measured_membw": hbm,
+        "bytes_per_topic": round(bytes_per_topic, 1),
+        "membw_bound_topics_per_sec": round(hbm_bound, 1),
+        "pct_of_membw_roofline": round(
+            100 * measured_device_topics_per_sec / hbm_bound, 2),
+        "vpu_ops_per_topic": ops_per_topic,
+        "implied_vpu_ops_per_sec": round(
+            ops_per_topic * measured_device_topics_per_sec, 1),
+    }
 
 
 def bench_config(name: str, n_subs: int, batch: int, iters: int,
@@ -927,6 +1005,39 @@ print(json.dumps({"config": "cluster_sharded_cpu_mesh",
 """
 
 
+def bench_e2e_matchbench(subs: int = 100_000,
+                         messages: int = 4_000) -> dict:
+    """Integrated broker->matcher->fan-out A/B (VERDICT r4 #10, carried
+    from r3): CPU trie vs sig matcher through the SAME harness
+    (benchmarks/e2e_broker.py --matchbench — broker in its own process,
+    real TCP clients, publish->deliver latency at the subscribers). The
+    broker child runs on the session's default backend, so on the TPU
+    rig the sig arm crosses the real chip."""
+    harness = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "benchmarks", "e2e_broker.py")
+    out: dict = {"config": "e2e_matchbench", "corpus_subs": subs,
+                 "messages": messages}
+    for matcher in ("trie", "sig"):
+        log(f"[e2e] matcher={matcher} ...")
+        stderr_tail = ""
+        try:
+            proc = subprocess.run(
+                [sys.executable, harness, "--matchbench", str(subs),
+                 "--matcher", matcher, "--messages", str(messages)],
+                capture_output=True, text=True, timeout=1800)
+            stderr_tail = proc.stderr[-300:]
+            row = json.loads(proc.stdout.strip().splitlines()[-1])
+            out[matcher] = {k: row[k] for k in
+                            ("deliveries", "deliveries_per_sec",
+                             "p50_ms", "p99_ms", "wall_s")}
+            log(f"[e2e] {matcher}: {row['deliveries_per_sec']:,.0f} "
+                f"deliveries/s p99 {row['p99_ms']}ms")
+        except Exception as exc:
+            out[matcher] = {"error": repr(exc)[:300],
+                            "stderr": stderr_tail}
+    return out
+
+
 def bench_cluster(subs: int = 100_000, batch: int = 8192,
                   msgs: int = 10_000) -> dict:
     log("[cluster] 8-dev CPU mesh subprocess ...")
@@ -1015,7 +1126,7 @@ def cpu_sanity_rows() -> dict:
 
 def main() -> None:
     which = os.environ.get("MAXMQ_BENCH_CONFIGS",
-                           "1,2,3,4,4h,5,lat,lath,latd,latdo")
+                           "1,2,3,4,4h,5,lat,lath,latd,latdo,e2e")
     which = [w.strip() for w in which.split(",")]
     n_subs4 = int(os.environ.get("MAXMQ_BENCH_SUBS", 1_000_000))
     batch4 = int(os.environ.get("MAXMQ_BENCH_BATCH", 262_144))
@@ -1173,6 +1284,10 @@ def main() -> None:
                                            force_device=True)))
     if "5" in which:
         runs.append(("cluster", lambda: bench_cluster(subs=s(100_000))))
+    if "e2e" in which:
+        runs.append(("e2e_matchbench",
+                     lambda: bench_e2e_matchbench(subs=s(100_000),
+                                                  messages=s(4_000))))
 
     configs = []
     for name, fn in runs:
@@ -1249,7 +1364,7 @@ def assemble_result(configs: list, link: dict, backend_name: str,
 # config that blows its deadline is recorded as wedged, not waited on
 CONFIG_DEADLINES = {"1": 900, "2": 900, "3": 1200, "4": 2400,
                     "4h": 2400, "lat": 900, "lath": 900, "latd": 900,
-                    "latdo": 1200, "5": 2400}
+                    "latdo": 1200, "5": 2400, "e2e": 3600}
 
 
 def run_supervised(which: list[str]) -> None:
