@@ -1017,24 +1017,40 @@ def bench_e2e_matchbench(subs: int = 100_000,
                            "benchmarks", "e2e_broker.py")
     out: dict = {"config": "e2e_matchbench", "corpus_subs": subs,
                  "messages": messages}
+    # the broker child must see the REAL target backend even when this
+    # orchestrating process was pinned to CPU by the supervisor (the
+    # chip is single-process; see run_supervised's e2e env)
+    child_env = dict(os.environ)
+    want = os.environ.get("MAXMQ_E2E_CHILD_PLATFORMS",
+                          os.environ.get("JAX_PLATFORMS", ""))
+    if want:
+        child_env["JAX_PLATFORMS"] = want
+    else:
+        child_env.pop("JAX_PLATFORMS", None)
     for matcher in ("trie", "sig"):
         log(f"[e2e] matcher={matcher} ...")
-        stderr_tail = ""
         try:
             proc = subprocess.run(
                 [sys.executable, harness, "--matchbench", str(subs),
                  "--matcher", matcher, "--messages", str(messages)],
-                capture_output=True, text=True, timeout=1800)
-            stderr_tail = proc.stderr[-300:]
+                env=child_env, capture_output=True, text=True,
+                timeout=1800)
             row = json.loads(proc.stdout.strip().splitlines()[-1])
             out[matcher] = {k: row[k] for k in
                             ("deliveries", "deliveries_per_sec",
                              "p50_ms", "p99_ms", "wall_s")}
             log(f"[e2e] {matcher}: {row['deliveries_per_sec']:,.0f} "
                 f"deliveries/s p99 {row['p99_ms']}ms")
+        except subprocess.TimeoutExpired as exc:
+            tail = exc.stderr or b""
+            if isinstance(tail, bytes):
+                tail = tail.decode(errors="replace")
+            out[matcher] = {"error": "arm exceeded 1800s",
+                            "stderr": tail[-300:]}
         except Exception as exc:
             out[matcher] = {"error": repr(exc)[:300],
-                            "stderr": stderr_tail}
+                            "stderr": (proc.stderr or "")[-300:]
+                            if "proc" in locals() else ""}
     return out
 
 
@@ -1364,7 +1380,7 @@ def assemble_result(configs: list, link: dict, backend_name: str,
 # config that blows its deadline is recorded as wedged, not waited on
 CONFIG_DEADLINES = {"1": 900, "2": 900, "3": 1200, "4": 2400,
                     "4h": 2400, "lat": 900, "lath": 900, "latd": 900,
-                    "latdo": 1200, "5": 2400, "e2e": 3600}
+                    "latdo": 1200, "5": 2400, "e2e": 4200}
 
 
 def run_supervised(which: list[str]) -> None:
@@ -1379,6 +1395,14 @@ def run_supervised(which: list[str]) -> None:
         log(f"[supervisor] config {key} (deadline {deadline:.0f}s)")
         env = dict(os.environ)
         env.update(MAXMQ_BENCH_CONFIGS=key, MAXMQ_BENCH_SUBPROC="1")
+        if key == "e2e":
+            # the e2e config child only ORCHESTRATES broker subprocesses
+            # — pin its own jax to CPU so it cannot hold the chip the
+            # sig-arm broker grandchild needs (single-process TPU), and
+            # hand the real target through for the grandchild
+            env["MAXMQ_E2E_CHILD_PLATFORMS"] = os.environ.get(
+                "JAX_PLATFORMS", "")
+            env["JAX_PLATFORMS"] = "cpu"
         t0 = time.perf_counter()
         try:
             p = subprocess.run([sys.executable, os.path.abspath(__file__)],

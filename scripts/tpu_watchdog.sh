@@ -23,7 +23,7 @@ while :; do
         echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tunnel ALIVE - capture #$n" >> "$LOG"
         touch "$FLAG"
         MAXMQ_BENCH_CONFIGS="${MAXMQ_BENCH_CONFIGS:-1,2,3,4,4h,lat,lath,latd,latdo,e2e}" \
-            timeout 14400 python bench.py \
+            timeout 18000 python bench.py \
             > "/tmp/bench_r05_live_$n.json" 2> "/tmp/bench_r05_live_$n.err"
         rc=$?
         rm -f "$FLAG"
