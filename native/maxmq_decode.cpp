@@ -782,6 +782,52 @@ Py_ssize_t g_chain_min_base = 64;
 Py_ssize_t g_chain_tail_num = 1;
 Py_ssize_t g_chain_tail_den = 1;
 
+// opt-in section timing for the decode hot path (profiling builds of
+// the bench drive it via _timing_reset/_timing_get; zero cost when off)
+struct DecodeTiming {
+  int64_t pass1_ns = 0, pass2_ns = 0, construct_ns = 0;
+  int64_t constructs = 0, shared_ns = 0;
+};
+DecodeTiming g_timing;
+bool g_timing_on = false;
+int g_timing_depth = 0;   // recursion guard: only depth-0 accumulates
+
+static inline int64_t now_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+struct TimeAcc {
+  int64_t *dst;
+  int64_t t0;
+  bool armed;
+  explicit TimeAcc(int64_t *d)
+      : dst(d), t0(0), armed(g_timing_on && g_timing_depth == 0) {
+    if (armed) t0 = now_ns();
+  }
+  ~TimeAcc() {
+    if (armed) *dst += now_ns() - t0;
+  }
+};
+
+PyObject *timing_reset(PyObject *, PyObject *arg) {
+  const int v = PyObject_IsTrue(arg);
+  if (v < 0) return nullptr;
+  g_timing = DecodeTiming{};
+  g_timing_on = v != 0;
+  Py_RETURN_NONE;
+}
+
+PyObject *timing_get(PyObject *, PyObject *) {
+  return Py_BuildValue(
+      "{s:L,s:L,s:L,s:L,s:L}", "pass1_ns", (long long)g_timing.pass1_ns,
+      "pass2_ns", (long long)g_timing.pass2_ns, "construct_ns",
+      (long long)g_timing.construct_ns, "constructs",
+      (long long)g_timing.constructs, "shared_ns",
+      (long long)g_timing.shared_ns);
+}
+
 PyObject *set_chain_enabled(PyObject *, PyObject *arg) {
   const int v = PyObject_IsTrue(arg);
   if (v < 0) return nullptr;
@@ -1331,6 +1377,7 @@ PyObject *cached_rowset_result(DecodeTable *t, const int32_t *rows,
 // re-enter this builder on another thread's behalf — publish-once
 // keeps the cached map single and complete.
 PyObject *row_shared(DecodeTable *t, Py_ssize_t r) {
+  TimeAcc time_shared(&g_timing.shared_ns);
   if (t->rshared[r]) return t->rshared[r];
   const auto *off = static_cast<const int64_t *>(t->offsets.buf);
   const auto *kind = static_cast<const uint8_t *>(t->kinds.buf);
@@ -1385,6 +1432,8 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
     Py_DECREF(key);
     return nullptr;
   }
+  TimeAcc time_construct(&g_timing.construct_ns);
+  if (time_construct.armed) g_timing.constructs++;
   const auto *off = static_cast<const int64_t *>(t->offsets.buf);
   const auto *kind = static_cast<const uint8_t *>(t->kinds.buf);
   Py_ssize_t total = 0;
@@ -1439,7 +1488,9 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
       if (fb != t->row_base.end()) {
         base_res = Py_NewRef(fb->second);
       } else {
+        g_timing_depth++;      // nested build: outer TimeAcc owns it
         base_res = cached_intents_result(t, cap, &rows[bi], 1);
+        g_timing_depth--;
         if (!base_res) {
           Py_DECREF(key);
           return nullptr;
@@ -1866,7 +1917,9 @@ PyObject *decode_batch_impl(PyObject *args, const bool intents) {
   std::vector<int32_t> v_rw;
   v_tp.reserve(N);
   v_rw.reserve(N);
-  for (Py_ssize_t k = 0; k < N; k++) {
+  {
+    TimeAcc time_pass1(&g_timing.pass1_ns);
+    for (Py_ssize_t k = 0; k < N; k++) {
     const int64_t tp = ti[k], r = rw[k];
     if (tp < 0 || tp >= B || r < 0 || r >= t->R) continue;
     const uint8_t f = fl[r];
@@ -1895,8 +1948,10 @@ PyObject *decode_batch_impl(PyObject *args, const bool intents) {
     if (!ok) continue;
     v_tp.push_back(tp);
     v_rw.push_back(static_cast<int32_t>(r));
+    }
   }
 
+  TimeAcc time_pass2(&g_timing.pass2_ns);
   // pass 2 — counting-sort the survivors by topic (pairs may interleave
   // device and host-probe streams), then resolve each topic's row SET
   // through the table's result cache: topics overwhelmingly repeat a
@@ -1972,6 +2027,10 @@ PyMethodDef methods[] = {
     {"_set_chain_enabled", set_chain_enabled, METH_O,
      "TEST ONLY: disable/enable the chained-union fast path so the "
      "suite can A/B chained vs full unions of the same row sets."},
+    {"_timing_reset", timing_reset, METH_O,
+     "PROFILING: reset and enable(1)/disable(0) decode section timers."},
+    {"_timing_get", timing_get, METH_NOARGS,
+     "PROFILING: accumulated decode section times (ns) since reset."},
     {"_set_chain_params", set_chain_params, METH_VARARGS,
      "TEST/TUNING: (min_base, tail_num, tail_den) — chain when the "
      "fattest row has >= min_base plain entries and tail <= "
