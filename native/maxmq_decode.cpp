@@ -787,6 +787,10 @@ Py_ssize_t g_chain_tail_den = 1;
 struct DecodeTiming {
   int64_t pass1_ns = 0, pass2_ns = 0, construct_ns = 0;
   int64_t constructs = 0, shared_ns = 0;
+  // chain-decision census over timed constructions
+  int64_t chained = 0, single_row = 0, decl_minbase = 0, decl_ratio = 0;
+  int64_t decl_budget = 0;     // slot-map budget exhausted
+  int64_t entries_built = 0;   // plain entries allocated (tail or full)
 };
 DecodeTiming g_timing;
 bool g_timing_on = false;
@@ -821,11 +825,18 @@ PyObject *timing_reset(PyObject *, PyObject *arg) {
 
 PyObject *timing_get(PyObject *, PyObject *) {
   return Py_BuildValue(
-      "{s:L,s:L,s:L,s:L,s:L}", "pass1_ns", (long long)g_timing.pass1_ns,
-      "pass2_ns", (long long)g_timing.pass2_ns, "construct_ns",
-      (long long)g_timing.construct_ns, "constructs",
-      (long long)g_timing.constructs, "shared_ns",
-      (long long)g_timing.shared_ns);
+      "{s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L}",
+      "pass1_ns", (long long)g_timing.pass1_ns,
+      "pass2_ns", (long long)g_timing.pass2_ns,
+      "construct_ns", (long long)g_timing.construct_ns,
+      "constructs", (long long)g_timing.constructs,
+      "shared_ns", (long long)g_timing.shared_ns,
+      "chained", (long long)g_timing.chained,
+      "single_row", (long long)g_timing.single_row,
+      "decl_minbase", (long long)g_timing.decl_minbase,
+      "decl_budget", (long long)g_timing.decl_budget,
+      "decl_ratio", (long long)g_timing.decl_ratio,
+      "entries_built", (long long)g_timing.entries_built);
 }
 
 PyObject *set_chain_enabled(PyObject *, PyObject *arg) {
@@ -1417,7 +1428,8 @@ PyObject *row_shared(DecodeTable *t, Py_ssize_t r) {
 // rows' action streams — int32/pointer writes only; merge_subscription
 // runs solely on same-client collisions and v5-identifier entries.
 PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
-                                const int32_t *rows, Py_ssize_t n_rows) {
+                                const int32_t *rows, Py_ssize_t n_rows,
+                                bool allow_chain = true) {
   PyObject *key = PyBytes_FromStringAndSize(
       reinterpret_cast<const char *>(rows),
       n_rows * (Py_ssize_t)sizeof(int32_t));
@@ -1442,15 +1454,25 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
     total += off[rows[i] + 1] - off[rows[i]];
     sh_pairs += t->shcount[rows[i]];
   }
-  // chain decision: one fat row + a thin tail means the union can
-  // anchor on the fat row's immutable single-row intents and build
-  // only the tail — O(tail) per topic instead of O(total), which is
-  // the whole cold-stream game on shallow-'#' corpora where every
-  // topic's row set is distinct but shares the same fat bucket row.
+  // chain decision: a few fat rows + a thin remainder mean the union
+  // can anchor on an immutable cached base intents and build only the
+  // remainder — O(tail) per topic instead of O(total), which is the
+  // whole cold-stream game on shallow-'#' corpora where every topic's
+  // row set is distinct but shares the same fat bucket row.
+  // NOTE (round-5 measured negative result): anchoring on a FLATTENED
+  // multi-fat-row subset base was implemented and benchmarked here —
+  // the heavy cold sets look like [280, 63, 61, 50, ...] and pay a
+  // ~150-entry tail — but a corpus census showed fat-row COMBINATIONS
+  // essentially never repeat on cold streams (2,781 distinct subsets
+  // across 2,783 multi-fat topics at 1M subs), so per-subset flatten
+  // work can never amortize and measured strictly slower. Individual
+  // rows DO repeat heavily; composing multiple per-row cached bases
+  // (a bases[] list with slot-space concatenation) is the structural
+  // follow-up if the cold wall must drop further.
   constexpr Py_ssize_t kSlotMapCap = 512 * 1024;
   Py_ssize_t bi = -1;
   Py_ssize_t fat_plain = 0, tail_plain = 0;
-  if (n_rows > 1 && g_chain_enabled) {
+  if (n_rows > 1 && g_chain_enabled && allow_chain) {
     Py_ssize_t total_plain = 0;
     for (Py_ssize_t i = 0; i < n_rows; i++) {
       const Py_ssize_t p =
@@ -1463,9 +1485,19 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
     }
     tail_plain = total_plain - fat_plain;
     if (fat_plain < g_chain_min_base ||
-        tail_plain * g_chain_tail_den > fat_plain * g_chain_tail_num)
+        tail_plain * g_chain_tail_den > fat_plain * g_chain_tail_num) {
+      if (time_construct.armed) {
+        if (fat_plain < g_chain_min_base)
+          g_timing.decl_minbase++;
+        else
+          g_timing.decl_ratio++;
+      }
       bi = -1;
+    }
+  } else if (time_construct.armed && n_rows == 1) {
+    g_timing.single_row++;
   }
+
   PyObject *base_res = nullptr;
   std::unordered_map<int32_t, DecodeTable::BaseSlot> *sm = nullptr;
   if (bi >= 0) {
@@ -1503,22 +1535,30 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
         if (ins.second) ins.first->second = Py_NewRef(base_res);
       }
     } else {
+      if (time_construct.armed) g_timing.decl_budget++;
       bi = -1;  // slot-map budget exhausted: full union instead
     }
   }
+
+  const bool chained = bi >= 0;
+  const Py_ssize_t tail_n = chained ? tail_plain : 0;
   IntentsObject *it =
-      intents_alloc(cap, bi >= 0 ? tail_plain : total - sh_pairs);
+      intents_alloc(cap, chained ? tail_n : total - sh_pairs);
   if (!it) {
     Py_XDECREF(base_res);
     Py_DECREF(key);
     return nullptr;
   }
-  if (bi >= 0) {
+  if (time_construct.armed) {
+    if (chained) g_timing.chained++;
+    g_timing.entries_built += chained ? tail_n : total - sh_pairs;
+  }
+  if (chained) {
     it->base = reinterpret_cast<IntentsObject *>(base_res);  // owns it
-    if (tail_plain) {
+    if (tail_n) {
       // one block: PyObject* array first (alignment), slots after
       char *ob = static_cast<char *>(PyMem_Malloc(
-          tail_plain * (sizeof(PyObject *) + sizeof(int32_t))));
+          tail_n * (sizeof(PyObject *) + sizeof(int32_t))));
       if (!ob) {
         Py_DECREF(key);
         Py_DECREF(it);
@@ -1527,15 +1567,16 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
       }
       it->ovr_subs = reinterpret_cast<PyObject **>(ob);
       it->ovr_slots = reinterpret_cast<int32_t *>(
-          ob + tail_plain * sizeof(PyObject *));
+          ob + tail_n * sizeof(PyObject *));
     }
   }
   // override build state: a chained union must produce EXACTLY what
   // the ascending-row-order union produces for a client present in
-  // both the base row and tail rows — qos max and identifier union
+  // both base row(s) and tail rows — qos max and identifier union
   // are order-free, but merge_subscription takes flags from the NEWER
-  // (= higher row id) filter, so the base contribution is folded in at
-  // its ordered position via its raw action, not merged first-come.
+  // (= higher row id) filter, so each base contribution is folded in
+  // at its ordered position via its raw action, not merged
+  // first-come.
   struct OvrBuild {
     int32_t slot;      // base slot shadowed
     int64_t base_act;  // the base row's action for this client
@@ -1620,7 +1661,7 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
   // union degenerates to a straight sequential copy of the stream.
   // A chained build unions only the tail rows, so the same shortcut
   // applies when the tail is a single row.
-  const Py_ssize_t n_union_rows = n_rows - (bi >= 0 ? 1 : 0);
+  const Py_ssize_t n_union_rows = n_rows - (chained ? 1 : 0);
   const bool dedupe = n_union_rows > 1;
   const bool fast = dedupe && guard.owned;
   uint32_t e32 = 0;
@@ -1702,7 +1743,7 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
     if (pc >= 0) PREFETCH_W(&t->mark[pc]);
   };
   for (Py_ssize_t i = 0; i < n_rows; i++) {
-    if (i == bi) continue;  // chained: the base carries the fat row
+    if (chained && i == bi) continue;  // the base carries the fat row
     const int64_t r = rows[i];
     for (int64_t a = off[r]; a < off[r + 1]; a++) {
       if (fast) prefetch_at(i, a);
@@ -1710,7 +1751,7 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
       if (k == ACT_SHARED) continue;   // prebuilt per-row maps above
       const int32_t c = t->act_cidx[a];
       if (sm) {
-        // same client also in the base row: shadow the base slot with
+        // same client also in a base row: shadow the base slot with
         // a merged record instead of adding a duplicate tail entry
         auto f = sm->find(c);
         if (f != sm->end()) {
