@@ -392,16 +392,39 @@ struct IntentsObject {
   PyObject *shared;     // (group, filter) -> {cid: sub}, or NULL
   PyObject *set_cache;  // lazily-built SubscriberSet twin
   // chain: own entries are the tail; base holds the fat row's pairs
-  IntentsObject *base;  // strong; single-row intents (never chained)
+  // chain bases (round 5: a LIST — heavy cold sets hold several fat
+  // '#' rows whose per-row intents all repeat across topics even
+  // though their combinations do not): cached single-row intents in
+  // ascending row order. The iteration/override slot space is the
+  // concatenation of the bases' entries; base_off[j] is base j's
+  // first global slot, base_off[n_bases] the total.
+  IntentsObject **bases;  // strong refs; one block with base_off
+  int32_t *base_off;      // [n_bases + 1] cumulative entry offsets
+  int32_t n_bases;
   int32_t *ovr_slots;   // [n_ovr] base slots shadowed, ascending
   PyObject **ovr_subs;  // [n_ovr] owned merged Subscriptions
   Py_ssize_t n_ovr;
   uint8_t sel_seen;     // select_set() ran once (cache on the re-hit)
 };
 
-// total plain entries a consumer sees (tail + base; overrides shadow)
+// total plain entries a consumer sees (tail + bases; overrides shadow)
 static inline Py_ssize_t intents_total(const IntentsObject *self) {
-  return self->n + (self->base ? self->base->n : 0);
+  return self->n + (self->n_bases ? self->base_off[self->n_bases] : 0);
+}
+
+// resolve a global base slot to the base's stored subscription
+static inline PyObject *base_sub_at(const IntentsObject *self,
+                                    int32_t gs) {
+  int32_t b = 0;
+  while (gs >= self->base_off[b + 1]) b++;
+  return self->bases[b]->subs[gs - self->base_off[b]];
+}
+
+static inline PyObject *base_cid_at(const IntentsObject *self,
+                                    int32_t gs) {
+  int32_t b = 0;
+  while (gs >= self->base_off[b + 1]) b++;
+  return self->bases[b]->cids[gs - self->base_off[b]];
 }
 
 PyTypeObject *g_intents_type = nullptr;
@@ -434,7 +457,9 @@ IntentsObject *intents_alloc(PyObject *capsule, Py_ssize_t capacity) {
   self->owned = nullptr;
   self->shared = nullptr;
   self->set_cache = nullptr;
-  self->base = nullptr;
+  self->bases = nullptr;
+  self->base_off = nullptr;
+  self->n_bases = 0;
   self->ovr_slots = nullptr;
   self->ovr_subs = nullptr;
   self->n_ovr = 0;
@@ -464,7 +489,8 @@ int intents_traverse(PyObject *self_o, visitproc visit, void *arg) {
   Py_VISIT(self->table_cap);
   Py_VISIT(self->shared);
   Py_VISIT(self->set_cache);
-  Py_VISIT(reinterpret_cast<PyObject *>(self->base));
+  for (int32_t b = 0; b < self->n_bases; b++)
+    Py_VISIT(reinterpret_cast<PyObject *>(self->bases[b]));
   for (Py_ssize_t i = 0; i < self->n; i++)
     if (self->owned && self->owned[i]) Py_VISIT(self->subs[i]);
   for (Py_ssize_t i = 0; i < self->n_ovr; i++)
@@ -487,7 +513,12 @@ int intents_clear_slot(PyObject *self_o) {
   PyMem_Free(self->ovr_subs);  // one block: ovr_subs | ovr_slots
   self->ovr_slots = nullptr;
   self->ovr_subs = nullptr;
-  Py_CLEAR(self->base);
+  for (int32_t b = 0; b < self->n_bases; b++)
+    Py_CLEAR(self->bases[b]);
+  self->n_bases = 0;
+  PyMem_Free(self->bases);     // one block: bases | base_off
+  self->bases = nullptr;
+  self->base_off = nullptr;
   Py_CLEAR(self->table_cap);
   Py_CLEAR(self->shared);
   Py_CLEAR(self->set_cache);
@@ -518,20 +549,20 @@ Py_ssize_t intents_len(PyObject *self_o) {
 PyObject *intents_build_subs(const IntentsObject *self) {
   PyObject *subs = PyDict_New();
   if (!subs) return nullptr;
-  if (self->base) {
-    const IntentsObject *b = self->base;
-    for (Py_ssize_t j = 0; j < b->n; j++)
-      if (PyDict_SetItem(subs, b->cids[j], b->subs[j]) < 0) {
-        Py_DECREF(subs);
-        return nullptr;
-      }
-    for (Py_ssize_t k = 0; k < self->n_ovr; k++)
-      if (PyDict_SetItem(subs, b->cids[self->ovr_slots[k]],
-                         self->ovr_subs[k]) < 0) {
+  for (int32_t b = 0; b < self->n_bases; b++) {
+    const IntentsObject *bb = self->bases[b];
+    for (Py_ssize_t j = 0; j < bb->n; j++)
+      if (PyDict_SetItem(subs, bb->cids[j], bb->subs[j]) < 0) {
         Py_DECREF(subs);
         return nullptr;
       }
   }
+  for (Py_ssize_t k = 0; k < self->n_ovr; k++)
+    if (PyDict_SetItem(subs, base_cid_at(self, self->ovr_slots[k]),
+                       self->ovr_subs[k]) < 0) {
+      Py_DECREF(subs);
+      return nullptr;
+    }
   for (Py_ssize_t i = 0; i < self->n; i++)
     if (PyDict_SetItem(subs, self->cids[i], self->subs[i]) < 0) {
       Py_DECREF(subs);
@@ -612,17 +643,20 @@ PyObject *intents_select_set(PyObject *self_o, PyObject *) {
 // overlap check, on sets of a few hundred entries at most)
 PyObject *intents_has_client(PyObject *self_o, PyObject *cid) {
   auto *self = reinterpret_cast<IntentsObject *>(self_o);
-  for (const IntentsObject *part = self; part;
-       part = (part == self ? self->base : nullptr)) {
+  auto scan = [&](const IntentsObject *part) -> int {
     for (Py_ssize_t i = 0; i < part->n; i++) {
-      if (part->cids[i] == cid) Py_RETURN_TRUE;
+      if (part->cids[i] == cid) return 1;
       const int eq =
           PyObject_RichCompareBool(part->cids[i], cid, Py_EQ);
-      if (eq < 0) return nullptr;
-      if (eq) Py_RETURN_TRUE;
+      if (eq != 0) return eq;   // hit or error
     }
-  }
-  Py_RETURN_FALSE;
+    return 0;
+  };
+  int r = scan(self);
+  for (int32_t b = 0; r == 0 && b < self->n_bases; b++)
+    r = scan(self->bases[b]);
+  if (r < 0) return nullptr;
+  return PyBool_FromLong(r);
 }
 
 PyObject *intents_get_shared(PyObject *self_o, void *) {
@@ -641,7 +675,7 @@ PyObject *intents_get_n(PyObject *self_o, void *) {
 
 PyObject *intents_get_chained(PyObject *self_o, void *) {
   return PyBool_FromLong(
-      reinterpret_cast<IntentsObject *>(self_o)->base != nullptr);
+      reinterpret_cast<IntentsObject *>(self_o)->n_bases > 0);
 }
 
 struct IntentsIterObject {
@@ -649,6 +683,7 @@ struct IntentsIterObject {
   IntentsObject *it;  // strong
   Py_ssize_t i;
   Py_ssize_t oi;  // cursor into ovr_slots (ascending, so O(1) amort.)
+  int32_t b;      // current base (global slots ascend with iteration)
 };
 
 PyObject *intents_iter(PyObject *self_o) {
@@ -657,6 +692,7 @@ PyObject *intents_iter(PyObject *self_o) {
   iter->it = reinterpret_cast<IntentsObject *>(Py_NewRef(self_o));
   iter->i = 0;
   iter->oi = 0;
+  iter->b = 0;
   PyObject_GC_Track(iter);
   return reinterpret_cast<PyObject *>(iter);
 }
@@ -669,16 +705,18 @@ PyObject *intents_iternext(PyObject *self_o) {
     self->i++;
     return PyTuple_Pack(2, v->cids[i], v->subs[i]);
   }
-  const IntentsObject *b = v->base;
-  if (!b) return nullptr;  // StopIteration
-  const Py_ssize_t j = i - v->n;
-  if (j >= b->n) return nullptr;
+  if (!v->n_bases) return nullptr;  // StopIteration
+  const Py_ssize_t j = i - v->n;    // global base slot
+  if (j >= v->base_off[v->n_bases]) return nullptr;
+  while (j >= v->base_off[self->b + 1]) self->b++;
+  const IntentsObject *bb = v->bases[self->b];
+  const Py_ssize_t lj = j - v->base_off[self->b];
   self->i++;
   while (self->oi < v->n_ovr && v->ovr_slots[self->oi] < j) self->oi++;
   PyObject *sub = (self->oi < v->n_ovr && v->ovr_slots[self->oi] == j)
                       ? v->ovr_subs[self->oi]
-                      : b->subs[j];
-  return PyTuple_Pack(2, b->cids[j], sub);
+                      : bb->subs[lj];
+  return PyTuple_Pack(2, bb->cids[lj], sub);
 }
 
 int intents_iter_traverse(PyObject *self_o, visitproc visit, void *arg) {
@@ -696,10 +734,11 @@ void intents_iter_dealloc(PyObject *self_o) {
 
 PyObject *intents_repr(PyObject *self_o) {
   auto *self = reinterpret_cast<IntentsObject *>(self_o);
-  if (self->base)
+  if (self->n_bases)
     return PyUnicode_FromFormat(
-        "DeliveryIntents(n=%zd, tail=%zd, overrides=%zd, shared=%zd)",
-        intents_total(self), self->n, self->n_ovr,
+        "DeliveryIntents(n=%zd, tail=%zd, bases=%d, overrides=%zd, "
+        "shared=%zd)",
+        intents_total(self), self->n, (int)self->n_bases, self->n_ovr,
         self->shared ? PyDict_Size(self->shared) : (Py_ssize_t)0);
   return PyUnicode_FromFormat(
       "DeliveryIntents(n=%zd, shared=%zd)", self->n,
@@ -769,6 +808,7 @@ PyObject *configure(PyObject *, PyObject *args) {
 // this test-only switch lets the suite A/B the two builds of the SAME
 // row set (flags included, not just the normalize() projection)
 bool g_chain_enabled = true;
+bool g_multi_base = true;
 
 // chain-decision thresholds (settable for measurement/tests): anchor
 // on the fattest row when it has >= min_base plain entries and the
@@ -790,6 +830,8 @@ struct DecodeTiming {
   // chain-decision census over timed constructions
   int64_t chained = 0, single_row = 0, decl_minbase = 0, decl_ratio = 0;
   int64_t decl_budget = 0;     // slot-map budget exhausted
+  int64_t resolve_ns = 0;      // candidate->base resolution time
+  int64_t multi_base = 0;      // chains composing >= 2 row bases
   int64_t entries_built = 0;   // plain entries allocated (tail or full)
 };
 DecodeTiming g_timing;
@@ -825,16 +867,18 @@ PyObject *timing_reset(PyObject *, PyObject *arg) {
 
 PyObject *timing_get(PyObject *, PyObject *) {
   return Py_BuildValue(
-      "{s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L}",
+      "{s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L,s:L}",
       "pass1_ns", (long long)g_timing.pass1_ns,
       "pass2_ns", (long long)g_timing.pass2_ns,
       "construct_ns", (long long)g_timing.construct_ns,
       "constructs", (long long)g_timing.constructs,
       "shared_ns", (long long)g_timing.shared_ns,
       "chained", (long long)g_timing.chained,
+      "multi_base", (long long)g_timing.multi_base,
       "single_row", (long long)g_timing.single_row,
       "decl_minbase", (long long)g_timing.decl_minbase,
       "decl_budget", (long long)g_timing.decl_budget,
+      "resolve_ns", (long long)g_timing.resolve_ns,
       "decl_ratio", (long long)g_timing.decl_ratio,
       "entries_built", (long long)g_timing.entries_built);
 }
@@ -843,6 +887,13 @@ PyObject *set_chain_enabled(PyObject *, PyObject *arg) {
   const int v = PyObject_IsTrue(arg);
   if (v < 0) return nullptr;
   g_chain_enabled = v != 0;
+  Py_RETURN_NONE;
+}
+
+PyObject *set_multi_base(PyObject *, PyObject *arg) {
+  const int v = PyObject_IsTrue(arg);
+  if (v < 0) return nullptr;
+  g_multi_base = v != 0;
   Py_RETURN_NONE;
 }
 
@@ -942,6 +993,10 @@ struct DecodeTable {
   // per topic, and the base survives icache churn. Same
   // capsule<->cache cycle class as icache; table_release breaks it.
   std::unordered_map<int32_t, PyObject *> row_base;
+  // multi-base composition: per-row purity flag (0 = none of the row's
+  // plain clients appears in any other row), computed once at
+  // table_new. Pure rows are pairwise disjoint with everything.
+  std::vector<uint8_t> row_impure;
   Py_ssize_t R, W, A;
 };
 
@@ -1102,6 +1157,28 @@ PyObject *table_new(PyObject *, PyObject *args) {
       for (int64_t a = offs[r]; a < offs[r + 1]; a++)
         c += kind[a] == ACT_SHARED;
       t->shcount[r] = c;
+    }
+    // row purity for multi-base chaining: a client delivering plainly
+    // from >= 2 rows makes every such row IMPURE. Pure rows share no
+    // client with any other row, so any set of pure rows (plus at most
+    // one impure row) is pairwise disjoint by construction — an O(1)
+    // verdict at chain time instead of per-pair stream probes (pairs,
+    // like subsets, almost never repeat on cold streams).
+    {
+      std::vector<uint8_t> cnt(t->mark.size(), 0);
+      for (Py_ssize_t a = 0; a < t->A; a++)
+        if (kind[a] != ACT_SHARED && t->act_cidx[a] >= 0) {
+          uint8_t &x = cnt[t->act_cidx[a]];
+          if (x < 2) x++;
+        }
+      t->row_impure.assign(t->R, 0);
+      for (Py_ssize_t r = 0; r < t->R; r++)
+        for (int64_t a = offs[r]; a < offs[r + 1]; a++)
+          if (kind[a] != ACT_SHARED && t->act_cidx[a] >= 0 &&
+              cnt[t->act_cidx[a]] >= 2) {
+            t->row_impure[r] = 1;
+            break;
+          }
     }
   }
   return capsule;
@@ -1454,107 +1531,207 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
     total += off[rows[i] + 1] - off[rows[i]];
     sh_pairs += t->shcount[rows[i]];
   }
-  // chain decision: a few fat rows + a thin remainder mean the union
-  // can anchor on an immutable cached base intents and build only the
-  // remainder — O(tail) per topic instead of O(total), which is the
-  // whole cold-stream game on shallow-'#' corpora where every topic's
-  // row set is distinct but shares the same fat bucket row.
-  // NOTE (round-5 measured negative result): anchoring on a FLATTENED
-  // multi-fat-row subset base was implemented and benchmarked here —
-  // the heavy cold sets look like [280, 63, 61, 50, ...] and pay a
-  // ~150-entry tail — but a corpus census showed fat-row COMBINATIONS
-  // essentially never repeat on cold streams (2,781 distinct subsets
-  // across 2,783 multi-fat topics at 1M subs), so per-subset flatten
-  // work can never amortize and measured strictly slower. Individual
-  // rows DO repeat heavily; composing multiple per-row cached bases
-  // (a bases[] list with slot-space concatenation) is the structural
-  // follow-up if the cold wall must drop further.
+  // chain decision (round-5 multi-base form): the union anchors on a
+  // LIST of cached per-row base intents and builds only the thin
+  // remainder — O(tail) per topic instead of O(total), the whole
+  // cold-stream game on shallow-'#' corpora. Heavy cold sets look like
+  // [~280, 63, 61, 50, thin...]: their fat-row COMBINATIONS almost
+  // never repeat (measured: 2,781 distinct subsets across 2,783
+  // multi-fat topics at 1M subs — a flattened per-subset base can
+  // never amortize and measured strictly slower), but each ROW repeats
+  // across many topics, so every row at or above base_min_row becomes
+  // its own base. Bases must be pairwise client-disjoint (exact
+  // verdicts cached per row pair); an overlapping row drops to the
+  // tail, which keeps the fold semantics single-act per client.
   constexpr Py_ssize_t kSlotMapCap = 512 * 1024;
-  Py_ssize_t bi = -1;
-  Py_ssize_t fat_plain = 0, tail_plain = 0;
+  constexpr int kMaxBases = 8;
+  const Py_ssize_t base_min_row =
+      g_multi_base ? std::max<Py_ssize_t>(16, g_chain_min_base / 4)
+                   : g_chain_min_base;
+  Py_ssize_t total_plain = 0, sum_base = 0;
+  Py_ssize_t cand[kMaxBases], cand_p[kMaxBases];
+  int n_cand = 0;
   if (n_rows > 1 && g_chain_enabled && allow_chain) {
-    Py_ssize_t total_plain = 0;
     for (Py_ssize_t i = 0; i < n_rows; i++) {
       const Py_ssize_t p =
           (off[rows[i] + 1] - off[rows[i]]) - t->shcount[rows[i]];
       total_plain += p;
-      if (p > fat_plain) {
-        fat_plain = p;
-        bi = i;
+      if (p >= base_min_row) {
+        if (n_cand < kMaxBases) {
+          cand[n_cand] = i;
+          cand_p[n_cand] = p;
+          n_cand++;
+          sum_base += p;
+        } else {
+          // keep the FATTEST kMaxBases candidates: replace the
+          // smallest (the fat anchor must never fall to the tail)
+          int sm = 0;
+          for (int cj = 1; cj < kMaxBases; cj++)
+            if (cand_p[cj] < cand_p[sm]) sm = cj;
+          if (p > cand_p[sm]) {
+            sum_base += p - cand_p[sm];
+            cand[sm] = i;
+            cand_p[sm] = p;
+          }
+        }
       }
     }
-    tail_plain = total_plain - fat_plain;
-    if (fat_plain < g_chain_min_base ||
-        tail_plain * g_chain_tail_den > fat_plain * g_chain_tail_num) {
+    if (!g_multi_base && n_cand > 1) {
+      // legacy form: only the fattest candidate anchors
+      int best = 0;
+      for (int ci = 1; ci < n_cand; ci++)
+        if (cand_p[ci] > cand_p[best]) best = ci;
+      cand[0] = cand[best];
+      cand_p[0] = cand_p[best];
+      n_cand = 1;
+      sum_base = cand_p[0];
+    }
+    if (sum_base < g_chain_min_base ||
+        (total_plain - sum_base) * g_chain_tail_den >
+            sum_base * g_chain_tail_num) {
       if (time_construct.armed) {
-        if (fat_plain < g_chain_min_base)
+        if (sum_base < g_chain_min_base)
           g_timing.decl_minbase++;
         else
           g_timing.decl_ratio++;
       }
-      bi = -1;
+      n_cand = 0;
     }
   } else if (time_construct.armed && n_rows == 1) {
     g_timing.single_row++;
   }
 
-  PyObject *base_res = nullptr;
-  std::unordered_map<int32_t, DecodeTable::BaseSlot> *sm = nullptr;
-  if (bi >= 0) {
-    const int32_t fat_row = rows[bi];
-    auto found = t->row_slot.find(fat_row);
-    if (found != t->row_slot.end()) {
-      sm = &found->second;
-    } else if (t->slot_entries + fat_plain <= kSlotMapCap) {
-      sm = &t->row_slot[fat_row];
-      sm->reserve(static_cast<size_t>(fat_plain) * 2);
-      int32_t slot = 0;
-      for (int64_t a = off[fat_row]; a < off[fat_row + 1]; a++) {
-        if (kind[a] == ACT_SHARED) continue;
-        sm->emplace(t->act_cidx[a], DecodeTable::BaseSlot{slot++, a});
-      }
-      t->slot_entries += fat_plain;
+  // resolve candidates (ascending row order) into accepted bases:
+  // slot map + pairwise disjointness + pinned single-row intents
+  IntentsObject *bases_acc[kMaxBases];
+  std::unordered_map<int32_t, DecodeTable::BaseSlot> *maps_acc[kMaxBases];
+  int32_t base_rows[kMaxBases];
+  Py_ssize_t base_ci[kMaxBases];  // candidate's index into rows[]
+  int k = 0;
+  Py_ssize_t kept_mass = 0;
+  bool have_impure = false;
+  auto drop_bases = [&]() {
+    for (int j = 0; j < k; j++)
+      Py_DECREF(reinterpret_cast<PyObject *>(bases_acc[j]));
+    k = 0;
+    kept_mass = 0;
+  };
+  // ascending row order (slot/base/fold invariants); the fattest-8
+  // replacement above can leave cand[] unordered
+  for (int a2 = 1; a2 < n_cand; a2++)
+    for (int b2 = a2; b2 > 0 && cand[b2] < cand[b2 - 1]; b2--) {
+      std::swap(cand[b2], cand[b2 - 1]);
+      std::swap(cand_p[b2], cand_p[b2 - 1]);
     }
-    if (sm) {
-      auto fb = t->row_base.find(fat_row);
-      if (fb != t->row_base.end()) {
-        base_res = Py_NewRef(fb->second);
-      } else {
-        g_timing_depth++;      // nested build: outer TimeAcc owns it
-        base_res = cached_intents_result(t, cap, &rows[bi], 1);
-        g_timing_depth--;
-        if (!base_res) {
-          Py_DECREF(key);
-          return nullptr;
-        }
-        // the recursive build can run Python (merge callbacks, GC
-        // finalizers) and re-enter this builder; only the emplace
-        // WINNER may deposit a reference, like row_shared's
-        // publish-once discipline
-        auto ins = t->row_base.emplace(fat_row, nullptr);
-        if (ins.second) ins.first->second = Py_NewRef(base_res);
+  TimeAcc time_resolve(&g_timing.resolve_ns);
+  for (int ci = 0; ci < n_cand; ci++) {
+    const int32_t r = rows[cand[ci]];
+    const Py_ssize_t p = cand_p[ci];
+    std::unordered_map<int32_t, DecodeTable::BaseSlot> *m;
+    auto found = t->row_slot.find(r);
+    if (found != t->row_slot.end()) {
+      m = &found->second;
+    } else if (t->slot_entries + p <= kSlotMapCap) {
+      m = &t->row_slot[r];
+      m->reserve(static_cast<size_t>(p) * 2);
+      int32_t slot = 0;
+      for (int64_t a = off[r]; a < off[r + 1]; a++) {
+        if (kind[a] == ACT_SHARED) continue;
+        m->emplace(t->act_cidx[a], DecodeTable::BaseSlot{slot++, a});
       }
+      t->slot_entries += p;
     } else {
       if (time_construct.armed) g_timing.decl_budget++;
-      bi = -1;  // slot-map budget exhausted: full union instead
+      continue;                 // budget: this row unions in the tail
     }
+    // purity rule (O(1)): pure rows share no client with ANY other
+    // row; an impure row may only be the single impure base
+    if (t->row_impure[r]) {
+      if (have_impure) continue;   // could overlap a kept base: tail it
+      have_impure = true;
+    }
+    PyObject *b;
+    auto fb = t->row_base.find(r);
+    if (fb != t->row_base.end()) {
+      b = Py_NewRef(fb->second);
+    } else {
+      g_timing_depth++;        // nested build: outer TimeAcc owns it
+      int32_t one = r;
+      b = cached_intents_result(t, cap, &one, 1);
+      g_timing_depth--;
+      if (!b) {
+        drop_bases();
+        Py_DECREF(key);
+        return nullptr;
+      }
+      // the recursive build can run Python (merge callbacks, GC
+      // finalizers) and re-enter this builder; only the emplace
+      // WINNER may deposit a reference, like row_shared's
+      // publish-once discipline
+      auto ins = t->row_base.emplace(r, nullptr);
+      if (ins.second) ins.first->second = Py_NewRef(b);
+    }
+    bases_acc[k] = reinterpret_cast<IntentsObject *>(b);
+    maps_acc[k] = m;
+    base_rows[k] = r;
+    base_ci[k] = cand[ci];
+    kept_mass += p;
+    k++;
+  }
+  if (time_resolve.armed) {
+    g_timing.resolve_ns += now_ns() - time_resolve.t0;
+    time_resolve.armed = false;
+  }
+  // dropped candidates grew the tail: the chain must still win
+  if (k && (kept_mass < g_chain_min_base ||
+            (total_plain - kept_mass) * g_chain_tail_den >
+                kept_mass * g_chain_tail_num)) {
+    if (time_construct.armed) {
+      if (kept_mass < g_chain_min_base)
+        g_timing.decl_minbase++;
+      else
+        g_timing.decl_ratio++;
+    }
+    drop_bases();
   }
 
-  const bool chained = bi >= 0;
-  const Py_ssize_t tail_n = chained ? tail_plain : 0;
+  const bool chained = k > 0;
+  const Py_ssize_t tail_n = chained ? total_plain - kept_mass : 0;
   IntentsObject *it =
       intents_alloc(cap, chained ? tail_n : total - sh_pairs);
   if (!it) {
-    Py_XDECREF(base_res);
+    drop_bases();
     Py_DECREF(key);
     return nullptr;
   }
   if (time_construct.armed) {
     if (chained) g_timing.chained++;
+    if (k > 1) g_timing.multi_base++;
     g_timing.entries_built += chained ? tail_n : total - sh_pairs;
   }
+  std::vector<char> is_base_i;
   if (chained) {
-    it->base = reinterpret_cast<IntentsObject *>(base_res);  // owns it
+    char *blk = static_cast<char *>(PyMem_Malloc(
+        k * sizeof(IntentsObject *) + (k + 1) * sizeof(int32_t)));
+    if (!blk) {
+      drop_bases();
+      Py_DECREF(key);
+      Py_DECREF(it);
+      PyErr_NoMemory();
+      return nullptr;
+    }
+    it->bases = reinterpret_cast<IntentsObject **>(blk);
+    it->base_off = reinterpret_cast<int32_t *>(
+        blk + k * sizeof(IntentsObject *));
+    it->base_off[0] = 0;
+    for (int j = 0; j < k; j++) {
+      it->bases[j] = bases_acc[j];  // ref transferred
+      it->base_off[j + 1] =
+          it->base_off[j] + static_cast<int32_t>(bases_acc[j]->n);
+    }
+    it->n_bases = k;
+    is_base_i.assign(n_rows, 0);
+    for (int j = 0; j < k; j++) is_base_i[base_ci[j]] = 1;
     if (tail_n) {
       // one block: PyObject* array first (alignment), slots after
       char *ob = static_cast<char *>(PyMem_Malloc(
@@ -1572,14 +1749,16 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
   }
   // override build state: a chained union must produce EXACTLY what
   // the ascending-row-order union produces for a client present in
-  // both base row(s) and tail rows — qos max and identifier union
+  // both a base row and tail rows — qos max and identifier union
   // are order-free, but merge_subscription takes flags from the NEWER
-  // (= higher row id) filter, so each base contribution is folded in
+  // (= higher row id) filter, so the base contribution is folded in
   // at its ordered position via its raw action, not merged
-  // first-come.
+  // first-come. Bases are pairwise disjoint, so each client has at
+  // most ONE base act.
   struct OvrBuild {
-    int32_t slot;      // base slot shadowed
+    int32_t slot;      // GLOBAL base slot shadowed
     int64_t base_act;  // the base row's action for this client
+    int32_t base_row;  // its row (fold ordering)
     PyObject *cur;     // accumulated entry; owned iff owned
     bool owned;
     bool folded;       // base contribution already applied
@@ -1661,7 +1840,7 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
   // union degenerates to a straight sequential copy of the stream.
   // A chained build unions only the tail rows, so the same shortcut
   // applies when the tail is a single row.
-  const Py_ssize_t n_union_rows = n_rows - (chained ? 1 : 0);
+  const Py_ssize_t n_union_rows = n_rows - k;
   const bool dedupe = n_union_rows > 1;
   const bool fast = dedupe && guard.owned;
   uint32_t e32 = 0;
@@ -1705,7 +1884,7 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
       // base is this client's first contribution: the entry form the
       // union would hold after the base row (ACT_MERGE base actions
       // are already pre-merged inside the base intents)
-      ob.cur = it->base->subs[ob.slot];
+      ob.cur = base_sub_at(it, ob.slot);
       ob.owned = false;
       return true;
     }
@@ -1720,7 +1899,6 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
     ob.owned = true;
     return true;
   };
-  const int32_t fat_row_id = bi >= 0 ? rows[bi] : -1;
   Py_ssize_t n = 0;
   // The union is DRAM-latency-bound: every action's mark[] slot is a
   // random 8-byte access into a table that is tens of MB at 1M clients
@@ -1743,34 +1921,44 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
     if (pc >= 0) PREFETCH_W(&t->mark[pc]);
   };
   for (Py_ssize_t i = 0; i < n_rows; i++) {
-    if (chained && i == bi) continue;  // the base carries the fat row
+    if (chained && is_base_i[i]) continue;  // bases carry these rows
     const int64_t r = rows[i];
     for (int64_t a = off[r]; a < off[r + 1]; a++) {
       if (fast) prefetch_at(i, a);
-      const uint8_t k = kind[a];
-      if (k == ACT_SHARED) continue;   // prebuilt per-row maps above
+      const uint8_t kk = kind[a];
+      if (kk == ACT_SHARED) continue;  // prebuilt per-row maps above
       const int32_t c = t->act_cidx[a];
-      if (sm) {
-        // same client also in a base row: shadow the base slot with
-        // a merged record instead of adding a duplicate tail entry
-        auto f = sm->find(c);
-        if (f != sm->end()) {
-          const auto &bs = f->second;
+      if (chained) {
+        // same client also in a base row (at most one: bases are
+        // pairwise disjoint): shadow the GLOBAL base slot with a
+        // merged record instead of adding a duplicate tail entry
+        const DecodeTable::BaseSlot *hit = nullptr;
+        int hit_j = 0;
+        for (int j = 0; j < k; j++) {
+          auto f = maps_acc[j]->find(c);
+          if (f != maps_acc[j]->end()) {
+            hit = &f->second;
+            hit_j = j;
+            break;
+          }
+        }
+        if (hit) {
+          const int32_t gslot = it->base_off[hit_j] + hit->slot;
           size_t oi;
-          auto fi = ovr_index.find(bs.slot);
+          auto fi = ovr_index.find(gslot);
           if (fi != ovr_index.end()) {
             oi = fi->second;
           } else {
             oi = ovr_build.size();
-            ovr_index.emplace(bs.slot, oi);
-            ovr_build.push_back({bs.slot, bs.act, nullptr, false,
-                                 false});
+            ovr_index.emplace(gslot, oi);
+            ovr_build.push_back({gslot, hit->act, base_rows[hit_j],
+                                 nullptr, false, false});
           }
           OvrBuild &ob = ovr_build[oi];
-          if (fat_row_id < r && !fold_base(ob)) return bail();
+          if (ob.base_row < r && !fold_base(ob)) return bail();
           if (!ob.cur) {
-            // first contribution, base row not yet due (r < fat)
-            if (k == ACT_MERGE) {
+            // first contribution, base row not yet due (r < base row)
+            if (kk == ACT_MERGE) {
               PyObject *mg = PyObject_CallFunctionObjArgs(
                   g_merge_fn, Py_None, t->sub[a], t->key[a], nullptr);
               if (!mg) return bail();
@@ -1780,7 +1968,7 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
               ob.cur = t->sub[a];
               ob.owned = false;
             }
-          } else if (k == ACT_PLAIN && ob.cur == t->sub[a]) {
+          } else if (kk == ACT_PLAIN && ob.cur == t->sub[a]) {
             // same record twice (duplicate filter rows)
           } else {
             PyObject *mg = PyObject_CallFunctionObjArgs(
@@ -1797,7 +1985,7 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
       if (j < 0) {
         record_slot(c, n);
         it->cids[n] = t->cid[a];
-        if (k == ACT_MERGE) {
+        if (kk == ACT_MERGE) {
           // v5 identifiers: ALWAYS through merge_subscription so the
           // identifier-union copy semantics hold from the first insert
           PyObject *mg = PyObject_CallFunctionObjArgs(
@@ -1811,7 +1999,7 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
         }
         it->n = ++n;  // keep n consistent for dealloc on error
       } else {
-        if (k == ACT_PLAIN && it->subs[j] == t->sub[a])
+        if (kk == ACT_PLAIN && it->subs[j] == t->sub[a])
           continue;  // same record twice (duplicate filter rows)
         PyObject *mg = PyObject_CallFunctionObjArgs(
             g_merge_fn, it->subs[j], t->sub[a], t->key[a], nullptr);
@@ -1834,7 +2022,7 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
                 return x.slot < y.slot;
               });
     for (auto &ob : ovr_build) {
-      if (ob.cur == it->base->subs[ob.slot]) {
+      if (ob.cur == base_sub_at(it, ob.slot)) {
         if (ob.owned) Py_DECREF(ob.cur);
         ob.cur = nullptr;
         ob.owned = false;
@@ -2072,6 +2260,9 @@ PyMethodDef methods[] = {
      "PROFILING: reset and enable(1)/disable(0) decode section timers."},
     {"_timing_get", timing_get, METH_NOARGS,
      "PROFILING: accumulated decode section times (ns) since reset."},
+    {"_set_multi_base", set_multi_base, METH_O,
+     "TEST/TUNING: enable/disable multi-row base composition (off = "
+     "legacy single-fattest-row chaining)."},
     {"_set_chain_params", set_chain_params, METH_VARARGS,
      "TEST/TUNING: (min_base, tail_num, tail_den) — chain when the "
      "fattest row has >= min_base plain entries and tail <= "

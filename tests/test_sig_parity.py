@@ -1021,3 +1021,79 @@ def test_single_topic_surface_serves_from_host():
     got = eng.subscribers(topic="s/x/t")
     assert "c1" in _as_set(got).subscriptions
     assert eng.host_matches == 1
+
+
+def test_intents_multi_base_composition():
+    """Round-5 multi-base chains: a row set holding several DISJOINT
+    fat rows composes per-row cached bases (fat-row combinations never
+    repeat on cold streams, but each row does — measured in
+    BASELINE-COMPARE) and must stay full-field-identical to both the
+    legacy single-fattest-base form and the full union. A client
+    subscribed into TWO fat rows makes both rows impure: at most one
+    may anchor, and parity must still hold."""
+    mod = _native_mod()
+    if not hasattr(mod, "_set_multi_base"):
+        pytest.skip("multi-base toggle unavailable")
+
+    def build_engine():
+        idx = TopicIndex()
+        # three fat buckets all matching mb/x/a/b
+        for i in range(90):
+            idx.subscribe(f"fa{i}", Subscription(filter="mb/#", qos=1))
+        for i in range(40):
+            idx.subscribe(f"fb{i}", Subscription(
+                filter="mb/x/#", qos=0, retain_handling=1))
+        for i in range(24):
+            idx.subscribe(f"fc{i}", Subscription(filter="mb/x/a/#",
+                                                 qos=2))
+        # impure pair: one client delivering from TWO fat rows
+        idx.subscribe("fa0", Subscription(filter="mb/x/#", qos=2,
+                                          no_local=True))
+        # thin tail incl. a base-collision override with v5 identifier
+        idx.subscribe("thin1", Subscription(filter="mb/x/a/b", qos=1))
+        idx.subscribe("fb3", Subscription(filter="mb/+/a/b", qos=2,
+                                          identifier=5))
+        eng = _intents_engine(idx)
+        eng.route_small = False
+        return eng
+
+    topics = ["mb/x/a/b", "mb/x/a/c", "mb/q", "mb/x/zz"]
+
+    def snapshot(eng):
+        got = eng.collect_fixed(topics, eng.dispatch_fixed(topics))
+        out = []
+        for r in got:
+            s = r.to_set() if hasattr(r, "to_set") else r
+            out.append((sorted(
+                (cid, v.filter, v.qos, v.no_local,
+                 v.retain_as_published, v.retain_handling, v.identifier,
+                 tuple(sorted(v.identifiers.items())))
+                for cid, v in s.subscriptions.items()),
+                sorted((g, f, tuple(sorted(m)))
+                       for (g, f), m in s.shared.items())))
+        return got, out
+
+    def max_bases(results):
+        best = 0
+        for r in results:
+            rep = repr(r)
+            if "bases=" in rep:
+                best = max(best, int(rep.split("bases=")[1].split(",")[0]))
+        return best
+
+    try:
+        mod._set_chain_params(32, 4, 1)
+        multi_res, multi = snapshot(build_engine())
+        assert max_bases(multi_res) >= 2, \
+            [repr(r) for r in multi_res]
+        mod._set_multi_base(False)
+        single_res, single = snapshot(build_engine())
+        assert max_bases(single_res) <= 1
+        mod._set_chain_enabled(False)
+        _, plain = snapshot(build_engine())
+    finally:
+        mod._set_chain_enabled(True)
+        mod._set_multi_base(True)
+        mod._set_chain_params(64, 1, 1)
+    assert multi == plain
+    assert single == plain
