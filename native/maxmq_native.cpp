@@ -23,6 +23,8 @@
 #include <cstring>
 #include <string>
 #include <string_view>
+#include <atomic>
+#include <mutex>
 #include <thread>
 #include <unordered_map>
 #include <vector>
@@ -52,13 +54,26 @@ struct Vocab {
   std::vector<Entry> entries;
   std::vector<int32_t> slots;   // index into entries, -1 = empty
   uint64_t mask = 0;
-  bool dirty = false;
+  // Lazy-build synchronization: concurrent matcher threads share one
+  // Vocab per compiled table (the churn suite storms exactly this),
+  // and the FIRST batch after a rotation finds it dirty — without the
+  // lock two threads would rebuild slots/mask under each other's
+  // probes. dirty is atomic with release/acquire pairing so a reader
+  // that sees dirty == false also sees the completed slots.
+  std::atomic<bool> dirty{false};
+  std::mutex build_mu;
 
   void add(const char* s, int64_t len, int32_t id) {
     entries.push_back({fnv1a(s, len), static_cast<uint32_t>(pool.size()),
                        static_cast<uint32_t>(len), id});
     pool.append(s, len);
-    dirty = true;
+    dirty.store(true, std::memory_order_release);
+  }
+
+  void ensure_built() {
+    if (!dirty.load(std::memory_order_acquire)) return;
+    std::lock_guard<std::mutex> g(build_mu);
+    if (dirty.load(std::memory_order_relaxed)) build();
   }
 
   void build() {
@@ -78,7 +93,7 @@ struct Vocab {
       }
       if (slots[h] == -1) slots[h] = static_cast<int32_t>(e);
     }
-    dirty = false;
+    dirty.store(false, std::memory_order_release);
   }
 
   int32_t find(const char* s, size_t len) const {
@@ -201,7 +216,7 @@ void mq_tokenize(void* v, const char* buf, const int64_t* offsets,
                  int64_t n_topics, int64_t max_levels, int32_t* toks,
                  int32_t* lengths, uint8_t* dollar) {
   Vocab* vb = static_cast<Vocab*>(v);
-  if (vb->dirty) vb->build();
+  vb->ensure_built();
   const Vocab& map = *vb;
   for (int64_t i = 0; i < n_topics; ++i) {
     const char* start = buf + offsets[i];
@@ -240,7 +255,7 @@ void mq_tokenize_joined(void* v, const char* buf, int64_t buf_len,
                         int64_t n_topics, int64_t max_levels, int32_t* toks,
                         int32_t* lengths, uint8_t* dollar) {
   Vocab* vb = static_cast<Vocab*>(v);
-  if (vb->dirty) vb->build();
+  vb->ensure_built();
   const Vocab& map = *vb;
   int64_t topic_start = 0;
   int64_t i = 0;
@@ -295,7 +310,7 @@ void mq_tokenize_sig(void* v, const char* buf, int64_t buf_len,
                      const uint8_t* exact_present, int64_t max_exact_d,
                      void* toks_out, int8_t* lens_out, uint32_t* esig_out) {
   Vocab* vb = static_cast<Vocab*>(v);
-  if (vb->dirty) vb->build();
+  vb->ensure_built();
   const Vocab& map = *vb;
   constexpr int64_t kDepthCap = 63;
   uint8_t* t8 = static_cast<uint8_t*>(toks_out);
@@ -565,7 +580,7 @@ int64_t mq_tokenize_probe(void* v, void* h, const char* buf, int64_t buf_len,
                           void* toks_out, int8_t* lens_out, int64_t* ti_out,
                           int32_t* row_out, int64_t cap) {
   Vocab* vb = static_cast<Vocab*>(v);
-  if (vb->dirty) vb->build();
+  vb->ensure_built();
   const Vocab& map = *vb;
   const ProbeSet* set = static_cast<ProbeSet*>(h);
   if (n_topics <= 0) return 0;
