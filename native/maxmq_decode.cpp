@@ -1899,6 +1899,13 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
     ob.owned = true;
     return true;
   };
+  // Tail-collision probe gating: a client can sit in both a tail row
+  // and a base row only if BOTH rows are impure (that is the purity
+  // definition), and at most one kept base is impure — so pure tail
+  // rows probe nothing, and impure ones probe exactly one map.
+  int impure_j = -1;
+  for (int j = 0; j < k; j++)
+    if (t->row_impure[base_rows[j]]) impure_j = j;
   Py_ssize_t n = 0;
   // The union is DRAM-latency-bound: every action's mark[] slot is a
   // random 8-byte access into a table that is tens of MB at 1M clients
@@ -1928,19 +1935,15 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
       const uint8_t kk = kind[a];
       if (kk == ACT_SHARED) continue;  // prebuilt per-row maps above
       const int32_t c = t->act_cidx[a];
-      if (chained) {
-        // same client also in a base row (at most one: bases are
-        // pairwise disjoint): shadow the GLOBAL base slot with a
-        // merged record instead of adding a duplicate tail entry
+      if (chained && impure_j >= 0 && t->row_impure[r]) {
+        // same client also in a base row: only possible when both the
+        // tail row and a base row are impure, and at most one kept
+        // base is — probe exactly that one map
         const DecodeTable::BaseSlot *hit = nullptr;
-        int hit_j = 0;
-        for (int j = 0; j < k; j++) {
-          auto f = maps_acc[j]->find(c);
-          if (f != maps_acc[j]->end()) {
-            hit = &f->second;
-            hit_j = j;
-            break;
-          }
+        const int hit_j = impure_j;
+        {
+          auto f = maps_acc[hit_j]->find(c);
+          if (f != maps_acc[hit_j]->end()) hit = &f->second;
         }
         if (hit) {
           const int32_t gslot = it->base_off[hit_j] + hit->slot;
