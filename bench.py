@@ -473,6 +473,9 @@ def bench_config(name: str, n_subs: int, batch: int, iters: int,
     batches = [topic_gen(batch, seed2=100 + i) for i in range(iters)]
 
     run_sig(engine, batches[:1], depth)          # warm compile + slices
+    engine.emit_intents = True
+    engine.prewarm_decode_bases()   # chained-decode anchors, like boot
+    engine.emit_intents = False
     frozen = n_subs >= 100_000
     if frozen:
         # post-warm-up freeze (ADR 009): the warmed caches and compile
@@ -526,6 +529,7 @@ def _chain_ab(index, engine_kw, batch, iters, depth, topic_gen) -> dict:
             eng = SigEngine(index, auto_refresh=False, **engine_kw)
             eng.emit_intents = True
             eng.route_small = False
+            eng.prewarm_decode_bases()
             run_subscribers(eng, ab[:1], depth)      # warm compile
             t0 = time.perf_counter()
             run_subscribers(eng, ab, depth)

@@ -107,6 +107,9 @@ def build_matcher(conf: Config, broker: Broker):
     warm = getattr(engine, "warm_buckets", None)
     if warm is not None:
         warm(conf.matcher_max_batch)    # background bucket precompile
+    prewarm = getattr(engine, "prewarm_decode_bases", None)
+    if prewarm is not None:
+        prewarm()    # chained-decode anchors at the boot quiescent point
     return batcher
 
 

@@ -1500,13 +1500,67 @@ PyObject *row_shared(DecodeTable *t, Py_ssize_t r) {
   return t->rshared[r];
 }
 
+constexpr Py_ssize_t kSlotMapCap = 512 * 1024;
+
+PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
+                                const int32_t *rows, Py_ssize_t n_rows,
+                                bool allow_chain = true);
+
+// build-or-fetch row r's slot map and pinned single-row base intents
+// (shared by the chain resolution loop and prewarm_bases). Returns the
+// slot map, or nullptr when the map budget declines the row; *base_out
+// gets a NEW reference to the base intents, or nullptr on a python
+// error (PyErr set).
+std::unordered_map<int32_t, DecodeTable::BaseSlot> *
+ensure_row_base(DecodeTable *t, PyObject *cap, int32_t r, Py_ssize_t p,
+                PyObject **base_out) {
+  const auto *off = static_cast<const int64_t *>(t->offsets.buf);
+  const auto *kind = static_cast<const uint8_t *>(t->kinds.buf);
+  *base_out = nullptr;
+  std::unordered_map<int32_t, DecodeTable::BaseSlot> *m;
+  auto found = t->row_slot.find(r);
+  if (found != t->row_slot.end()) {
+    m = &found->second;
+  } else if (t->slot_entries + p <= kSlotMapCap) {
+    m = &t->row_slot[r];
+    m->reserve(static_cast<size_t>(p) * 2);
+    int32_t slot = 0;
+    for (int64_t a = off[r]; a < off[r + 1]; a++) {
+      if (kind[a] == ACT_SHARED) continue;
+      m->emplace(t->act_cidx[a], DecodeTable::BaseSlot{slot++, a});
+    }
+    t->slot_entries += p;
+  } else {
+    return nullptr;              // budget: row unions in the tail
+  }
+  PyObject *b;
+  auto fb = t->row_base.find(r);
+  if (fb != t->row_base.end()) {
+    b = Py_NewRef(fb->second);
+  } else {
+    g_timing_depth++;            // nested build: outer TimeAcc owns it
+    int32_t one = r;
+    b = cached_intents_result(t, cap, &one, 1, true);
+    g_timing_depth--;
+    if (!b) return m;            // PyErr set; *base_out stays null
+    // the recursive build can run Python (merge callbacks, GC
+    // finalizers) and re-enter this builder; only the emplace WINNER
+    // may deposit a reference, like row_shared's publish-once
+    // discipline
+    auto ins = t->row_base.emplace(r, nullptr);
+    if (ins.second) ins.first->second = Py_NewRef(b);
+  }
+  *base_out = b;
+  return m;
+}
+
 // build-or-fetch DeliveryIntents for one verified, sorted, deduped row
 // set; NEW reference. The union is an epoch-stamped dedupe over the
 // rows' action streams — int32/pointer writes only; merge_subscription
 // runs solely on same-client collisions and v5-identifier entries.
 PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
                                 const int32_t *rows, Py_ssize_t n_rows,
-                                bool allow_chain = true) {
+                                bool allow_chain) {
   PyObject *key = PyBytes_FromStringAndSize(
       reinterpret_cast<const char *>(rows),
       n_rows * (Py_ssize_t)sizeof(int32_t));
@@ -1543,7 +1597,6 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
   // its own base. Bases must be pairwise client-disjoint (exact
   // verdicts cached per row pair); an overlapping row drops to the
   // tail, which keeps the fold semantics single-act per client.
-  constexpr Py_ssize_t kSlotMapCap = 512 * 1024;
   constexpr int kMaxBases = 8;
   const Py_ssize_t base_min_row =
       g_multi_base ? std::max<Py_ssize_t>(16, g_chain_min_base / 4)
@@ -1627,50 +1680,22 @@ PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
   for (int ci = 0; ci < n_cand; ci++) {
     const int32_t r = rows[cand[ci]];
     const Py_ssize_t p = cand_p[ci];
-    std::unordered_map<int32_t, DecodeTable::BaseSlot> *m;
-    auto found = t->row_slot.find(r);
-    if (found != t->row_slot.end()) {
-      m = &found->second;
-    } else if (t->slot_entries + p <= kSlotMapCap) {
-      m = &t->row_slot[r];
-      m->reserve(static_cast<size_t>(p) * 2);
-      int32_t slot = 0;
-      for (int64_t a = off[r]; a < off[r + 1]; a++) {
-        if (kind[a] == ACT_SHARED) continue;
-        m->emplace(t->act_cidx[a], DecodeTable::BaseSlot{slot++, a});
-      }
-      t->slot_entries += p;
-    } else {
+    // purity rule (O(1)): pure rows share no client with ANY other
+    // row; an impure row may only be the single impure base
+    if (t->row_impure[r] && have_impure)
+      continue;                 // could overlap a kept base: tail it
+    PyObject *b = nullptr;
+    auto *m = ensure_row_base(t, cap, r, p, &b);
+    if (!m) {
       if (time_construct.armed) g_timing.decl_budget++;
       continue;                 // budget: this row unions in the tail
     }
-    // purity rule (O(1)): pure rows share no client with ANY other
-    // row; an impure row may only be the single impure base
-    if (t->row_impure[r]) {
-      if (have_impure) continue;   // could overlap a kept base: tail it
-      have_impure = true;
+    if (!b) {
+      drop_bases();
+      Py_DECREF(key);
+      return nullptr;
     }
-    PyObject *b;
-    auto fb = t->row_base.find(r);
-    if (fb != t->row_base.end()) {
-      b = Py_NewRef(fb->second);
-    } else {
-      g_timing_depth++;        // nested build: outer TimeAcc owns it
-      int32_t one = r;
-      b = cached_intents_result(t, cap, &one, 1);
-      g_timing_depth--;
-      if (!b) {
-        drop_bases();
-        Py_DECREF(key);
-        return nullptr;
-      }
-      // the recursive build can run Python (merge callbacks, GC
-      // finalizers) and re-enter this builder; only the emplace
-      // WINNER may deposit a reference, like row_shared's
-      // publish-once discipline
-      auto ins = t->row_base.emplace(r, nullptr);
-      if (ins.second) ins.first->second = Py_NewRef(b);
-    }
+    if (t->row_impure[r]) have_impure = true;
     bases_acc[k] = reinterpret_cast<IntentsObject *>(b);
     maps_acc[k] = m;
     base_rows[k] = r;
@@ -2238,6 +2263,52 @@ PyObject *decode_batch(PyObject *, PyObject *args) {
   return decode_batch_impl(args, false);
 }
 
+// prewarm_bases(capsule, start_row, max_builds) -> next_row.
+// Builds the chained-decode anchors (slot map + pinned single-row
+// intents) for every row at or above the LIVE runtime base bar,
+// starting at start_row, until max_builds rows were built or the
+// prewarm budget closes (3/4 of the slot-map cap: the remainder stays
+// free for traffic-driven population of rows this row-order sweep
+// would otherwise starve on over-budget tables). Returns the row to
+// resume from (== the table's row count when finished), so engines can
+// populate the anchors in bounded chunks at compile/boot time instead
+// of paying the ramp across the first few hundred thousand cold
+// topics.
+PyObject *prewarm_bases(PyObject *, PyObject *args) {
+  PyObject *cap;
+  Py_ssize_t start, max_builds;
+  if (!PyArg_ParseTuple(args, "Onn", &cap, &start, &max_builds))
+    return nullptr;
+  auto *t = static_cast<DecodeTable *>(
+      PyCapsule_GetPointer(cap, "maxmq_decode.table"));
+  if (!t) return nullptr;
+  const auto *off = static_cast<const int64_t *>(t->offsets.buf);
+  const Py_ssize_t bar =
+      g_multi_base ? std::max<Py_ssize_t>(16, g_chain_min_base / 4)
+                   : g_chain_min_base;
+  Py_ssize_t built = 0;
+  Py_ssize_t r = start < 0 ? 0 : start;
+  for (; r < t->R && built < max_builds; r++) {
+    const Py_ssize_t p = (off[r + 1] - off[r]) - t->shcount[r];
+    if (p < bar) continue;
+    if (t->row_slot.count(static_cast<int32_t>(r))) continue;
+    if (t->slot_entries + p > kSlotMapCap / 4 * 3) {
+      r = t->R;                  // prewarm budget closed
+      break;
+    }
+    PyObject *b = nullptr;
+    auto *m = ensure_row_base(t, cap, static_cast<int32_t>(r), p, &b);
+    if (!m) {
+      r = t->R;                  // budget closed: nothing more to build
+      break;
+    }
+    if (!b) return nullptr;      // python error from the base build
+    Py_DECREF(b);
+    built++;
+  }
+  return PyLong_FromSsize_t(r);
+}
+
 PyObject *decode_batch_intents(PyObject *, PyObject *args) {
   return decode_batch_impl(args, true);
 }
@@ -2259,6 +2330,9 @@ PyMethodDef methods[] = {
     {"_set_chain_enabled", set_chain_enabled, METH_O,
      "TEST ONLY: disable/enable the chained-union fast path so the "
      "suite can A/B chained vs full unions of the same row sets."},
+    {"prewarm_bases", prewarm_bases, METH_VARARGS,
+     "Build chained-decode row anchors in bounded chunks "
+     "(capsule, start_row, max_builds) -> next_row."},
     {"_timing_reset", timing_reset, METH_O,
      "PROFILING: reset and enable(1)/disable(0) decode section timers."},
     {"_timing_get", timing_get, METH_NOARGS,
