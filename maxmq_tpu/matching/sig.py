@@ -1045,6 +1045,30 @@ def _decode_cache(tables):
     return dc
 
 
+def prewarm_tables(tables, chunk: int = 2048) -> int:
+    """Chunked chained-decode anchor population for ONE compiled table
+    (the shared engine-independent half of prewarm_decode_bases):
+    yields the GIL between chunks so an event loop sharing the
+    interpreter only stalls ~ms at a time. Returns chunk calls made."""
+    import time as _time
+
+    nd = _native_decode(tables)
+    if nd is None or not hasattr(nd[0], "prewarm_bases"):
+        return 0
+    mod, cap = nd
+    n_rows = len(tables.row_entries)
+    r = 0
+    calls = 0
+    while r < n_rows:
+        r2 = mod.prewarm_bases(cap, r, chunk)
+        calls += 1
+        if r2 <= r:
+            break                  # defensive: no forward progress
+        r = r2
+        _time.sleep(0)
+    return calls
+
+
 def _native_decode(tables):
     """(maxmq_decode module, table capsule) for the C verify+union fast
     path, built once per compiled snapshot — or None when the extension
@@ -1290,9 +1314,9 @@ class OverlayedEngine:
             if warm_max:
                 self.warm_buckets(warm_max, background=False)
             # repopulate the chained-decode anchors for the fresh
-            # table off the hot path (chunked; yields the GIL);
-            # getattr: the sharded engine shares this refresh path but
-            # not the anchor machinery
+            # table off the hot path (chunked; yields the GIL); the
+            # sharded engine provides its own cluster form of this
+            # method, hence the getattr indirection
             getattr(self, "prewarm_decode_bases", lambda: 0)()
         except Exception:
             self.bg_refresh_errors += 1
@@ -2165,21 +2189,7 @@ class SigEngine(OverlayedEngine):
         tables = self._state[0] if self._state else None
         if tables is None:
             return 0
-        nd = _native_decode(tables)
-        if nd is None or not hasattr(nd[0], "prewarm_bases"):
-            return 0
-        mod, cap = nd
-        n_rows = len(tables.row_entries)
-        r = 0
-        calls = 0
-        while r < n_rows:
-            r2 = mod.prewarm_bases(cap, r, chunk)
-            calls += 1
-            if r2 <= r:
-                break              # defensive: no forward progress
-            r = r2
-            time.sleep(0)          # let the event loop take the GIL
-        return calls
+        return prewarm_tables(tables, chunk)
 
     @staticmethod
     def _add_row(result: SubscriberSet, row: int, tables: SigTables,

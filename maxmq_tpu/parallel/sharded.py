@@ -445,32 +445,18 @@ class ShardedSigEngine(OverlayedEngine):
     def prewarm_decode_bases(self, chunk: int = 2048) -> int:
         """Cluster form of SigEngine.prewarm_decode_bases: populate the
         chained-decode anchors for every SHARD's table at a quiescent
-        point (called by the boot path via getattr). Returns total
-        chunk calls made."""
+        point (called by the boot path and the background refresh via
+        getattr). Skipped when the shards compiled via the round-robin
+        fallback (chain_ok False, state[7]) — the intents decode never
+        runs there, so anchors would be pinned dead weight. Returns
+        total chunk calls made."""
         if not self.emit_intents or not self._state:
             return 0
-        shards = self._state[1]
-        if not shards:
+        shards, chain_ok = self._state[1], self._state[7]
+        if not shards or not chain_ok:
             return 0
-        import time as _time
-
-        from ..matching.sig import _native_decode
-        calls = 0
-        for tables in shards:
-            nd = _native_decode(tables)
-            if nd is None or not hasattr(nd[0], "prewarm_bases"):
-                continue
-            mod, cap = nd
-            n_rows = len(tables.row_entries)
-            r = 0
-            while r < n_rows:
-                r2 = mod.prewarm_bases(cap, r, chunk)
-                calls += 1
-                if r2 <= r:
-                    break
-                r = r2
-                _time.sleep(0)
-        return calls
+        from ..matching.sig import prewarm_tables
+        return sum(prewarm_tables(t, chunk) for t in shards)
 
     def match_raw(self, topics: list[str]):
         """Sharded device match. Returns (out uint32[sp, B, 1+max_rows],
