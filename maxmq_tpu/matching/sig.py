@@ -40,7 +40,6 @@ topics.go:484-555 (`Subscribers`/`scanSubscribers`).
 from __future__ import annotations
 
 import threading
-import time
 from dataclasses import dataclass
 
 import numpy as np
