@@ -2291,6 +2291,13 @@ PyObject *prewarm_bases(PyObject *, PyObject *args) {
   for (; r < t->R && built < max_builds; r++) {
     const Py_ssize_t p = (off[r + 1] - off[r]) - t->shcount[r];
     if (p < bar) continue;
+    // anchor-eligible $share rows: prebuild the per-row shared map
+    // too (same first-touch class, same eligibility bar — sub-bar
+    // rows keep building theirs lazily on first touch)
+    if (t->shcount[r] && !t->rshared[r]) {
+      if (!row_shared(t, r)) return nullptr;
+      built++;
+    }
     if (t->row_slot.count(static_cast<int32_t>(r))) continue;
     if (t->slot_entries + p > kSlotMapCap / 4 * 3) {
       r = t->R;                  // prewarm budget closed
