@@ -5,6 +5,7 @@
 # baked into this image, so the gates are first-party (tools/qa.py).
 
 PY ?= python
+SHELL := /bin/bash           # pipefail in the test target
 
 .PHONY: all check lint cyclo test test-asan coverage native bench clean hooks
 
@@ -18,8 +19,10 @@ lint:
 cyclo:
 	$(PY) tools/qa.py cyclo --over 12
 
+# --tb=long is unconditional via pyproject addopts; keep the log so a
+# flake's first occurrence is diagnosable (docs/qa_report.md)
 test:
-	$(PY) -m pytest tests/ -x -q
+	set -o pipefail; $(PY) -m pytest tests/ -x -q 2>&1 | tee pytest.log
 
 coverage:
 	$(PY) tools/qa.py coverage --fail-under 80

@@ -371,6 +371,14 @@ def stage_decomposition(engine, topics_batch: list[str],
             engine, batch, d["device_only_topics_per_sec"])
     except Exception as exc:       # analysis must never cost the stages
         d["roofline"] = {"error": repr(exc)[:200]}
+    if engine.pallas_active:
+        # measured counterpart of the roofline's predicted width cut:
+        # 32-forced vs mixed on the same tables and batch
+        try:
+            d["kernel_width_ab"] = kernel_width_ab(
+                engine, topics_batch, iters)
+        except Exception as exc:
+            d["kernel_width_ab"] = {"error": repr(exc)[:200]}
     log(f"[stages] prep {d['host_prep_topics_per_sec']:,.0f}/s  "
         f"device {d['device_only_topics_per_sec']:,.0f}/s  "
         f"decode {d['decode_topics_per_sec']:,.0f}/s  "
@@ -401,6 +409,27 @@ def hbm_probe(mb: int = 256) -> dict:
             "gbps": round(2 * mb * reps / 1024 / dt, 1)}
 
 
+def _kernel_ops_model(p: dict, max_rows: int) -> dict:
+    """Predicted per-topic compute of the fused compare+extract for one
+    kernel plan. ``plane_compare_ops`` is the round-5 model's unit (one
+    compare + one accumulate per plane pass per column): the packed
+    16-bit planes run 16 passes per 32 rows instead of 32, so this
+    HALVES on fully-16-bit-eligible tables. ``vpu_ops`` additionally
+    costs the packed pass's SWAR glue honestly (xor + borrow-detect +
+    accumulate ~ 3 ops vs the 32-bit pass's 2) and the min-extract
+    tail, so it is the conservative total."""
+    w32 = p["n_chunks32"] * p["chunk32"]
+    w16 = p["n_chunks16"] * p["chunk16"]
+    passes = 32 * w32 + 16 * w16
+    return {
+        "plane_passes_per_topic": passes,
+        "plane_compare_ops_per_topic": passes * 2,
+        "vpu_ops_per_topic": (32 * 2 * w32 + 16 * 3 * w16
+                              + max_rows * 2 * (w32 + w16)),
+        "plane_const_bytes": passes * 4,
+    }
+
+
 def kernel_roofline(engine, batch: int,
                     measured_device_topics_per_sec: float) -> dict:
     """Analytic HBM-traffic and VPU-op model of the fused signature
@@ -414,42 +443,131 @@ def kernel_roofline(engine, batch: int,
                  (x2 arrays for the MXU expansion's lo/hi halves);
       outputs  — each chunk writes [B, 1+max_rows] u32 candidates, the
                  XLA merge reads them all back (x2 in the model);
-      constants— one-hot/group + 32 bit-planes, [*, w_full] u32/f32,
-                 read once per batch and amortized over B.
-    Compute model per topic: 32 plane compares + or/shift per word plus
-    max_rows min-extract passes per chunk column."""
+      constants— one-hot/group map per column + bit-planes (32 u32 rows
+                 per 32-bit column, 16 per packed 16-bit column), read
+                 once per batch and amortized over B.
+    Compute model per topic (``_kernel_ops_model``): plane-compare
+    passes per word column (32 or 16 by region width) plus max_rows
+    min-extract passes. The model is emitted for BOTH the live mixed
+    plan and the 32-bit-forced plan of the same tables, with the
+    predicted reduction alongside the measured rate — the width A/B row
+    (``kernel_width_ab``) is the measured counterpart."""
     from maxmq_tpu.matching.sig_pallas import SELECT_EXPAND_MAX, plan
 
     tables = engine.tables
-    p = plan(tables)
+    p = getattr(engine, "kernel_plan", None) or plan(tables)
     if p is None:
         return {"note": "XLA body in use (no pallas plan); model n/a"}
     hbm = hbm_probe()
-    g_pad, chunk, n_chunks = p["g_pad"], p["chunk"], p["n_chunks"]
-    w_full = n_chunks * chunk
+    g_pad, n_chunks = p["g_pad"], p["n_chunks"]
+    w_full = (p["n_chunks32"] * p["chunk32"]
+              + p["n_chunks16"] * p["chunk16"])
     max_rows = engine.fixed_max_rows
     select = len(tables.groups) <= SELECT_EXPAND_MAX
     sig_arrays = 1 if select else 2
     bytes_in = sig_arrays * g_pad * 4 * n_chunks + 4 * n_chunks
     bytes_out = n_chunks * (1 + max_rows) * 4 * 2      # write + merge read
     g_rows = 1 if select else g_pad
-    bytes_const = (32 + g_rows) * w_full * 4 / max(batch, 1)
+    ops = _kernel_ops_model(p, max_rows)
+    bytes_const = (ops["plane_const_bytes"]
+                   + g_rows * w_full * 4) / max(batch, 1)
     bytes_per_topic = bytes_in + bytes_out + bytes_const
     hbm_bound = hbm["gbps"] * 1e9 / bytes_per_topic
-    ops_per_topic = w_full * (32 * 2 + max_rows * 2)
+    ops_per_topic = ops["vpu_ops_per_topic"]
+    p32 = (p if p["force_width32"]
+           else plan(tables, force_width32=True))
+    ops32 = _kernel_ops_model(p32, max_rows) if p32 is not None else ops
     return {
         "kernel_shape": {"w_full": w_full, "g_pad": g_pad,
                          "chunks": n_chunks, "max_rows": max_rows,
-                         "expand": "select" if select else "mxu"},
+                         "expand": "select" if select else "mxu",
+                         "groups16": p["groups16"],
+                         "groups32": p["groups32"],
+                         "words16": p["n_words16"],
+                         "words32": p["n_words32"]},
         "measured_membw": hbm,
         "bytes_per_topic": round(bytes_per_topic, 1),
         "membw_bound_topics_per_sec": round(hbm_bound, 1),
         "pct_of_membw_roofline": round(
             100 * measured_device_topics_per_sec / hbm_bound, 2),
         "vpu_ops_per_topic": ops_per_topic,
+        "plane_compare_ops_per_topic": ops["plane_compare_ops_per_topic"],
+        "predicted_force32": {
+            "vpu_ops_per_topic": ops32["vpu_ops_per_topic"],
+            "plane_compare_ops_per_topic":
+                ops32["plane_compare_ops_per_topic"]},
+        "predicted_plane_compare_reduction_vs_32": round(
+            ops32["plane_compare_ops_per_topic"]
+            / max(ops["plane_compare_ops_per_topic"], 1), 3),
+        "predicted_vpu_ops_reduction_vs_32": round(
+            ops32["vpu_ops_per_topic"] / max(ops_per_topic, 1), 3),
+        "measured_device_topics_per_sec": round(
+            measured_device_topics_per_sec, 1),
         "implied_vpu_ops_per_sec": round(
             ops_per_topic * measured_device_topics_per_sec, 1),
     }
+
+
+def kernel_width_ab(engine, topics_batch: list[str],
+                    iters: int = 3) -> dict:
+    """32-bit-forced vs mixed-width fused kernels on IDENTICAL compiled
+    tables and an identical prepared batch: device-only topics/s per
+    arm, each arm's plan shape, and a candidate-count cross-check. The
+    mixed arm's counts must be a superset of the forced arm's wherever
+    neither overflows (a 16-bit fold can only ADD host-verified false
+    candidates or overflow to the exact fallback — never drop a true
+    match)."""
+    import jax
+
+    from maxmq_tpu.matching import sig_pallas
+    from maxmq_tpu.matching.sig import prepare_batch
+
+    tables = engine.tables
+    state = engine._state
+    if state[1] is None:
+        return {"note": "trie-only corpus; kernel width A/B n/a"}
+    consts = state[1]
+    toks8, lens_enc, _hostrows = prepare_batch(tables, topics_batch)
+    toks_dev = jax.device_put(toks8)
+    lens_dev = jax.device_put(lens_enc)
+    out: dict = {"batch": len(topics_batch), "iters": iters}
+    counts = {}
+    for label, force in (("mixed", False), ("force32", True)):
+        kplan = sig_pallas.plan(tables, force_width32=force)
+        if kplan is None:
+            out[label] = {"note": "no pallas plan"}
+            continue
+        fn, _fmt = sig_pallas.build_fixed_fn(
+            tables, consts, kplan, max_rows=engine.fixed_max_rows)
+        jax.block_until_ready(fn(toks_dev, lens_dev))   # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            res = fn(toks_dev, lens_dev)
+            jax.block_until_ready(res)
+        dt = time.perf_counter() - t0
+        cnt = np.asarray(res[0])
+        counts[label] = cnt
+        out[label] = {
+            "device_topics_per_sec": round(
+                len(topics_batch) * iters / dt, 1),
+            "groups16": kplan["groups16"],
+            "groups32": kplan["groups32"],
+            "words16": kplan["n_words16"],
+            "words32": kplan["n_words32"],
+            "plane_passes_per_topic": kplan["plane_passes_per_topic"],
+            "overflow_topics": int((cnt == 0xFF).sum()),
+            "matched_rows": int(
+                cnt[cnt != 0xFF].astype(np.int64).sum()),
+        }
+    if "mixed" in counts and "force32" in counts:
+        m, f = counts["mixed"], counts["force32"]
+        both = (m != 0xFF) & (f != 0xFF)
+        out["mixed_counts_superset_of_32"] = bool((m[both] >= f[both]).all())
+        fd = out["force32"]["device_topics_per_sec"]
+        if fd:
+            out["mixed_speedup_vs_force32"] = round(
+                out["mixed"]["device_topics_per_sec"] / fd, 3)
+    return out
 
 
 def bench_config(name: str, n_subs: int, batch: int, iters: int,
@@ -503,6 +621,36 @@ def bench_config(name: str, n_subs: int, batch: int, iters: int,
             gc.collect()
 
 
+def bench_kernel_width_ab(n_subs: int = 100_000, batch: int = 65_536,
+                          iters: int = 3) -> dict:
+    """Standalone kernel-width A/B config (MAXMQ_BENCH_CONFIGS=widthab;
+    the capture script's row): one compiled 100K mixed corpus, both
+    kernel widths on it, plus the roofline model evaluated at the mixed
+    arm's measured device rate."""
+    from maxmq_tpu.matching.sig import SigEngine
+
+    log(f"[widthab] corpus {n_subs} subs ...")
+    filters, topic_gen = build_corpus(n_subs)
+    index = build_index(filters)
+    engine = SigEngine(index, auto_refresh=False, fixed_max_rows=14)
+    out: dict = {"config": "kernel_width_ab", "subs": n_subs}
+    if not engine.pallas_active:
+        out["error"] = "pallas plan declined; width A/B needs the kernel"
+        return out
+    out.update(kernel_width_ab(engine, topic_gen(batch, seed2=42), iters))
+    try:
+        dev = out.get("mixed", {}).get("device_topics_per_sec", 0.0)
+        out["roofline"] = kernel_roofline(engine, batch, dev)
+    except Exception as exc:       # analysis must never cost the row
+        out["roofline"] = {"error": repr(exc)[:200]}
+    mixed = out.get("mixed", {})
+    log(f"[widthab] mixed {mixed.get('device_topics_per_sec', 0):,.0f}/s "
+        f"({mixed.get('groups16', 0)}g16/{mixed.get('groups32', 0)}g32)  "
+        f"force32 {out.get('force32', {}).get('device_topics_per_sec', 0):,.0f}/s  "
+        f"speedup {out.get('mixed_speedup_vs_force32', '?')}")
+    return out
+
+
 def _chain_ab(index, engine_kw, batch, iters, depth, topic_gen) -> dict:
     """Chain on/off A/B with per-arm engine isolation: the native
     intents cache is keyed by row-set bytes alone (chain-agnostic), so
@@ -512,7 +660,7 @@ def _chain_ab(index, engine_kw, batch, iters, depth, topic_gen) -> dict:
     arm actually chained (0 on exact corpora = chaining cannot tax
     them by construction)."""
     from maxmq_tpu.matching.sig import SigEngine
-    from maxmq_tpu.native import decode_module
+    from maxmq_tpu.native import chain_params_in_effect, decode_module
 
     mod = decode_module()
     if mod is None or not hasattr(mod, "_set_chain_params"):
@@ -522,6 +670,7 @@ def _chain_ab(index, engine_kw, batch, iters, depth, topic_gen) -> dict:
     # caches, so reuse is safe): the delta must measure chaining, not
     # per-seed workload variance
     ab = [topic_gen(batch, seed2=300 + i) for i in range(iters)]
+    saved_params = chain_params_in_effect(mod)
     try:
         for mode in ("on", "off"):
             if mode == "off":
@@ -541,7 +690,7 @@ def _chain_ab(index, engine_kw, batch, iters, depth, topic_gen) -> dict:
                         topic_gen(min(batch, 4096), seed2=555))
                     if getattr(r, "chained", False))
     finally:
-        mod._set_chain_params(64, 1, 1)
+        mod._set_chain_params(*saved_params)
     return out
 
 
@@ -850,13 +999,14 @@ for t, s in zip(topics[:64], got):
 # chained-intents decode A/B at the FULL corpus (r4 measured the gain
 # at 20K subs only). Fresh engine per arm: the native intents cache is
 # keyed by row-set bytes alone, chain-agnostic.
-from maxmq_tpu.native import decode_module
+from maxmq_tpu.native import chain_params_in_effect, decode_module
 mod = decode_module()
 chain = {}
 if mod is not None and hasattr(mod, "_set_chain_params"):
     # identical topics both arms (fresh engines isolate the caches):
     # the delta must measure chaining, not per-seed workload variance
     ts = topic_gen(BATCH, seed2=600)
+    saved_params = chain_params_in_effect(mod)
     try:
         for mode in ("on", "off"):
             if mode == "off":
@@ -869,7 +1019,7 @@ if mod is not None and hasattr(mod, "_set_chain_params"):
             chain["chain_%%s_matches_per_sec" %% mode] = round(
                 BATCH / (time.perf_counter() - t0), 1)
     finally:
-        mod._set_chain_params(64, 1, 1)
+        mod._set_chain_params(*saved_params)
 
 # end-to-end DELIVERY through a real broker wired to the sharded
 # matcher (BASELINE config 5: QoS1/2, $share, retained — not just
@@ -1302,6 +1452,13 @@ def main() -> None:
                                            n_requests=s(8_192),
                                            concurrency=1024,
                                            force_device=True)))
+    if "widthab" in which:
+        # 16-bit bit-plane cut A/B: 32-forced vs mixed-width kernels on
+        # one compiled table set (the round-6 tentpole's measured row)
+        runs.append(("kernel_width_ab",
+                     lambda: bench_kernel_width_ab(n_subs=s(100_000),
+                                                   batch=s(65_536),
+                                                   iters=iters)))
     if "5" in which:
         runs.append(("cluster", lambda: bench_cluster(subs=s(100_000))))
     if "e2e" in which:
@@ -1384,7 +1541,8 @@ def assemble_result(configs: list, link: dict, backend_name: str,
 # config that blows its deadline is recorded as wedged, not waited on
 CONFIG_DEADLINES = {"1": 900, "2": 900, "3": 1200, "4": 2400,
                     "4h": 2400, "lat": 900, "lath": 900, "latd": 900,
-                    "latdo": 1200, "5": 2400, "e2e": 4200}
+                    "latdo": 1200, "5": 2400, "e2e": 4200,
+                    "widthab": 1200}
 
 
 def run_supervised(which: list[str]) -> None:

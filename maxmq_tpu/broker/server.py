@@ -180,15 +180,36 @@ class Broker:
         defer the first table compile (and its gc.freeze, ADR 009) to
         the first publish, freezing mid-traffic transients along with
         the tables. Off the event loop: the compile can take seconds at
-        1M subscriptions, and nothing is being served yet."""
+        1M subscriptions, and nothing is being served yet.
+
+        Prewarm rides the same executor call: a synchronous refresh()
+        alone never populates the chained-decode anchors (only
+        _bg_refresh does), so a broker restored with a large
+        subscription set would pay the anchor-population ramp across
+        its first few hundred thousand publishes (ADVICE r5 #1)."""
         if self.matcher is None or self.topics.subscription_count == 0:
             return
         engine = getattr(self.matcher, "engine", self.matcher)
         refresh = getattr(engine, "refresh", None)
         if refresh is None:
             return
+
+        def compile_and_prewarm():
+            refresh()
+            prewarm = getattr(engine, "prewarm_decode_bases", None)
+            if prewarm is None:
+                return
+            try:
+                prewarm()
+            except Exception as exc:
+                # prewarm is a warm-up optimization: the compiled
+                # tables above are live either way, so a prewarm
+                # failure must not be reported as a compile failure
+                if self.log is not None:
+                    self.log.warn("boot-time decode prewarm failed",
+                                  error=repr(exc)[:200])
         try:
-            await self.loop.run_in_executor(None, refresh)
+            await self.loop.run_in_executor(None, compile_and_prewarm)
         except Exception as exc:
             # lazy refresh on first batch remains the fallback
             if self.log is not None:

@@ -1,5 +1,9 @@
 """Pallas TPU kernel for the dense trie walk — the fused-VMEM matcher.
 
+NOTE: this file is the DENSE-walk kernel (small/medium tables). The
+production signature matcher's fused kernels — including the dual-width
+packed 16-bit bit-planes (ADR 010) — live in sig_pallas.py.
+
 This is the "micro-batched Pallas trie-walk kernel" of the north star: the
 whole L-level walk runs inside ONE kernel, the active-state matrix never
 leaves VMEM between levels, and the one data-dependent operation of the walk

@@ -69,6 +69,42 @@ def _group_constants(key: tuple[bool, int, tuple[int, ...]],
     return c | np.uint32(1)
 
 
+W16_MAX_GROUP_ROWS = 512  # beyond this a collision-free 16-bit image is
+                          # birthday-improbable (p_fail/try ~ 1-e^(-n^2/2^17))
+                          # and the false-candidate rate (rows/2^16 per
+                          # topic) stops being noise
+_W16_FOLD_TRIES = 8
+_W16_PAD = np.uint16(0xFFFF)    # pad-row poison in the 16-bit planes
+
+
+def _fold16(sig: np.ndarray, mult) -> np.ndarray:
+    """Multiply-shift fold of uint32 signatures to 16 bits. The topic
+    side computes the same (sig * mult) >> 16 on device, so fold
+    equality is exactly plane equality; a topic-vs-row fold collision
+    is a wasted (host-verified) candidate, never a wrong delivery."""
+    with np.errstate(over="ignore"):
+        return ((sig * np.uint32(mult)) >> np.uint32(16)).astype(np.uint16)
+
+
+def _pick_fold16(g: "GroupSpec", sigs: np.ndarray):
+    """(mult, sig16) for a group whose signatures fit 16 bits: an odd
+    multiply-shift fold that is injective on the group's row signatures
+    (one word then still holds at most one true match, preserving the
+    kernel's single-bit extraction invariant) and avoids the 0xFFFF
+    pad poison — or None (the group keeps 32-bit planes)."""
+    if not 0 < len(sigs) <= W16_MAX_GROUP_ROWS:
+        return None
+    rng = np.random.default_rng((0x16B1, int(g.is_hash), g.depth,
+                                 *g.kept))
+    for m in rng.integers(0, 1 << 32, size=_W16_FOLD_TRIES,
+                          dtype=np.uint32):
+        m = int(m) | 1
+        f = _fold16(sigs, m)
+        if (f != _W16_PAD).all() and len(np.unique(f)) == len(f):
+            return m, f
+    return None
+
+
 @dataclass
 class GroupSpec:
     """One wildcard shape: every filter in it matches by signature equality."""
@@ -154,6 +190,17 @@ class SigTables:
                               # rows) — the device-free probe path
     probe_depth: int = 0      # deepest literal position ANY group reads
                               # (device or host_plus) = tokenizer window
+    # dual-width planes: groups whose signatures admit an injective
+    # 16-bit multiply-shift fold get packed 16-bit plane tables (two
+    # rows per uint32 word — half the compare passes and half the
+    # constant traffic in the fused kernel); the rest keep 32-bit
+    # planes. Groups are laid out 32-bit-first so each width is
+    # contiguous in word space (sig_pallas chunks stay single-width).
+    group_w16: np.ndarray = None   # bool[G] 16-bit-plane-eligible
+    fold_mult: np.ndarray = None   # uint32[G] odd fold mults (0 = 32-bit)
+    row_sig16: np.ndarray = None   # uint16[R_padded] folded row sigs
+                                   # (0xFFFF pad poison; 0 for 32-bit
+                                   # groups' rows — never compared)
 
     def tokenize(self, topics: list[str], max_levels: int):
         return tokenize_cached(self, topics, max_levels)
@@ -237,8 +284,23 @@ def compile_sig_subscriptions(subs, version: int = 0,  # qa: complex
     plus_specs = {k: group_map.pop(k) for k in plus_keys}
     plus_rows = {k: group_rows.pop(k) for k in plus_keys}
 
-    groups = list(group_map.values())
-    g_rows = [group_rows[k] for k in group_map]
+    # per-group signatures first: 16-bit plane eligibility needs them
+    # BEFORE the padded layout is fixed, because eligible groups are
+    # laid out after the 32-bit ones (contiguous word regions per width)
+    staged = []
+    for key, g in group_map.items():
+        rows = group_rows[key]
+        toks = np.zeros((len(rows), max(g.depth, 1)), dtype=np.int32)
+        for j, r in enumerate(rows):
+            levels = row_filt[r]
+            lits = levels[:-1] if g.is_hash else levels
+            for pos in g.kept:
+                toks[j, pos] = vocab[lits[pos]]
+        s = g.signature(toks)
+        staged.append((g, rows, s, _pick_fold16(g, s)))
+    # stable sort: 32-bit groups first, then the 16-bit-eligible ones
+    staged.sort(key=lambda t: t[3] is not None)
+    groups = [t[0] for t in staged]
 
     # padded row layout: groups contiguous, each padded to a multiple of 32
     max_depth = max((g.depth for g in groups), default=0)
@@ -248,12 +310,15 @@ def compile_sig_subscriptions(subs, version: int = 0,  # qa: complex
     is_hash_a = np.zeros(len(groups), dtype=bool)
     wild_first = np.zeros(len(groups), dtype=bool)
     group_words = np.zeros(len(groups), dtype=np.int32)
+    group_w16 = np.zeros(len(groups), dtype=bool)
+    fold_mult = np.zeros(len(groups), dtype=np.uint32)
 
     row_entries: list[tuple[int, ...]] = []
     row_levels: list[tuple[str, ...] | None] = []
     sigs: list[np.ndarray] = []
+    sigs16: list[np.ndarray] = []
     hash_sig_list: list[tuple[GroupSpec, np.ndarray]] = []
-    for gi, (g, rows) in enumerate(zip(groups, g_rows)):
+    for gi, (g, rows, s, fold) in enumerate(staged):
         for c, pos in zip(g.coef, g.kept):
             topo_coef[gi, pos] = c
         depth_coef[gi] = g.depth_coef
@@ -262,17 +327,11 @@ def compile_sig_subscriptions(subs, version: int = 0,  # qa: complex
         wild_first[gi] = g.wild_first
         n_pad = (-len(rows)) % 32
         group_words[gi] = (len(rows) + n_pad) // 32
-        toks = np.zeros((len(rows), max(g.depth, 1)), dtype=np.int32)
-        for j, r in enumerate(rows):
-            levels = row_filt[r]
-            lits = levels[:-1] if g.is_hash else levels
-            for pos in g.kept:
-                toks[j, pos] = vocab[lits[pos]]
+        for r in rows:
             row_entries.append(tuple(row_bits[r]))
-            row_levels.append(levels)
+            row_levels.append(row_filt[r])
         g.rows = list(range(len(row_entries) - len(rows),
                             len(row_entries)))
-        s = g.signature(toks)
         hash_sig_list.append((g, s))
         # padding rows get a poison signature: an all-zero pad sig would
         # match any topic whose (adjusted) signature is 0 and flood the
@@ -280,11 +339,21 @@ def compile_sig_subscriptions(subs, version: int = 0,  # qa: complex
         # (and collisions are verified away on host regardless)
         sigs.append(np.concatenate(
             [s, np.full(n_pad, 0xFFFFFFFF, dtype=np.uint32)]))
+        if fold is not None:
+            group_w16[gi] = True
+            fold_mult[gi] = fold[0]
+            s16 = fold[1]
+        else:
+            s16 = np.zeros(len(rows), dtype=np.uint16)
+        sigs16.append(np.concatenate(
+            [s16, np.full(n_pad, _W16_PAD, dtype=np.uint16)]))
         row_entries.extend(() for _ in range(n_pad))
         row_levels.extend(None for _ in range(n_pad))
 
     row_sig = (np.concatenate(sigs) if sigs
                else np.zeros(0, dtype=np.uint32))
+    row_sig16 = (np.concatenate(sigs16) if sigs16
+                 else np.zeros(0, dtype=np.uint16))
     n_device_rows = len(row_entries)
 
     host_exact: dict[int, HostExactGroup] = {}
@@ -371,6 +440,7 @@ def compile_sig_subscriptions(subs, version: int = 0,  # qa: complex
         groups=groups, topo_coef=topo_coef, depth_coef=depth_coef,
         min_depth=min_depth, is_hash=is_hash_a, wild_first=wild_first,
         row_sig=row_sig, group_words=group_words,
+        group_w16=group_w16, fold_mult=fold_mult, row_sig16=row_sig16,
         row_entries=row_entries, row_levels=row_levels,
         entries=builder.entries, vocab=vocab, n_rows=n_device_rows,
         max_depth=max_depth, host_exact=host_exact, version=version,
@@ -1370,7 +1440,8 @@ class SigEngine(OverlayedEngine):
                  compact_cap_per_topic: int = 3,
                  fixed_sel_blocks: int = 8,
                  fixed_max_rows: int = 7,
-                 use_pallas: bool | str = "auto") -> None:
+                 use_pallas: bool | str = "auto",
+                 kernel_width: str = "auto") -> None:
         self.index = index
         self.max_levels = max_levels
         self.max_words = max_words
@@ -1402,6 +1473,14 @@ class SigEngine(OverlayedEngine):
         # False = XLA body
         self.use_pallas = use_pallas
         self.pallas_active = False
+        # dual-width plane compare: "auto" runs packed 16-bit planes for
+        # eligible groups (compile-time injective fold, see
+        # _pick_fold16), "32" forces the uniform 32-bit planes — the
+        # A/B arm bench.kernel_width_ab measures against
+        if kernel_width not in ("auto", "32"):
+            raise ValueError("kernel_width must be 'auto' or '32'")
+        self.kernel_width = kernel_width
+        self.kernel_plan = None    # sig_pallas.plan of the live program
         # emit DeliveryIntents (flat fan-out-ready entries, ADR 007)
         # instead of merged SubscriberSet dicts from the native decode —
         # the production broker path; falls back to sets automatically
@@ -1552,13 +1631,16 @@ class SigEngine(OverlayedEngine):
         fmt16 = n_words * 32 <= 65536
         fmt = {"kind": "fmt16"} if fmt16 else {"kind": "fmt32"}
         self.pallas_active = False
+        self.kernel_plan = None
         if self.use_pallas:
             from . import sig_pallas
-            kplan = sig_pallas.plan(tables)
+            kplan = sig_pallas.plan(
+                tables, force_width32=self.kernel_width == "32")
             if kplan is not None:
                 fn_fixed, fmt = sig_pallas.build_fixed_fn(
                     tables, consts, kplan, max_rows=kr)
                 self.pallas_active = True
+                self.kernel_plan = kplan
                 return fn_fixed, fmt
             if self.use_pallas is True:
                 raise ValueError(

@@ -298,6 +298,8 @@ def _register_matcher_metrics(registry: Registry, broker) -> None:
                 "Topics served from the CPU trie by the small-corpus "
                 "router (ADR 008)",
                 lambda: eng.trie_routed)
+        if hasattr(eng, "kernel_plan"):
+            _register_kernel_width_metrics(registry, eng)
         if hasattr(matcher, "reconnects"):
             registry.counter_func(
                 "maxmq_matcher_service_reconnects_total",
@@ -312,3 +314,26 @@ def _register_matcher_metrics(registry: Registry, broker) -> None:
             "Publishes queued awaiting in-order fan-out (ADR 006)",
             lambda: (q.qsize()
                      if (q := broker._pub_queue) is not None else 0))
+
+
+def _register_kernel_width_metrics(registry: Registry, eng) -> None:
+    """Dual-width plane compare (ADR 010): compiled shape of the live
+    fused-kernel program, re-read at scrape time so a table rotation is
+    reflected immediately."""
+    def _plan(key, e=eng):
+        return (e.kernel_plan or {}).get(key, 0)
+    for width, gk, wk in (("16", "groups16", "n_words16"),
+                          ("32", "groups32", "n_words32")):
+        registry.gauge_func(
+            "maxmq_matcher_kernel_groups",
+            "Signature groups by compiled plane width",
+            lambda k=gk: _plan(k), labels={"width": width})
+        registry.gauge_func(
+            "maxmq_matcher_kernel_words",
+            "Device match words by compiled plane width",
+            lambda k=wk: _plan(k), labels={"width": width})
+    registry.gauge_func(
+        "maxmq_matcher_kernel_plane_passes_saved_per_topic",
+        "Bit-plane compare passes per topic saved by the packed "
+        "16-bit planes vs a uniform 32-bit program",
+        lambda: 16 * _plan("n_chunks16") * _plan("chunk16"))

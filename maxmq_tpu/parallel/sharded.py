@@ -35,6 +35,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:                                   # newer jax spells it jax.shard_map
+    _shard_map = jax.shard_map
+except AttributeError:                 # 0.4.x: the experimental module
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 from ..matching.engine import NFAEngine, match_batch_body
 from ..matching.nfa import NFATables, TableFull, compile_subscriptions
 from ..matching.trie import SubscriberSet, TopicIndex, subs_version
@@ -400,7 +405,7 @@ class ShardedSigEngine(OverlayedEngine):
             by_shard = NamedSharding(mesh, P(subs_axes))
             dev = tuple(jax.device_put(a, by_shard) for a in stacked)
 
-            fn = jax.jit(jax.shard_map(
+            fn = jax.jit(_shard_map(
                 partial(_sharded_sig_match, sel_blocks=self.sel_blocks,
                         max_rows=self.max_rows),
                 mesh=mesh,
@@ -725,7 +730,7 @@ class ShardedNFAEngine:
         """jit(shard_map) of the match step over the mesh."""
         mesh = self.mesh
         table_specs = tuple(P("subs") for _ in range(6))
-        fn = jax.shard_map(
+        fn = _shard_map(
             partial(_sharded_match, width=self.width, table_mask=table_mask,
                     max_rows=self.max_rows),
             mesh=mesh,

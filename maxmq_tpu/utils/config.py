@@ -12,8 +12,12 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import tomllib
 from dataclasses import dataclass, fields
+
+try:
+    import tomllib
+except ModuleNotFoundError:            # Python < 3.11
+    import tomli as tomllib
 
 
 @dataclass

@@ -34,6 +34,14 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#if PY_VERSION_HEX < 0x030c0000
+// pre-3.12 spelling of the PyMemberDef type/flag constants
+#include <structmember.h>
+#ifndef Py_T_OBJECT_EX
+#define Py_T_OBJECT_EX T_OBJECT_EX
+#endif
+#endif
+
 #include <algorithm>
 #include <cstddef>
 #include <cstdint>
@@ -913,6 +921,11 @@ PyObject *set_chain_params(PyObject *, PyObject *args) {
   Py_RETURN_NONE;
 }
 
+PyObject *get_chain_params(PyObject *, PyObject *) {
+  return Py_BuildValue("(nnn)", g_chain_min_base, g_chain_tail_num,
+                       g_chain_tail_den);
+}
+
 // ----------------------------------------------------------------- //
 //  Decode table + batch                                             //
 // ----------------------------------------------------------------- //
@@ -1500,7 +1513,10 @@ PyObject *row_shared(DecodeTable *t, Py_ssize_t r) {
   return t->rshared[r];
 }
 
-constexpr Py_ssize_t kSlotMapCap = 512 * 1024;
+// total per-table slot-map entry budget; a mutable global so the test
+// suite can shrink it to exercise the prewarm budget paths without
+// building hundred-thousand-entry corpora
+Py_ssize_t g_slot_map_cap = 512 * 1024;
 
 PyObject *cached_intents_result(DecodeTable *t, PyObject *cap,
                                 const int32_t *rows, Py_ssize_t n_rows,
@@ -1521,7 +1537,7 @@ ensure_row_base(DecodeTable *t, PyObject *cap, int32_t r, Py_ssize_t p,
   auto found = t->row_slot.find(r);
   if (found != t->row_slot.end()) {
     m = &found->second;
-  } else if (t->slot_entries + p <= kSlotMapCap) {
+  } else if (t->slot_entries + p <= g_slot_map_cap) {
     m = &t->row_slot[r];
     m->reserve(static_cast<size_t>(p) * 2);
     int32_t slot = 0;
@@ -2299,16 +2315,16 @@ PyObject *prewarm_bases(PyObject *, PyObject *args) {
       built++;
     }
     if (t->row_slot.count(static_cast<int32_t>(r))) continue;
-    if (t->slot_entries + p > kSlotMapCap / 4 * 3) {
-      r = t->R;                  // prewarm budget closed
-      break;
+    if (t->slot_entries + p > g_slot_map_cap / 4 * 3) {
+      continue;                  // over-budget ROW, not a closed sweep:
+                                 // smaller later rows may still fit
+                                 // (the skip is one hash probe, so a
+                                 // fully-spent budget costs ms of scan
+                                 // once, bounded by R)
     }
     PyObject *b = nullptr;
     auto *m = ensure_row_base(t, cap, static_cast<int32_t>(r), p, &b);
-    if (!m) {
-      r = t->R;                  // budget closed: nothing more to build
-      break;
-    }
+    if (!m) continue;            // hard-cap decline for THIS row only
     if (!b) return nullptr;      // python error from the base build
     Py_DECREF(b);
     built++;
@@ -2318,6 +2334,33 @@ PyObject *prewarm_bases(PyObject *, PyObject *args) {
 
 PyObject *decode_batch_intents(PyObject *, PyObject *args) {
   return decode_batch_impl(args, true);
+}
+
+PyObject *set_slot_map_cap(PyObject *, PyObject *arg) {
+  const Py_ssize_t v = PyLong_AsSsize_t(arg);
+  if (v == -1 && PyErr_Occurred()) return nullptr;
+  if (v < 1) {
+    PyErr_SetString(PyExc_ValueError, "slot map cap must be positive");
+    return nullptr;
+  }
+  g_slot_map_cap = v;
+  Py_RETURN_NONE;
+}
+
+PyObject *get_slot_map_cap(PyObject *, PyObject *) {
+  return PyLong_FromSsize_t(g_slot_map_cap);
+}
+
+// _slot_map_stats(capsule) -> (rows_with_slot_maps, slot_entries):
+// observability for the chained-decode anchor budget (metrics + the
+// prewarm tests assert population through it).
+PyObject *slot_map_stats(PyObject *, PyObject *arg) {
+  auto *t = static_cast<DecodeTable *>(
+      PyCapsule_GetPointer(arg, "maxmq_decode.table"));
+  if (!t) return nullptr;
+  return Py_BuildValue("(nn)",
+                       static_cast<Py_ssize_t>(t->row_slot.size()),
+                       t->slot_entries);
 }
 
 PyMethodDef methods[] = {
@@ -2351,6 +2394,18 @@ PyMethodDef methods[] = {
      "TEST/TUNING: (min_base, tail_num, tail_den) — chain when the "
      "fattest row has >= min_base plain entries and tail <= "
      "fat*tail_num/tail_den."},
+    {"_get_chain_params", get_chain_params, METH_NOARGS,
+     "The live (min_base, tail_num, tail_den) — so A/B harnesses and "
+     "test finally blocks restore the values actually in effect."},
+    {"_set_slot_map_cap", set_slot_map_cap, METH_O,
+     "TEST ONLY: shrink the per-table slot-map entry budget so the "
+     "prewarm budget paths are exercisable at test scale."},
+    {"_get_slot_map_cap", get_slot_map_cap, METH_NOARGS,
+     "The live slot-map entry budget — restore the saved value, not a "
+     "hardcoded default."},
+    {"_slot_map_stats", slot_map_stats, METH_O,
+     "(rows_with_slot_maps, slot_entries) for a table capsule — "
+     "chained-decode anchor population observability."},
     {nullptr, nullptr, 0, nullptr}};
 
 PyModuleDef mod = {PyModuleDef_HEAD_INIT, "maxmq_decode",

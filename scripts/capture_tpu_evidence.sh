@@ -1,12 +1,16 @@
 #!/bin/sh
-# One-shot TPU evidence capture for round 3 (run when the tunnel is alive):
+# One-shot TPU evidence capture (run when the tunnel is alive):
 #   1. integrated broker A/B at 100K subs (trie, then sig+MicroBatcher)
-#   2. the 1M-sub headline config with a wider batch (device-only focus)
-# Appends raw JSON lines to /tmp/capture_r03.out; the caller curates into
-# BASELINE-COMPARE.md / BENCH_SELF_r03*.json.
+#   2. the 1M-sub headline config with a wider batch (device-only focus;
+#      its stage decomposition now carries the kernel_width_ab row and
+#      the mixed-width kernel_roofline predicted-vs-measured columns)
+#   3. the standalone kernel-width A/B row: 32-bit-forced vs mixed-width
+#      fused kernels on ONE compiled 100K table set (round-6 tentpole)
+# Appends raw JSON lines to /tmp/capture_r06.out; the caller curates into
+# BASELINE-COMPARE.md / BENCH_SELF_r06*.json.
 set -x
 cd "$(dirname "$0")/.." || exit 1
-OUT=/tmp/capture_r03.out
+OUT=/tmp/capture_r06.out
 : > "$OUT"
 
 timeout 60 python -c "import jax.numpy as j; print(j.arange(8).sum())" || {
@@ -20,7 +24,11 @@ echo "=== matchbench sig ===" >> "$OUT"
 timeout 1800 python benchmarks/e2e_broker.py --matchbench 100000 \
     --matcher sig >> "$OUT" 2>/tmp/cap_sig.err
 
-echo "=== 1M config, batch 524288 ===" >> "$OUT"
+echo "=== kernel width A/B (32-forced vs mixed, same tables) ===" >> "$OUT"
+MAXMQ_BENCH_CONFIGS=widthab timeout 1200 python bench.py \
+    >> "$OUT" 2>/tmp/cap_widthab.err
+
+echo "=== 1M config, batch 524288 (incl. roofline + width A/B) ===" >> "$OUT"
 MAXMQ_BENCH_CONFIGS=4 MAXMQ_BENCH_BATCH=524288 MAXMQ_BENCH_ITERS=3 \
     timeout 3100 python bench.py >> "$OUT" 2>/tmp/cap_1m.err
 

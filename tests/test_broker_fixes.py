@@ -360,3 +360,64 @@ def test_retained_expiry_heap_bounded_under_republish():
     # expiry still fires off the compacted heap
     b._check_expired_retained(now=1000.0 + 5000 + 3600 + 1)
     assert not b._retained_due
+
+
+async def test_restore_path_prewarms_decode_anchors(tmp_path):
+    """A broker restored with a large subscription set must run
+    prewarm_decode_bases at the boot quiescent point: the restore path
+    used to call only refresh(), deferring anchor population to the
+    first background rotation — i.e. paying the ramp across the first
+    few hundred thousand cold publishes (ADVICE r5 #1)."""
+    from maxmq_tpu.hooks import AllowHook
+    from maxmq_tpu.hooks.storage import (SQLiteStore, StorageHook,
+                                         SubscriptionRecord)
+    from maxmq_tpu.matching.batcher import MicroBatcher
+    from maxmq_tpu.matching.sig import SigEngine
+
+    path = str(tmp_path / "prewarm.db")
+    store = SQLiteStore(path)
+    # >= 10K subscriptions incl. one fat '#' bucket (chain-eligible:
+    # well past the decode's min-base bar), written straight into the
+    # store — the restore path reads records, not live clients
+    for i in range(200):
+        store.put("subscriptions", f"fat{i}|pw/dev/#",
+                  SubscriptionRecord(client_id=f"fat{i}",
+                                     filter="pw/dev/#", qos=1).to_json())
+    for i in range(9800):
+        store.put("subscriptions", f"c{i}|pw/{i}/x",
+                  SubscriptionRecord(client_id=f"c{i}",
+                                     filter=f"pw/{i}/x", qos=0).to_json())
+    store.close()
+
+    b = Broker(BrokerOptions(capabilities=Capabilities(
+        sys_topic_interval=0)))
+    b.add_hook(AllowHook())
+    b.add_hook(StorageHook(SQLiteStore(path)))
+    engine = SigEngine(b.topics, auto_refresh=False)
+    engine.emit_intents = True     # production shape (ADR 007)
+    calls: list[int] = []
+    orig_prewarm = engine.prewarm_decode_bases
+
+    def counting_prewarm(*a, **k):
+        calls.append(1)
+        return orig_prewarm(*a, **k)
+
+    engine.prewarm_decode_bases = counting_prewarm
+    b.attach_matcher(MicroBatcher(engine))
+    await b.serve()
+    try:
+        # prewarm ran inside serve(), i.e. BEFORE any publish dispatch
+        assert calls, "restore path never ran prewarm_decode_bases"
+        # and against the restored corpus, not the boot-empty tables
+        assert b.topics.subscription_count >= 10_000
+        assert engine.tables.version == b.topics.sub_version
+        from maxmq_tpu.native import decode_module
+        mod = decode_module()
+        if mod is not None and hasattr(mod, "_slot_map_stats"):
+            nd = engine.tables.__dict__.get("_native_decode")
+            assert nd, "native decode never engaged for the prewarm"
+            rows_mapped, entries = mod._slot_map_stats(nd[1])
+            assert rows_mapped >= 1, "no anchor slot maps populated"
+            assert entries >= 200   # the fat row's plain entries
+    finally:
+        await b.close()

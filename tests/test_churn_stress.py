@@ -200,6 +200,8 @@ def test_threaded_churn_sig_chained():
     _seed(idx, n=800, clients=120)
     for i in range(120):
         idx.subscribe(f"fat{i}", Subscription(filter="s0/#", qos=1))
+    from maxmq_tpu.native import chain_params_in_effect
+    saved = chain_params_in_effect(mod)
     mod._set_chain_params(16, 4, 1)
     try:
         eng = SigEngine(idx)
@@ -221,7 +223,7 @@ def test_threaded_churn_sig_chained():
         _assert_no_grafted_referents(
             eng, ["s0/a/b"] + [_rand_topic(rng) for _ in range(32)])
     finally:
-        mod._set_chain_params(64, 1, 1)
+        mod._set_chain_params(*saved)
 
 
 def test_threaded_churn_sharded():

@@ -216,3 +216,28 @@ def test_matcher_metrics_series_render():
     assert "maxmq_matcher_bypassed_topics_total 3" in text
     assert "maxmq_matcher_device_rtt_seconds 0.012" in text
     assert "maxmq_matcher_trie_routed_total 5" in text
+
+
+def test_kernel_width_metrics_render():
+    """The ADR-010 dual-width kernel series reflect the LIVE plan at
+    scrape time (groups/words by width, plane passes saved)."""
+    from maxmq_tpu.matching.batcher import MicroBatcher
+    from maxmq_tpu.matching.sig import SigEngine
+
+    broker = Broker(BrokerOptions(
+        capabilities=Capabilities(sys_topic_interval=0)))
+    for i in range(3):
+        broker.topics.subscribe(f"k{i}",
+                                Subscription(filter=f"kw/{i}/#", qos=0))
+    eng = SigEngine(broker.topics)
+    broker.attach_matcher(MicroBatcher(eng))
+    reg = Registry()
+    register_broker_metrics(reg, broker)
+    text = reg.expose()
+    assert 'maxmq_matcher_kernel_groups{width="16"}' in text
+    assert 'maxmq_matcher_kernel_groups{width="32"}' in text
+    assert 'maxmq_matcher_kernel_words{width="16"}' in text
+    assert "maxmq_matcher_kernel_plane_passes_saved_per_topic" in text
+    if eng.kernel_plan is not None:     # pallas plan admitted the tables
+        g16 = eng.kernel_plan["groups16"]
+        assert f'maxmq_matcher_kernel_groups{{width="16"}} {g16}' in text

@@ -186,3 +186,69 @@ def test_tokenize_sig_parity_with_python():
     assert np.array_equal(lens_n, lens_py)
     for a, b in zip(hr_n, hr_py):
         assert np.array_equal(a, b)
+
+
+def _decode_mod():
+    from maxmq_tpu.native import decode_module
+    mod = decode_module()
+    if mod is None:
+        pytest.skip("maxmq_decode extension unavailable")
+    return mod
+
+
+def test_get_chain_params_round_trip():
+    """_get_chain_params reports the live values so finally blocks can
+    restore exactly what was in effect (ADVICE r5 #3)."""
+    mod = _decode_mod()
+    if not hasattr(mod, "_get_chain_params"):
+        pytest.skip("getter unavailable (stale extension)")
+    saved = mod._get_chain_params()
+    try:
+        mod._set_chain_params(17, 3, 2)
+        assert mod._get_chain_params() == (17, 3, 2)
+    finally:
+        mod._set_chain_params(*saved)
+    assert mod._get_chain_params() == saved
+
+
+def test_prewarm_bases_continues_past_oversized_rows():
+    """One row too fat for the 3/4 slot-map budget must not abort the
+    whole prewarm sweep (ADVICE r5 #4): smaller later rows still get
+    their anchors. Exercised at test scale by shrinking the budget so
+    the FIRST fat row exceeds it while a later, thinner fat row fits."""
+    from maxmq_tpu.matching import TopicIndex
+    from maxmq_tpu.matching.sig import _native_decode, compile_sig
+
+    mod = _decode_mod()
+    for attr in ("_set_slot_map_cap", "_get_slot_map_cap",
+                 "_slot_map_stats", "prewarm_bases"):
+        if not hasattr(mod, attr):
+            pytest.skip(f"{attr} unavailable (stale extension)")
+
+    idx = TopicIndex()
+    # row order follows subscription order: the 40-entry row first
+    for i in range(40):
+        idx.subscribe(f"big{i}", Subscription(filter="pb/big/#", qos=1))
+    for i in range(20):
+        idx.subscribe(f"small{i}", Subscription(filter="pb/small/#",
+                                                qos=1))
+    tables = compile_sig(idx)
+    nd = _native_decode(tables)
+    assert nd is not None
+    _mod, cap = nd
+    from maxmq_tpu.native import chain_params_in_effect
+    saved_chain = chain_params_in_effect(mod)
+    saved_cap = mod._get_slot_map_cap()
+    try:
+        mod._set_chain_params(16, 1, 1)     # both rows anchor-eligible
+        # budget 48: 3/4 bar = 36 — the 40-entry row exceeds it, the
+        # 20-entry row fits; the old code ended the sweep at the fat row
+        mod._set_slot_map_cap(48)
+        r = mod.prewarm_bases(cap, 0, 1000)
+        rows_mapped, entries = mod._slot_map_stats(cap)
+        assert r == len(tables.row_entries), r
+        assert rows_mapped == 1, (rows_mapped, entries)
+        assert entries == 20, entries
+    finally:
+        mod._set_slot_map_cap(saved_cap)
+        mod._set_chain_params(*saved_chain)

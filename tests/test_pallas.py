@@ -153,3 +153,67 @@ def test_randomized_parity(seed):
                       Subscription(filter=f, qos=rng.randint(0, 2),
                                    identifier=rng.randint(0, 5)))
     check_parity(idx, topics)
+
+
+def test_sig_dual_width_kernel_raw_outputs(monkeypatch):
+    """Dual-width signature kernels at the RAW output level: on one
+    compiled table set, the mixed-width program's per-topic candidate
+    counts must be a superset of the 32-bit-forced program's wherever
+    neither overflows (a 16-bit fold can only add host-verified false
+    candidates or overflow — never drop a true match), and the row
+    slots must agree exactly on topics where the counts agree."""
+    import numpy as np
+
+    import maxmq_tpu.matching.sig as sigmod
+    from maxmq_tpu.matching import sig_pallas
+    from maxmq_tpu.matching.sig import SigEngine, prepare_batch
+
+    monkeypatch.setattr(sigmod, "W16_MAX_GROUP_ROWS", 8)
+    idx = TopicIndex()
+    for i in range(30):
+        idx.subscribe(f"w{i}", Subscription(filter=f"k{i}/#", qos=1))
+    for i in range(5):
+        idx.subscribe(f"n{i}", Subscription(filter=f"m/z{i}/#", qos=2))
+    engine = SigEngine(idx, use_pallas=True, fixed_max_rows=7)
+    assert engine.pallas_active
+    tables, consts = engine._state[0], engine._state[1]
+    assert tables.group_w16.any() and (~tables.group_w16).any()
+
+    rng = random.Random(6)
+    topics = ([f"k{i}/t" for i in range(30)]
+              + [f"m/z{i}/d/e" for i in range(5)]
+              + ["m/q", "$SYS/x", "none"]
+              + ["/".join(rng.choice(["k0", "m", "z0", "q"])
+                          for _ in range(rng.randint(1, 4)))
+                 for _ in range(20)])
+    toks8, lens_enc, _ = prepare_batch(tables, topics)
+
+    outs = {}
+    for label, force in (("mixed", False), ("force32", True)):
+        kplan = sig_pallas.plan(tables, force_width32=force)
+        assert kplan is not None
+        fn, fmt = sig_pallas.build_fixed_fn(tables, consts, kplan,
+                                            max_rows=7)
+        assert fmt["kind"] == "stream"
+        cnt, stream = fn(toks8, lens_enc)
+        outs[label] = (np.asarray(cnt), np.asarray(stream))
+
+    m_cnt, m_stream = outs["mixed"]
+    f_cnt, f_stream = outs["force32"]
+    both = (m_cnt != 0xFF) & (f_cnt != 0xFF)
+    assert both.any()
+    assert (m_cnt[both].astype(int) >= f_cnt[both].astype(int)).all()
+    # where the counts agree, the row slots must be identical (stream
+    # is topic-ordered; walk both with per-arm offsets)
+    mo = fo = 0
+    checked = 0
+    for i in range(len(topics)):
+        mc = int(m_cnt[i]) if m_cnt[i] != 0xFF else 0
+        fc = int(f_cnt[i]) if f_cnt[i] != 0xFF else 0
+        if m_cnt[i] != 0xFF and f_cnt[i] != 0xFF and mc == fc:
+            assert np.array_equal(m_stream[mo:mo + mc],
+                                  f_stream[fo:fo + fc]), topics[i]
+            checked += 1
+        mo += mc
+        fo += fc
+    assert checked, "no comparable topics"

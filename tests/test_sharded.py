@@ -311,6 +311,8 @@ def test_sharded_chain_in_chain_parity():
     idx.subscribe("sh1", Subscription(filter="$share/g/cc/dev/#", qos=1))
     # client-hash sharding splits the 200 fat clients ~25 per shard —
     # drop the chain threshold so every shard's fat row anchors a chain
+    from maxmq_tpu.native import chain_params_in_effect
+    saved = chain_params_in_effect(mod)
     mod._set_chain_params(8, 4, 1)
     try:
         eng = ShardedSigEngine(idx, mesh=make_mesh())
@@ -339,7 +341,7 @@ def test_sharded_chain_in_chain_parity():
             assert normalize(r.to_set()) == normalize(want), topic
         assert saw_nested, "no per-shard chained intents engaged"
     finally:
-        mod._set_chain_params(64, 1, 1)
+        mod._set_chain_params(*saved)
 
 
 @pytest.mark.parametrize("seed", [21, 22])

@@ -621,6 +621,13 @@ def _native_mod():
     return mod
 
 
+def _saved_chain_params(mod) -> tuple:
+    """Chain params in effect, restored verbatim by finally blocks
+    (never the hardcoded defaults — ADVICE r5 #3)."""
+    from maxmq_tpu.native import chain_params_in_effect
+    return chain_params_in_effect(mod)
+
+
 def _as_set(result):
     to_set = getattr(result, "to_set", None)
     return to_set() if to_set is not None else result
@@ -910,6 +917,7 @@ def test_intents_chain_fuzz_equivalence(seed):
         return got, out
 
     state = rng.getstate()
+    saved = _saved_chain_params(mod)
     try:
         mod._set_chain_params(32, 1, 1)    # chain aggressively
         chained_res, chained = snapshot(build_engine())
@@ -919,7 +927,7 @@ def test_intents_chain_fuzz_equivalence(seed):
         _, plain = snapshot(build_engine())
     finally:
         mod._set_chain_enabled(True)
-        mod._set_chain_params(64, 1, 1)
+        mod._set_chain_params(*saved)
     assert chained == plain
 
 
@@ -1081,6 +1089,7 @@ def test_intents_multi_base_composition():
                 best = max(best, int(rep.split("bases=")[1].split(",")[0]))
         return best
 
+    saved = _saved_chain_params(mod)
     try:
         mod._set_chain_params(32, 4, 1)
         multi_res, multi = snapshot(build_engine())
@@ -1094,6 +1103,170 @@ def test_intents_multi_base_composition():
     finally:
         mod._set_chain_enabled(True)
         mod._set_multi_base(True)
-        mod._set_chain_params(64, 1, 1)
+        mod._set_chain_params(*saved)
     assert multi == plain
     assert single == plain
+
+
+# --------------------------------------------------------------------
+# Dual-width bit-planes (ADR 010): packed 16-bit plane compare for
+# groups whose signatures admit an injective 16-bit fold, 32-bit planes
+# for the rest — exact parity required in every mix.
+# --------------------------------------------------------------------
+
+
+def _engineered_width_corpus(monkeypatch, max_rows16=8):
+    """Corpus with BOTH plane widths: one '#'-shape with more unique
+    rows than the (patched) eligibility bound stays 32-bit, a smaller
+    shape goes 16-bit."""
+    import maxmq_tpu.matching.sig as sigmod
+    monkeypatch.setattr(sigmod, "W16_MAX_GROUP_ROWS", max_rows16)
+    idx = TopicIndex()
+    for i in range(30):                        # shape (#, depth 1): 30 rows
+        idx.subscribe(f"w{i}", Subscription(filter=f"r{i}/#", qos=1))
+    for i in range(5):                         # shape (#, depth 2): 5 rows
+        idx.subscribe(f"n{i}", Subscription(filter=f"x/y{i}/#", qos=2))
+    idx.subscribe("sh", Subscription(filter="$share/g/x/y0/#"))
+    idx.subscribe("pl", Subscription(filter="x/+/q"))     # host-probed
+    idx.subscribe("ex", Subscription(filter="r0/exact"))  # host-probed
+    return idx
+
+
+def test_mixed_width_compile_layout(monkeypatch):
+    """Eligibility splits per group; 16-bit groups are laid out LAST
+    (contiguous word regions per width); folds are injective and avoid
+    the 0xFFFF pad poison."""
+    idx = _engineered_width_corpus(monkeypatch)
+    tables = compile_sig(idx)
+    w16 = tables.group_w16
+    assert w16.any() and (~w16).any(), "need both widths"
+    # 32-bit groups strictly precede 16-bit groups
+    first16 = int(np.argmax(w16))
+    assert w16[first16:].all() and not w16[:first16].any()
+    from maxmq_tpu.matching.sig import _fold16
+    for gi, g in enumerate(tables.groups):
+        rows = np.asarray(g.rows)
+        sig16 = tables.row_sig16[rows]
+        if w16[gi]:
+            assert tables.fold_mult[gi] % 2 == 1
+            assert (sig16 != 0xFFFF).all()
+            assert len(np.unique(sig16)) == len(sig16), "fold not injective"
+            # the stored fold IS the multiply-shift of the row sigs
+            np.testing.assert_array_equal(
+                sig16, _fold16(tables.row_sig[rows], tables.fold_mult[gi]))
+        else:
+            assert tables.fold_mult[gi] == 0
+    # pad rows carry the 16-bit poison
+    pad = np.ones(len(tables.row_sig16), dtype=bool)
+    for g in tables.groups:
+        pad[np.asarray(g.rows)] = False
+    assert (tables.row_sig16[pad] == 0xFFFF).all()
+
+
+def test_mixed_width_parity_and_equality(monkeypatch):
+    """The mixed-width kernel must be bit-exact with the 32-bit-forced
+    kernel AND the CPU trie at the decoded-result boundary, on a corpus
+    where some groups are 16-bit-eligible and some are not (16-bit fold
+    collisions only add host-verified candidates or overflow to the
+    exact trie fallback — results never change)."""
+    idx = _engineered_width_corpus(monkeypatch)
+    rng = random.Random(4)
+    topics = ([f"r{i}/t/{j}" for i in range(30) for j in (0, 1)]
+              + [f"x/y{i}/deep/er" for i in range(5)]
+              + ["x/zz/q", "r0/exact", "$SYS/x", "x/y0", "none/here"]
+              + ["/".join(rng.choice(["r0", "x", "y0", "q", "zz"])
+                          for _ in range(rng.randint(1, 5)))
+                 for _ in range(40)])
+    results = {}
+    for kw in ("auto", "32"):
+        for use_pallas in ("auto", False):
+            engine = SigEngine(idx, use_pallas=use_pallas,
+                               kernel_width=kw)
+            got = engine.subscribers_fixed_batch(topics)
+            for topic, result in zip(topics, got):
+                want = idx.subscribers(topic)
+                assert normalize(result) == normalize(want), (
+                    f"[width={kw}/pallas={use_pallas}] {topic!r}")
+            if use_pallas == "auto":
+                assert engine.pallas_active
+                plan = engine.kernel_plan
+                assert plan is not None
+                if kw == "auto":
+                    assert plan["groups16"] and plan["groups32"]
+                else:
+                    assert plan["groups16"] == 0
+                results[kw] = [normalize(r) for r in got]
+    assert results["auto"] == results["32"]
+
+
+def test_mixed_width_all_paths_parity(monkeypatch):
+    """word/compact/fixed paths stay exact on a dual-width table set
+    (word + compact run the unchanged 32-bit XLA body over the
+    REORDERED row layout — the reorder itself must be seamless)."""
+    idx = _engineered_width_corpus(monkeypatch)
+    check_parity(idx, [f"r{i}/a" for i in range(8)]
+                 + ["x/y0/b/c", "x/y3", "x/q/q", "$share/x", "r5"])
+
+
+def test_plan_force_width32(monkeypatch):
+    """force_width32 plans the SAME tables all-32: word totals are
+    conserved and the predicted plane passes drop in the mixed plan."""
+    from maxmq_tpu.matching import sig_pallas
+
+    idx = _engineered_width_corpus(monkeypatch)
+    tables = compile_sig(idx)
+    mixed = sig_pallas.plan(tables)
+    forced = sig_pallas.plan(tables, force_width32=True)
+    assert mixed is not None and forced is not None
+    assert mixed["n_words16"] > 0 and forced["n_words16"] == 0
+    assert (mixed["n_words32"] + mixed["n_words16"]
+            == forced["n_words32"])
+    assert forced["groups16"] == 0
+    # per padded column the packed compare halves the pass count
+    assert (mixed["plane_passes_per_topic"]
+            < 32 * (mixed["n_chunks32"] + mixed["n_chunks16"])
+            * mixed["chunk"])
+
+
+def test_kernel_width_arg_validated():
+    idx = TopicIndex()
+    idx.subscribe("c1", Subscription(filter="a/b"))
+    with pytest.raises(ValueError):
+        SigEngine(idx, kernel_width="16")
+
+
+def test_randomized_mixed_width_churn_parity(monkeypatch):
+    """Randomized corpora + churn under a small eligibility bound so
+    recompiles keep flipping groups between widths — every match must
+    stay exact through rotations."""
+    import maxmq_tpu.matching.sig as sigmod
+    monkeypatch.setattr(sigmod, "W16_MAX_GROUP_ROWS", 6)
+    rng = random.Random(77)
+    filters, topics = rand_corpus(rng, 200, 30)
+    idx = TopicIndex()
+    from maxmq_tpu.matching.topics import valid_filter
+    live = []
+    for i, f in enumerate(filters[:120]):
+        if not valid_filter(f):
+            continue
+        cid = f"cl-{i % 30}"
+        idx.subscribe(cid, Subscription(filter=f, qos=i % 3))
+        live.append((cid, f))
+    engine = SigEngine(idx, auto_refresh=False)
+    pool = [f for f in filters[120:] if valid_filter(f)]
+    for step in range(30):
+        if rng.random() < 0.5 and pool:
+            cid = f"cl-{rng.randrange(30)}"
+            f = pool.pop()
+            idx.subscribe(cid, Subscription(filter=f, qos=1))
+            live.append((cid, f))
+        elif live:
+            cid, f = live.pop(rng.randrange(len(live)))
+            idx.unsubscribe(cid, f)
+        if rng.random() < 0.3:
+            engine.refresh(force=True)
+        batch = [rng.choice(topics) for _ in range(5)]
+        got = engine.subscribers_fixed_batch(batch)
+        for topic, result in zip(batch, got):
+            want = idx.subscribers(topic)
+            assert normalize(result) == normalize(want), (step, topic)
