@@ -28,7 +28,10 @@ Prints ONE JSON line to stdout; progress goes to stderr.
 Env knobs: MAXMQ_BENCH_CONFIGS (csv of 1..5, 4h, lat; default all;
 4h = config 4's corpus with hot/repeated publish topics, the
 cache-friendly stream a real broker sees — reported alongside, never
-as the headline),
+as the headline; opt-in extras outside the default list: widthab =
+the ADR-010 kernel-width A/B, degraded = the ADR-011 ladder under
+injected device faults — healthy vs breaker-open trie-only vs
+recovered throughput),
 MAXMQ_BENCH_SUBS/BATCH/ITERS/DEPTH override config #4's shape.
 """
 
@@ -1208,6 +1211,76 @@ def bench_e2e_matchbench(subs: int = 100_000,
     return out
 
 
+def bench_degraded(n_subs: int = 100_000, batch: int = 8192,
+                   iters: int = 8, depth: int = 3) -> dict:
+    """ADR-011 degraded-mode measurement (MAXMQ_BENCH_CONFIGS=degraded):
+    one corpus + engine behind the SupervisedMatcher, measured in three
+    regimes — healthy device path, breaker-open trie-only (driven by
+    injected device faults), and post-recovery — so the ladder's cost
+    is a number, not a hope. Faults are armed through maxmq_tpu.faults
+    (the same registry tests use), deterministically counted."""
+    from maxmq_tpu import faults
+    from maxmq_tpu.matching.sig import SigEngine
+    from maxmq_tpu.matching.supervisor import SupervisedMatcher
+
+    filters, topic_gen = build_corpus(n_subs)
+    index = build_index(filters)
+    engine = SigEngine(index, auto_refresh=False)
+    engine.route_small = False
+    sup = SupervisedMatcher(engine, deadline_ms=2_000,
+                            breaker_threshold=3, breaker_window_s=30.0,
+                            backoff_initial_s=0.2, backoff_max_s=1.0)
+    batches = [topic_gen(batch, seed2=s) for s in range(iters)]
+    # warm OUTSIDE the supervisor: the first dispatch's XLA compile can
+    # outlast the deadline, and the resulting deadline failures would
+    # trip the breaker during the "healthy" measure — reporting trie
+    # throughput as the healthy baseline (production pays this compile
+    # at the boot quiescent point, not on a deadlined publish)
+    engine.subscribers_batch(batches[0])
+    sup.subscribers_batch(batches[0])          # warm caches via the wrap
+
+    def measure() -> float:
+        t0 = time.perf_counter()
+        n = 0
+        for topics in batches:
+            n += len(sup.subscribers_batch(topics))
+        return round(n / (time.perf_counter() - t0), 1)
+
+    d: dict = {"config": "degraded_mode", "n_subs": n_subs,
+               "batch": batch, "iters": iters}
+    d["healthy_topics_per_sec"] = measure()
+
+    # trip the breaker: every device call raises until disarmed. The
+    # finally matters: the fault registry is process-global, and an
+    # armed infinite fault leaking out of this config would silently
+    # turn every LATER config's device numbers into trie numbers.
+    try:
+        faults.arm(faults.DEVICE_MATCH, "raise", count=-1)
+        for _ in range(sup.breaker_threshold):
+            sup.subscribers_batch(batches[0])
+        if sup.breaker_state_name != "open":
+            raise RuntimeError(
+                f"breaker failed to trip: {sup.breaker_state_name}")
+        d["degraded_topics_per_sec"] = measure()   # trie-only regime
+    finally:
+        faults.disarm(faults.DEVICE_MATCH)
+    time.sleep(sup.backoff_max_s + 0.05)       # let the backoff expire
+    sup.subscribers_batch(batches[0])          # half-open probe -> close
+    d["recovered"] = sup.breaker_state_name == "closed"
+    d["recovered_topics_per_sec"] = measure()
+    d["breaker_trips"] = sup.breaker_trips
+    d["breaker_recoveries"] = sup.breaker_recoveries
+    d["degraded_seconds"] = round(sup.degraded_seconds, 3)
+    d["fallbacks_by_reason"] = dict(sup.fallbacks_by_reason)
+    d["degraded_frac_of_healthy"] = round(
+        d["degraded_topics_per_sec"] / max(d["healthy_topics_per_sec"],
+                                           1e-9), 3)
+    log(f"[degraded] healthy={d['healthy_topics_per_sec']} "
+        f"trie-only={d['degraded_topics_per_sec']} "
+        f"recovered={d['recovered_topics_per_sec']} topics/s")
+    return d
+
+
 def bench_cluster(subs: int = 100_000, batch: int = 8192,
                   msgs: int = 10_000) -> dict:
     log("[cluster] 8-dev CPU mesh subprocess ...")
@@ -1459,6 +1532,12 @@ def main() -> None:
                      lambda: bench_kernel_width_ab(n_subs=s(100_000),
                                                    batch=s(65_536),
                                                    iters=iters)))
+    if "degraded" in which:
+        # ADR-011 ladder under injected device faults: healthy vs
+        # breaker-open trie-only vs post-recovery throughput
+        runs.append(("degraded_mode",
+                     lambda: bench_degraded(n_subs=s(100_000),
+                                            batch=s(8_192))))
     if "5" in which:
         runs.append(("cluster", lambda: bench_cluster(subs=s(100_000))))
     if "e2e" in which:

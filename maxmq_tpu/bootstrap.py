@@ -103,14 +103,33 @@ def build_matcher(conf: Config, broker: Broker):
     batcher = MicroBatcher(engine,
                            window_us=conf.matcher_batch_window_us,
                            max_batch=conf.matcher_max_batch)
-    broker.attach_matcher(batcher)
+    attach = batcher
+    if conf.matcher_supervised:
+        # ADR 011: per-batch deadline + trie hedge + circuit breaker
+        # around every device call — publishes complete (bit-equal to
+        # the CPU trie) through device errors, hangs, failed recompiles
+        from .matching.supervisor import SupervisedMatcher
+        attach = SupervisedMatcher(batcher, index=broker.topics,
+                                   logger=broker.log,
+                                   **supervisor_kwargs(conf))
+    broker.attach_matcher(attach)
     warm = getattr(engine, "warm_buckets", None)
     if warm is not None:
         warm(conf.matcher_max_batch)    # background bucket precompile
     prewarm = getattr(engine, "prewarm_decode_bases", None)
     if prewarm is not None:
         prewarm()    # chained-decode anchors at the boot quiescent point
-    return batcher
+    return attach
+
+
+def supervisor_kwargs(conf: Config) -> dict:
+    """The ADR-011 SupervisedMatcher knobs as a kwargs dict (shared by
+    the in-process matcher build and the service attach)."""
+    return dict(deadline_ms=conf.matcher_deadline_ms,
+                breaker_threshold=conf.matcher_breaker_threshold,
+                breaker_window_s=conf.matcher_breaker_window_s,
+                backoff_initial_s=conf.matcher_breaker_backoff_s,
+                backoff_max_s=conf.matcher_breaker_backoff_max_s)
 
 
 def build_broker(conf: Config, logger: Logger) -> Broker:
@@ -167,7 +186,10 @@ async def _maybe_attach_service(conf: Config, broker: Broker) -> None:
     (``maxmq matcher-service``) at conf.matcher_socket."""
     if conf.matcher == "service":
         from .matching.service import attach_matcher_service
-        await attach_matcher_service(broker, conf.matcher_socket)
+        await attach_matcher_service(
+            broker, conf.matcher_socket,
+            supervisor=(supervisor_kwargs(conf)
+                        if conf.matcher_supervised else None))
 
 
 def _signal_stop_event() -> asyncio.Event:

@@ -122,6 +122,10 @@ class Broker:
         # [MQTT-4.6.0] while many publishes ride the device concurrently
         self._pub_queue: asyncio.Queue | None = None
         self._pub_consumer: asyncio.Task | None = None
+        # publishes whose match future failed and were served from the
+        # broker's own trie (the rung BELOW the ADR-011 supervisor —
+        # nonzero here means a failure got past the supervised matcher)
+        self.matcher_degrades = 0
         self._running = False
         self.loop: asyncio.AbstractEventLoop | None = None
 
@@ -737,6 +741,7 @@ class Broker:
                         raise
                     subscribers = self.topics.subscribers(packet.topic)
                 except Exception as exc:
+                    self.matcher_degrades += 1
                     if self.log is not None:
                         self.log.with_prefix("broker").error(
                             "matcher failed; trie fallback",

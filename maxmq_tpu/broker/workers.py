@@ -37,6 +37,7 @@ import subprocess
 import sys
 import time
 
+from .. import faults
 from ..hooks.base import Hook
 from ..protocol.packets import Packet, parse_stream
 
@@ -390,8 +391,10 @@ async def run_worker(conf, logger, worker_id: int, bus_path: str,
         # pool workers share ONE chip-owning matcher service (ADR 005):
         # every worker forwards its own clients' subscription ops and
         # all workers' match requests coalesce on the service's batcher
-        from ..matching.service import attach_matcher_service
-        await attach_matcher_service(broker, conf.matcher_socket)
+        # — each behind its own ADR-011 supervisor unless opted out
+        # (same wiring as the single-process boot, one source of truth)
+        from ..bootstrap import _maybe_attach_service
+        await _maybe_attach_service(conf, broker)
     metrics = build_metrics(conf, broker, logger) if worker_id == 0 else None
     # bus first, listeners second: a client accepted before the bus is
     # connected would publish into a void
@@ -416,6 +419,11 @@ async def run_worker(conf, logger, worker_id: int, bus_path: str,
     hook.on_bus_lost = stop.set      # parent died: don't serve split-brained
     if hook.bus_lost:
         stop.set()                   # EOF landed before the wiring
+    if faults.fire(faults.POOL_WORKER):
+        # injected worker death (ADR 011 fault suite; armed through the
+        # MAXMQ_FAULTS env the pool parent propagates): exit now so the
+        # parent's supervision loop observes the crash and respawns us
+        stop.set()
     try:
         await stop.wait()
     finally:
@@ -425,13 +433,29 @@ async def run_worker(conf, logger, worker_id: int, bus_path: str,
             metrics.stop()
 
 
-async def _supervise_workers(procs, spawn, boot) -> None:
-    """A worker that dies (crash, bus eviction, OOM kill) is logged and
-    respawned — the pool must not silently degrade to N-1. Throttled
-    per slot so a crash loop can't fork-bomb the host."""
+class PoolStats:
+    """Supervision counters for one pool parent, exported as the
+    ``maxmq_pool_*`` family (metrics.register_pool_metrics)."""
+
+    def __init__(self) -> None:
+        self.worker_restarts = 0
+
+
+# process-wide default (one pool parent per process); tests construct
+# their own and pass it to _supervise_workers
+POOL_STATS = PoolStats()
+
+
+async def _supervise_workers(procs, spawn, boot, stats: PoolStats = None,
+                             interval: float = 2.0) -> None:
+    """A worker that dies (crash, bus eviction, OOM kill) is logged,
+    counted (stats.worker_restarts -> maxmq_pool_worker_restarts_total),
+    and respawned — the pool must not silently degrade to N-1.
+    Throttled per slot so a crash loop can't fork-bomb the host."""
+    stats = stats if stats is not None else POOL_STATS
     last_spawn = [0.0] * len(procs)
     while True:
-        await asyncio.sleep(2.0)
+        await asyncio.sleep(interval)
         for i, p in enumerate(procs):
             rc = p.poll()
             if rc is None:
@@ -443,6 +467,7 @@ async def _supervise_workers(procs, spawn, boot) -> None:
                 await asyncio.sleep(wait)
             last_spawn[i] = time.monotonic()
             procs[i] = spawn(i)
+            stats.worker_restarts += 1
 
 
 @contextlib.asynccontextmanager
@@ -484,6 +509,38 @@ async def inprocess_pool(n: int = 2, bus_path: str | None = None):
             os.unlink(bus_path)
 
 
+def _worker_spawner(env: dict):
+    """Build the pool's spawn(i) closure, scoping pool.worker faults
+    (ADR 011 drills) to mean "kill A worker", not "kill every worker
+    forever": MAXMQ_FAULTS is parsed at import in EACH subprocess, so
+    an unscoped spec would re-arm in all N workers AND in every
+    respawned replacement — a throttled permanent crash loop instead
+    of a kill-once/recover drill. The first spawn keeps the
+    pool.worker entries; every other spawn (other slots, and all
+    respawns) gets them stripped."""
+    fault_spec = env.get("MAXMQ_FAULTS", "")
+    entries = [e.strip() for e in fault_spec.split(",") if e.strip()]
+    kept = ",".join(e for e in entries
+                    if not e.startswith(faults.POOL_WORKER))
+    has_kill = any(e.startswith(faults.POOL_WORKER) for e in entries)
+    delivered = [not has_kill]    # nothing to scope -> strip never
+
+    def spawn(i: int):
+        wenv = dict(env)
+        wenv["MAXMQ_WORKER_ID"] = str(i)
+        if fault_spec and delivered[0]:
+            if kept:
+                wenv["MAXMQ_FAULTS"] = kept
+            else:
+                wenv.pop("MAXMQ_FAULTS", None)
+        delivered[0] = True
+        return subprocess.Popen(
+            [sys.executable, "-m", "maxmq_tpu", "start", "--no-banner"],
+            env=wenv)
+
+    return spawn
+
+
 async def run_pool(conf, logger, ready: asyncio.Event | None = None,
                    stop: asyncio.Event | None = None) -> None:
     """The pool parent: fan-out bus + N worker subprocesses. The parent
@@ -499,15 +556,21 @@ async def run_pool(conf, logger, ready: asyncio.Event | None = None,
     env = dict(os.environ)
     env["MAXMQ_BUS"] = bus_path
     env["MAXMQ_POOL_CONF"] = json.dumps(config_as_dict(conf))
-
-    def spawn(i: int):
-        wenv = dict(env)
-        wenv["MAXMQ_WORKER_ID"] = str(i)
-        return subprocess.Popen(
-            [sys.executable, "-m", "maxmq_tpu", "start", "--no-banner"],
-            env=wenv)
+    spawn = _worker_spawner(env)
 
     procs = [spawn(i) for i in range(conf.workers)]
+    stats = PoolStats()
+    metrics = None
+    if conf.pool_metrics_address:
+        # parent-side supervision metrics (worker 0 owns the broker
+        # metrics address, so the pool family gets its own endpoint)
+        from ..metrics import MetricsServer, Registry, register_pool_metrics
+        registry = Registry()
+        register_pool_metrics(registry, stats)
+        metrics = MetricsServer(conf.pool_metrics_address, registry,
+                                path=conf.metrics_path,
+                                logger=logger.with_prefix("pool-metrics"))
+        metrics.start()
     boot.info("worker pool started", workers=conf.workers,
               bus=bus_path, tcp=conf.mqtt_tcp_address)
     if ready is not None:
@@ -523,11 +586,13 @@ async def run_pool(conf, logger, ready: asyncio.Event | None = None,
                 pass
 
     watcher = asyncio.get_running_loop().create_task(
-        _supervise_workers(procs, spawn, boot))
+        _supervise_workers(procs, spawn, boot, stats=stats))
     try:
         await stop.wait()
     finally:
         watcher.cancel()
+        if metrics is not None:
+            metrics.stop()
         boot.info("shutting down worker pool")
         for p in procs:
             p.terminate()

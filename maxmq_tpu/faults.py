@@ -1,0 +1,168 @@
+"""Deterministic fault injection for the matcher degradation ladder.
+
+The ladder (ADR 011) only earns trust if every rung can be exercised on
+demand: a device call that raises, a kernel that hangs past the batch
+deadline, a recompile that fails, a matcher-service socket that drops,
+a pool worker that dies. This registry arms those faults at well-known
+*sites* in the production code; the sites themselves cost one dict
+lookup on an (almost always) empty dict when nothing is armed.
+
+Arming is deterministic and counted: ``arm(site, mode, count)`` fires
+the fault for exactly the next ``count`` hits of that site (``count=-1``
+= until disarmed), then self-disarms, so a test (or a degraded-mode
+bench run) can script "fail the next 3 device batches, then recover"
+with no sleeps or races. ``fired`` records how many times each site
+actually tripped.
+
+Modes:
+
+* ``raise`` — the site raises :class:`InjectedFault` (a
+  :class:`DeviceMatchError`): the supervisor classifies it as
+  reason="error" and answers from the CPU trie.
+* ``hang``  — the site blocks for ``delay_s`` seconds (in whatever
+  thread runs the device call), driving the supervisor's per-batch
+  deadline instead of its exception path.
+* anything else (``drop``, ``exit``, ...) — ``fire`` returns True and
+  the SITE acts: the matcher service closes the client connection, a
+  pool worker stops itself. This keeps process-structure faults out of
+  the registry's hands — it only ever raises or sleeps.
+
+Env arming (``MAXMQ_FAULTS``) lets ``bench.py`` and subprocess pool
+workers arm faults they can't reach by reference::
+
+    MAXMQ_FAULTS="device.match:raise:3,device.match:hang:1:0.5"
+
+parses as ``site:mode[:count[:delay_s]]``, comma-separated, applied in
+order (later entries queue behind earlier ones for the same site).
+Because each subprocess re-parses the env at import, the pool parent
+delivers ``pool.worker`` entries to exactly ONE initial worker spawn
+and strips them everywhere else (broker/workers.py) — a worker-kill
+drill means one death, not a pool-wide crash loop.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+
+class DeviceMatchError(RuntimeError):
+    """The device matcher path failed (kernel launch, runtime error, or
+    injected fault). The supervisor (matching/supervisor.py) catches any
+    Exception, but sites that can classify their failures raise this so
+    logs and post-mortems separate device faults from host bugs."""
+
+
+class InjectedFault(DeviceMatchError):
+    """Raised by an armed ``raise``-mode fault site."""
+
+
+# canonical sites (the production code fires these; tests arm them)
+DEVICE_MATCH = "device.match"          # engine device-batch entry points
+DEVICE_RECOMPILE = "device.recompile"  # engine refresh()/table compile
+SERVICE_SOCKET = "service.socket"      # matcher-service client connection
+POOL_WORKER = "pool.worker"            # delivery-pool worker process
+
+
+class _Spec:
+    __slots__ = ("mode", "remaining", "delay_s")
+
+    def __init__(self, mode: str, remaining: int, delay_s: float) -> None:
+        self.mode = mode
+        self.remaining = remaining
+        self.delay_s = delay_s
+
+
+class FaultRegistry:
+    """Thread-safe armed-fault table. One global instance (``REGISTRY``)
+    serves the whole process; tests that want isolation construct their
+    own and pass it to the code under test where supported."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # site -> FIFO of specs (so "raise twice then hang once" scripts)
+        self._specs: dict[str, list[_Spec]] = {}
+        self.fired: dict[str, int] = {}
+
+    # -- arming --------------------------------------------------------
+
+    def arm(self, site: str, mode: str = "raise", count: int = 1,
+            delay_s: float = 0.05) -> None:
+        if count == 0:
+            return
+        with self._lock:
+            self._specs.setdefault(site, []).append(
+                _Spec(mode, count, delay_s))
+
+    def disarm(self, site: str) -> None:
+        with self._lock:
+            self._specs.pop(site, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._specs.clear()
+            self.fired.clear()
+
+    def armed(self, site: str) -> bool:
+        return site in self._specs
+
+    def arm_from_spec(self, spec: str) -> None:
+        """Parse a ``MAXMQ_FAULTS``-style csv and arm each entry."""
+        for entry in spec.split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            parts = entry.split(":")
+            if len(parts) < 2:
+                raise ValueError(f"bad fault spec {entry!r} "
+                                 "(want site:mode[:count[:delay_s]])")
+            site, mode = parts[0], parts[1]
+            count = int(parts[2]) if len(parts) > 2 else 1
+            delay = float(parts[3]) if len(parts) > 3 else 0.05
+            self.arm(site, mode, count, delay)
+
+    # -- firing (the production-code side) -----------------------------
+
+    def fire(self, site: str) -> bool:
+        """Trip ``site`` if armed. ``raise`` mode raises InjectedFault,
+        ``hang`` sleeps ``delay_s`` then returns True; any other mode
+        returns True and the call site acts. Returns False when the site
+        is not armed (the hot-path common case: one dict membership test
+        on an empty dict)."""
+        if site not in self._specs:       # racy-but-safe fast path
+            return False
+        with self._lock:
+            queue = self._specs.get(site)
+            if not queue:
+                return False
+            spec = queue[0]
+            if spec.remaining > 0:
+                spec.remaining -= 1
+                if spec.remaining == 0:
+                    queue.pop(0)
+                    if not queue:
+                        del self._specs[site]
+            self.fired[site] = self.fired.get(site, 0) + 1
+        if spec.mode == "raise":
+            raise InjectedFault(f"injected fault at {site}")
+        if spec.mode == "hang":
+            time.sleep(spec.delay_s)
+        return True
+
+
+REGISTRY = FaultRegistry()
+
+# module-level conveniences bound to the process registry
+arm = REGISTRY.arm
+disarm = REGISTRY.disarm
+clear = REGISTRY.clear
+armed = REGISTRY.armed
+fire = REGISTRY.fire
+arm_from_spec = REGISTRY.arm_from_spec
+
+# env arming: subprocess pool workers and bench's degraded-mode runs
+# inherit MAXMQ_FAULTS through their environment
+_env_spec = os.environ.get("MAXMQ_FAULTS", "")
+if _env_spec:
+    REGISTRY.arm_from_spec(_env_spec)

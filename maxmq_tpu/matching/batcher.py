@@ -103,6 +103,8 @@ class MicroBatcher:
         self.batched_topics = 0
         self.largest_batch = 0
         self.bypasses = 0                 # topics served by the bypass
+        self.errors = 0                   # batches whose engine call
+                                          # raised (ADR 011 observability)
 
     @property
     def device_rtt(self) -> float:
@@ -300,6 +302,7 @@ class MicroBatcher:
             results = (host(topics) if host is not None else
                        [self.engine.index.subscribers(t) for t in topics])
         except Exception as exc:
+            self.errors += 1
             for _, fut in batch:
                 if not fut.done():
                     fut.set_exception(exc)
@@ -386,7 +389,8 @@ class MicroBatcher:
             results = await loop.run_in_executor(
                 None, self._batch_fn, topics)
         except Exception as exc:  # engine failure → fail the callers
-            for _, fut in batch:
+            self.errors += 1      # (the ADR-011 supervisor above us
+            for _, fut in batch:  # answers them from the CPU trie)
                 if not fut.done():
                     fut.set_exception(exc)
             return
@@ -435,6 +439,7 @@ class MicroBatcher:
             raise
         except Exception:
             # same degradation contract as dispatch failures
+            self.errors += 1
             results = None
         finally:
             self._inflight.release()

@@ -29,6 +29,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import faults
 from .nfa import MAX_PROBES, NFATables, compile_trie, hash32
 from .topics import pad_topic_batch
 from .trie import SubscriberSet, TopicIndex, subs_version
@@ -172,6 +173,7 @@ class NFAEngine:
         if (not force and self._tables is not None
                 and self._tables.version == subs_version(self.index)):
             return False
+        faults.fire(faults.DEVICE_RECOMPILE)
         tables = compile_trie(self.index)
         arrays = (tables.hash_node, tables.hash_tok, tables.hash_val,
                   tables.plus_child, tables.node_mask, tables.hash_mask)
@@ -192,6 +194,7 @@ class NFAEngine:
         overflow bool[B], tables) — the tables the batch actually ran on."""
         if self.auto_refresh:
             self.refresh()
+        faults.fire(faults.DEVICE_MATCH)
         with self._lock:
             tables = self._tables
             dev = self._device_tables

@@ -36,7 +36,9 @@ import asyncio
 import contextlib
 import json
 import os
+import random
 
+from .. import faults
 from ..hooks.base import Hook
 from ..protocol.packets import Subscription
 from ..utils.framing import frame as _frame, read_frame as _read_frame
@@ -152,6 +154,11 @@ class MatcherService:
     async def close(self) -> None:
         if self._server is not None:
             self._server.close()
+        # a connection accepted just before close may not have reached
+        # _serve yet (the accept callback is scheduled, not run): yield
+        # once so it registers in _conns — otherwise its socket outlives
+        # close() as an orphan the client never sees EOF on
+        await asyncio.sleep(0)
         for w in list(self._conns):     # established connections too —
             w.close()                   # close() means STOP serving
         if self._server is not None:
@@ -208,6 +215,13 @@ class MatcherService:
         can never leave stale filters past the owning broker's
         reconnect+reseed: the connection purge releases everything this
         connection still owns."""
+        if self._server is None or not self._server.is_serving():
+            # the accept callback can fire AFTER close() swept _conns (a
+            # connection established in the same loop tick close ran in):
+            # serving it would orphan a live socket past shutdown — the
+            # client must see EOF and run its reconnect/trie ladder
+            writer.close()
+            return
         tasks: set[asyncio.Task] = set()
         self._conns.add(writer)
         owned: dict[str, dict[str, int]] = {}
@@ -215,6 +229,11 @@ class MatcherService:
             while True:
                 fr = await _read_frame(reader)
                 if fr is None:
+                    return
+                if faults.fire(faults.SERVICE_SOCKET):
+                    # injected socket drop (ADR 011 fault suite): the
+                    # client sees EOF mid-stream — pending matches fail
+                    # to its trie fallback and its reconnect loop kicks
                     return
                 ftype, payload = fr
                 msg = json.loads(payload)
@@ -294,6 +313,12 @@ class ServiceMatcher:
         self.fallbacks = 0
         self.cache_hits = 0
         self.reconnects = 0
+        self.reconnect_attempts = 0
+
+    # our ``fallbacks`` are dead-transport fast-fails, not row
+    # overflows; the ADR-011 supervisor counts those same events under
+    # reason="error", so it must not re-count them as "overflow"
+    overflow_fallbacks = 0
 
     async def connect(self) -> None:
         async with self._connect_lock:
@@ -358,6 +383,11 @@ class ServiceMatcher:
             if not fut.done():
                 fut.set_exception(ConnectionError(msg))
         self._pending.clear()
+        # a dropped transport opens a divergence window (ops queued
+        # while down are not forwarded; the service may have restarted
+        # empty): drop the result cache wholesale — the reconnect
+        # reseed re-establishes ground truth, and refilling is cheap
+        self._cache = VersionedTopicCache()
 
     async def _read_loop_inner(self, reader, writer) -> None:
         while True:
@@ -434,32 +464,50 @@ class ServiceMatcher:
         self._send(OP_MATCH, {"r": req, "t": [topic]})
         return fut
 
+    # reconnect backoff: the loop keeps retrying while traffic is quiet
+    # (the old behavior gave up after ONE OSError and waited for the
+    # next enqueue to retry — a silent broker stayed disconnected for
+    # as long as it stayed silent), with capped exponential backoff +
+    # jitter so a pool of brokers doesn't stampede a restarting service
+    RECONNECT_BACKOFF_INITIAL = 0.05
+    RECONNECT_BACKOFF_MAX = 2.0
+    RECONNECT_JITTER = 0.25     # fraction of the delay randomized
+
     async def _reconnect(self) -> None:
-        # under the connect lock: a concurrent connect() may already
-        # have restored a live transport, which a queued reconnect must
-        # not tear down
-        async with self._connect_lock:
-            if self._closed:
-                return
-            if self._writer is not None and not self._writer.is_closing():
-                return
-            # close any lingering old transport FIRST so the server
-            # purges that connection's subscription refs before (or
-            # concurrently with) the reseed replaying them on the new
-            # connection — the service-side refcounting makes either
-            # ordering safe, but a half-open fd must not leak
-            self._drop_transport()
-            try:
-                reader, writer = await asyncio.open_unix_connection(
-                    self.path)
-            except OSError:
-                return                  # next enqueue retries
-            self._reader, self._writer = reader, writer
-            self._reader_task = asyncio.ensure_future(
-                self._read_loop(reader, writer))
-            self.reconnects += 1
-            if self._reseed is not None:
-                self._reseed(self)      # replay current subscriptions
+        delay = self.RECONNECT_BACKOFF_INITIAL
+        while True:
+            # under the connect lock: a concurrent connect() may already
+            # have restored a live transport, which a queued reconnect
+            # must not tear down
+            async with self._connect_lock:
+                if self._closed:
+                    return
+                if (self._writer is not None
+                        and not self._writer.is_closing()):
+                    return
+                # close any lingering old transport FIRST so the server
+                # purges that connection's subscription refs before (or
+                # concurrently with) the reseed replaying them on the
+                # new connection — the service-side refcounting makes
+                # either ordering safe, but a half-open fd must not leak
+                self._drop_transport()
+                self.reconnect_attempts += 1
+                try:
+                    reader, writer = await asyncio.open_unix_connection(
+                        self.path)
+                except OSError:
+                    pass                # retry after backoff below
+                else:
+                    self._reader, self._writer = reader, writer
+                    self._reader_task = asyncio.ensure_future(
+                        self._read_loop(reader, writer))
+                    self.reconnects += 1
+                    if self._reseed is not None:
+                        self._reseed(self)  # replay current subscriptions
+                    return
+            await asyncio.sleep(
+                delay * (1 + self.RECONNECT_JITTER * random.random()))
+            delay = min(delay * 2, self.RECONNECT_BACKOFF_MAX)
 
     async def subscribers_async(self, topic: str) -> SubscriberSet:
         return await self.enqueue(topic)
@@ -504,12 +552,21 @@ class _ForwardHook(Hook):
             self.matcher.forward_drop(client.id)
 
 
-async def attach_matcher_service(broker, path: str) -> ServiceMatcher:
+async def attach_matcher_service(broker, path: str,
+                                 supervisor: dict | None = None):
     """Connect to a MatcherService and wire a broker to it: matcher for
     the publish pipeline + hook forwarding subscription ops. The
     broker's CURRENT index contents (e.g. subscriptions restored from
     persistent storage, which bypass the subscribe hooks) are seeded to
-    the service at attach time and re-seeded after any reconnect."""
+    the service at attach time and re-seeded after any reconnect.
+
+    ``supervisor`` (a dict of SupervisedMatcher kwargs, or None to
+    attach bare) wraps the broker-facing surface in the ADR-011
+    degradation ladder: a dead socket, a hung service, or an errored
+    match answers from the broker's own CPU trie within the deadline.
+    Returns the attached matcher (the supervisor when wrapped — its
+    ServiceMatcher is reachable as ``.inner``, and attribute access
+    delegates, so ``forward_*``/stats work on either)."""
     matcher = ServiceMatcher(path)
     matcher.index = broker.topics       # enables the topic cache
     await matcher.connect()
@@ -521,5 +578,11 @@ async def attach_matcher_service(broker, path: str) -> ServiceMatcher:
     matcher._reseed = reseed
     reseed(matcher)
     broker.add_hook(_ForwardHook(matcher))
-    broker.attach_matcher(matcher)
-    return matcher
+    attach = matcher
+    if supervisor is not None:
+        from .supervisor import SupervisedMatcher
+        attach = SupervisedMatcher(matcher, index=broker.topics,
+                                   logger=getattr(broker, "log", None),
+                                   **supervisor)
+    broker.attach_matcher(attach)
+    return attach

@@ -47,6 +47,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import faults
 from .dense import extract_nonzero_words
 from .nfa import Entry, EntryBuilder
 from .topics import (batch_bucket as _batch_bucket, filter_matches_topic,
@@ -1517,6 +1518,7 @@ class SigEngine(OverlayedEngine):
             if (not force and state is not None
                     and state[0].version == self.index.sub_version):
                 return False
+            faults.fire(faults.DEVICE_RECOMPILE)
             tables = compile_sig(self.index, max_levels=self.max_levels)
             if len(tables.groups) > MAX_GROUPS:
                 # pathological corpus (thousands of distinct wildcard
@@ -1679,6 +1681,7 @@ class SigEngine(OverlayedEngine):
                 "device matching disabled for this corpus "
                 f"(> {MAX_GROUPS} signature groups); use the subscribers_* "
                 "APIs, which fall back to the CPU trie")
+        faults.fire(faults.DEVICE_MATCH)
         tables, fn = state[0], state[2]
         toks, lengths, dollar = tables.tokenize(topics, self.max_levels)
         word_idx, word_val, overflow = fn(
@@ -1856,6 +1859,7 @@ class SigEngine(OverlayedEngine):
                 "device matching disabled for this corpus "
                 f"(> {MAX_GROUPS} signature groups); use the subscribers_* "
                 "APIs, which fall back to the CPU trie")
+        faults.fire(faults.DEVICE_MATCH)
         tables, fn_fixed, fmt = state[0], state[6], state[7]
         toks8, lens_enc, hostrows = prepare_batch(tables, topics)
         # Bucket the batch axis to powers of two: fn_fixed is jitted, so
@@ -1944,6 +1948,11 @@ class SigEngine(OverlayedEngine):
             return cpu
         try:
             ctx = self.dispatch_fixed(topics)
+        except faults.DeviceMatchError:
+            # a device fault is NOT the trie-only state swap below: it
+            # must surface so the ADR-011 supervisor can count it toward
+            # its breaker (it still answers the caller from the trie)
+            raise
         except RuntimeError:     # state swapped to trie-only mid-call
             return self._resync_batch(topics)
         return self.collect_fixed(topics, ctx)
@@ -2144,6 +2153,8 @@ class SigEngine(OverlayedEngine):
             return cpu
         try:
             counts, stream, total, hostrows, tables = self.match_compact(topics)
+        except faults.DeviceMatchError:
+            raise               # surface to the ADR-011 supervisor
         except RuntimeError:     # state swapped to trie-only mid-call
             return self._resync_batch(topics)
         overlay = self.overlay_for(tables.version)
@@ -2182,6 +2193,8 @@ class SigEngine(OverlayedEngine):
         try:
             word_idx, word_val, overflow, hostrows, tables = \
                 self.match_raw(topics)
+        except faults.DeviceMatchError:
+            raise               # surface to the ADR-011 supervisor
         except RuntimeError:     # state swapped to trie-only mid-call
             return self._resync_batch(topics)
         overlay = self.overlay_for(tables.version)

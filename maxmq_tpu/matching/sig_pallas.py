@@ -566,5 +566,17 @@ def build_fixed_fn(tables: SigTables, consts: dict, kplan: dict,
             pos.reshape(-1)].set(rows_sorted.reshape(-1), mode="drop")
         return counts_u8[:batch], stream
 
-    return fn, {"kind": "stream", "enc_bits": enc_bits,
-                "max_rows": max_rows}
+    def fn_surfaced(toks8, lens_enc):
+        # kernel-launch / runtime failures come back as opaque XLA
+        # exceptions; re-raise typed so the ADR-011 supervisor's logs
+        # separate a sick device from a host bug (the supervisor answers
+        # from the CPU trie either way)
+        try:
+            return fn(toks8, lens_enc)
+        except Exception as exc:
+            from ..faults import DeviceMatchError
+            raise DeviceMatchError(
+                f"fused sig kernel dispatch failed: {exc!r:.300}") from exc
+
+    return fn_surfaced, {"kind": "stream", "enc_bits": enc_bits,
+                         "max_rows": max_rows}

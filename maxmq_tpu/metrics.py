@@ -257,10 +257,9 @@ def _register_matcher_metrics(registry: Registry, broker) -> None:
             "maxmq_matcher_matches_total",
             "Topic matches answered by the device matcher",
             lambda: matcher.matches)
-        registry.counter_func(
-            "maxmq_matcher_fallbacks_total",
-            "Topic matches that overflowed to the CPU trie fallback",
-            lambda: matcher.fallbacks)
+        _register_fallback_metrics(registry, matcher)
+        if hasattr(matcher, "breaker_state"):
+            _register_breaker_metrics(registry, matcher)
         if hasattr(matcher, "batches"):
             registry.counter_func(
                 "maxmq_matcher_batches_total",
@@ -300,11 +299,7 @@ def _register_matcher_metrics(registry: Registry, broker) -> None:
                 lambda: eng.trie_routed)
         if hasattr(eng, "kernel_plan"):
             _register_kernel_width_metrics(registry, eng)
-        if hasattr(matcher, "reconnects"):
-            registry.counter_func(
-                "maxmq_matcher_service_reconnects_total",
-                "Matcher-service transport reconnects",
-                lambda: matcher.reconnects)
+        _register_transport_metrics(registry, matcher)
     if matcher is not None:
         # ANY attached matcher drives the ADR-006 pipeline; scrapes run
         # on the metrics thread while close() may null the queue on the
@@ -314,6 +309,83 @@ def _register_matcher_metrics(registry: Registry, broker) -> None:
             "Publishes queued awaiting in-order fan-out (ADR 006)",
             lambda: (q.qsize()
                      if (q := broker._pub_queue) is not None else 0))
+        registry.counter_func(
+            "maxmq_broker_publish_trie_degraded_total",
+            "Publishes served from the broker's own trie after a match "
+            "future failed (the rung below the ADR-011 supervisor)",
+            lambda: broker.matcher_degrades)
+
+
+def _register_fallback_metrics(registry: Registry, matcher) -> None:
+    if hasattr(matcher, "fallbacks_by_reason"):
+        # ADR 011: the pre-supervisor single counter is split by reason
+        # (docs/migration.md); the unlabelled total is the sum over it
+        for reason in ("overflow", "error", "deadline", "breaker_open"):
+            registry.counter_func(
+                "maxmq_matcher_fallbacks_total",
+                "Topic matches degraded to the CPU trie, by reason",
+                lambda r=reason: matcher.fallbacks_by_reason.get(r, 0),
+                labels={"reason": reason})
+    else:
+        registry.counter_func(
+            "maxmq_matcher_fallbacks_total",
+            "Topic matches that overflowed to the CPU trie fallback",
+            lambda: matcher.fallbacks)
+
+
+def _register_transport_metrics(registry: Registry, matcher) -> None:
+    if hasattr(matcher, "reconnects"):
+        registry.counter_func(
+            "maxmq_matcher_service_reconnects_total",
+            "Matcher-service transport reconnects",
+            lambda: matcher.reconnects)
+    if hasattr(matcher, "reconnect_attempts"):
+        registry.counter_func(
+            "maxmq_matcher_service_reconnect_attempts_total",
+            "Matcher-service reconnect attempts (incl. failed ones "
+            "retried under the capped exponential backoff)",
+            lambda: matcher.reconnect_attempts)
+    if hasattr(matcher, "errors"):
+        registry.counter_func(
+            "maxmq_matcher_batch_errors_total",
+            "Micro-batches whose engine call raised (each degraded "
+            "upstream per ADR 011)",
+            lambda: matcher.errors)
+
+
+def _register_breaker_metrics(registry: Registry, matcher) -> None:
+    """ADR-011 degradation-ladder observability: breaker state and the
+    time/recovery counters that make degraded-mode tails explainable."""
+    registry.gauge_func(
+        "maxmq_matcher_breaker_state",
+        "Matcher circuit breaker state (0=closed, 1=open, 2=half-open)",
+        lambda: matcher.breaker_state)
+    registry.counter_func(
+        "maxmq_matcher_breaker_trips_total",
+        "Times the matcher breaker opened (device path -> trie-only)",
+        lambda: matcher.breaker_trips)
+    registry.counter_func(
+        "maxmq_matcher_breaker_recoveries_total",
+        "Times a half-open reprobe restored the device path",
+        lambda: matcher.breaker_recoveries)
+    registry.counter_func(
+        "maxmq_matcher_degraded_seconds_total",
+        "Cumulative wall time with the breaker not closed",
+        lambda: matcher.degraded_seconds)
+    registry.counter_func(
+        "maxmq_matcher_refresh_failures_total",
+        "Table recompiles that failed (last-good tables kept serving)",
+        lambda: matcher.refresh_failures)
+
+
+def register_pool_metrics(registry: Registry, stats) -> None:
+    """The pool parent's supervision counters (broker/workers.py's
+    PoolStats) — served from the parent process, which owns the only
+    view of worker lifecycles."""
+    registry.counter_func(
+        "maxmq_pool_worker_restarts_total",
+        "Pool worker processes respawned after an unexpected exit",
+        lambda: stats.worker_restarts)
 
 
 def _register_kernel_width_metrics(registry: Registry, eng) -> None:

@@ -84,6 +84,21 @@ class Config:
     matcher_mesh: str = ""              # e.g. "2x4" to shard over a mesh
     matcher_socket: str = "/tmp/maxmq-matcher.sock"  # matcher = "service"
 
+    # -- matcher degradation ladder (ADR 011) --------------------------------
+    # wrap the device/service matcher in the supervisor: per-batch
+    # deadline, trie hedge on error, circuit breaker, half-open reprobe
+    matcher_supervised: bool = True
+    matcher_deadline_ms: int = 250      # per-batch deadline; 0 disables
+    matcher_breaker_threshold: int = 5  # failures in the window that trip
+    matcher_breaker_window_s: float = 10.0
+    matcher_breaker_backoff_s: float = 1.0      # first open interval
+    matcher_breaker_backoff_max_s: float = 30.0  # backoff doubles to here
+
+    # -- worker pool observability -------------------------------------------
+    # optional metrics endpoint served by the POOL PARENT (worker 0 owns
+    # conf.metrics_address): exposes maxmq_pool_* supervision counters
+    pool_metrics_address: str = ""
+
     # -- profiling ----------------------------------------------------------
     profile: bool = False
     profile_path: str = "."
@@ -118,6 +133,8 @@ def _coerce(value, typ):
         return str(value).strip().lower() in ("1", "true", "yes", "on")
     if typ is int:
         return int(value)
+    if typ is float:
+        return float(value)
     return str(value)
 
 

@@ -1,12 +1,18 @@
 """Test harness config: force the CPU backend with 8 virtual devices so the
 mesh/sharding tests run without real TPU hardware (the driver separately
-dry-runs the multi-chip path)."""
+dry-runs the multi-chip path). Also enforces the per-test timeout cap
+(pyproject ``timeout``) when pytest-timeout isn't installed — one hung
+device call must fail ONE test with a traceback, not consume the whole
+tier-1 budget."""
 
 import asyncio
+import importlib.util
 import inspect
 import os
 
 import pytest
+
+_HAVE_PYTEST_TIMEOUT = importlib.util.find_spec("pytest_timeout") is not None
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
@@ -19,6 +25,58 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+if not _HAVE_PYTEST_TIMEOUT:
+    # Fallback mini-plugin mirroring pytest-timeout's config surface
+    # (ini ``timeout`` / ``@pytest.mark.timeout(N)``, signal method):
+    # CI installs the real plugin; this image doesn't ship it, and the
+    # 870s tier-1 budget cannot absorb a single wedged device call.
+    def pytest_addoption(parser):
+        parser.addini("timeout", "per-test timeout in seconds "
+                      "(conftest fallback for pytest-timeout)",
+                      default="0")
+        parser.addini("timeout_method", "accepted for pytest-timeout "
+                      "compatibility; the fallback always uses signal",
+                      default="signal")
+
+    def _item_timeout(item) -> float:
+        marker = item.get_closest_marker("timeout")
+        if marker is not None and marker.args:
+            return float(marker.args[0])
+        try:
+            return float(item.config.getini("timeout") or 0)
+        except ValueError:
+            return 0.0
+
+    @pytest.hookimpl(hookwrapper=True)
+    def pytest_runtest_call(item):
+        import faulthandler
+        import signal
+        import threading
+
+        timeout = _item_timeout(item)
+        if (timeout <= 0 or not hasattr(signal, "SIGALRM")
+                or threading.current_thread()
+                is not threading.main_thread()):
+            yield
+            return
+
+        def on_alarm(signum, frame):
+            # all-thread dump FIRST: the hang is usually in a worker
+            # thread (device dispatch), and the failing frame alone
+            # wouldn't say which call wedged
+            faulthandler.dump_traceback()
+            pytest.fail(f"test timed out after {timeout:.0f}s "
+                        "(conftest timeout fallback)", pytrace=False)
+
+        old = signal.signal(signal.SIGALRM, on_alarm)
+        signal.setitimer(signal.ITIMER_REAL, timeout)
+        try:
+            yield
+        finally:
+            signal.setitimer(signal.ITIMER_REAL, 0)
+            signal.signal(signal.SIGALRM, old)
 
 
 @pytest.hookimpl(tryfirst=True)

@@ -62,8 +62,20 @@ class TestConfigMapping:
         assert len(broker.hooks) == 3  # logging + allow + storage
 
     def test_build_broker_dense_matcher_is_batched(self):
+        from maxmq_tpu.matching.supervisor import SupervisedMatcher
+
         conf = Config(mqtt_tcp_address="", metrics_enabled=False,
                       matcher="dense", matcher_max_levels=8)
+        broker = build_broker(conf, quiet_logger())
+        # ADR 011: the batcher ships wrapped in the degradation ladder
+        assert isinstance(broker.matcher, SupervisedMatcher)
+        assert isinstance(broker.matcher.inner, MicroBatcher)
+        assert broker.matcher.index is broker.topics
+
+    def test_build_broker_matcher_supervision_opt_out(self):
+        conf = Config(mqtt_tcp_address="", metrics_enabled=False,
+                      matcher="dense", matcher_max_levels=8,
+                      matcher_supervised=False)
         broker = build_broker(conf, quiet_logger())
         assert isinstance(broker.matcher, MicroBatcher)
 

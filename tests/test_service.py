@@ -5,6 +5,8 @@ import asyncio
 import os
 import tempfile
 
+import pytest
+
 from test_broker_system import connect, running_broker
 from test_nfa_parity import normalize
 
@@ -400,3 +402,98 @@ async def test_service_encode_memo_reuses_fragments():
         await m.close()
     finally:
         await svc.close()
+
+
+async def test_restart_mid_match_reseed_race(tmp_path):
+    """The ADR-011 reconnect/reseed race: restart the service while a
+    match is IN FLIGHT. The pending future must error (trie fallback
+    upstream), the client result cache must be invalidated, and the
+    reconnect must replay the live subscription set exactly once."""
+    from maxmq_tpu.matching.trie import subs_version
+
+    path = str(tmp_path / "m.sock")
+
+    class HangingMatcher:                    # never answers: the match
+        async def subscribers_async(self, topic):   # is mid-flight when
+            await asyncio.Event().wait()            # the service dies
+
+    svc = MatcherService(path, engine_factory=lambda idx: HangingMatcher())
+    await svc.start()
+
+    idx = TopicIndex()
+    idx.subscribe("rc1", Subscription(filter="rr/+", qos=1))
+    idx.subscribe("rc2", Subscription(filter="rr/#", qos=0))
+    m = ServiceMatcher(path)
+    m.RECONNECT_BACKOFF_INITIAL = 0.02
+    m.index = idx
+    reseeds = []
+
+    def reseed(mm):
+        reseeds.append(1)
+        for cid, sub in idx.walk_subscriptions():
+            mm.forward_subscribe(cid, sub)
+
+    m._reseed = reseed
+    await m.connect()
+    reseed(m)                                # attach-time seed (as prod)
+    ver = subs_version(idx)
+    m._cache.put("rr/x", ver, idx.subscribers("rr/x"))   # warm cache
+
+    fut = m.enqueue("rr/x2")                 # in flight (never answered)
+    await asyncio.sleep(0.1)
+    assert not fut.done() and m._pending
+    await svc.close()                        # restart begins mid-match
+    with pytest.raises((ConnectionError, RuntimeError)):
+        await asyncio.wait_for(fut, timeout=5)   # pending future errors
+    assert not m._pending
+    assert m._cache.get("rr/x", ver) is None     # cache invalidated
+
+    svc2 = MatcherService(path)              # service comes back
+    await svc2.start()
+    try:
+        reseeds.clear()
+        with pytest.raises((ConnectionError, RuntimeError)):
+            await m.enqueue("rr/kick")       # kicks the reconnect loop
+        for _ in range(100):
+            if m.reconnects and svc2.subs_applied >= 2:
+                break
+            await asyncio.sleep(0.05)
+        assert sum(reseeds) == 1             # replayed exactly once
+        assert svc2.subs_applied == 2        # the live set, no extras
+        got = await m.subscribers_async("rr/y")
+        assert set(got.subscriptions) == {"rc1", "rc2"}
+    finally:
+        await m.close()
+        await svc2.close()
+
+
+async def test_reconnect_backoff_retries_while_quiet(tmp_path):
+    """The reconnect loop keeps retrying under capped exponential
+    backoff while traffic is quiet — the old behavior gave up after one
+    OSError and waited for the next enqueue, so a silent broker stayed
+    disconnected as long as it stayed silent."""
+    path = str(tmp_path / "m.sock")
+    svc = MatcherService(path)
+    await svc.start()
+    m = ServiceMatcher(path)
+    m.RECONNECT_BACKOFF_INITIAL = 0.02
+    m.RECONNECT_BACKOFF_MAX = 0.1
+    await m.connect()
+    await m.subscribers_async("warm/x")      # connection fully accepted
+    await svc.close()                        # service gone
+    with pytest.raises((ConnectionError, RuntimeError)):
+        await m.enqueue("q/x")               # ONE kick, then silence
+    await asyncio.sleep(0.3)                 # loop retries on its own
+    assert m.reconnect_attempts >= 2, m.reconnect_attempts
+    svc2 = MatcherService(path)
+    await svc2.start()
+    try:
+        for _ in range(100):                 # no further enqueues: the
+            if m.reconnects:                 # loop alone reconnects
+                break
+            await asyncio.sleep(0.05)
+        assert m.reconnects == 1
+        assert m._writer is not None and not m._writer.is_closing()
+    finally:
+        await m.close()
+        await svc2.close()
