@@ -31,7 +31,9 @@ cache-friendly stream a real broker sees — reported alongside, never
 as the headline; opt-in extras outside the default list: widthab =
 the ADR-010 kernel-width A/B, degraded = the ADR-011 ladder under
 injected device faults — healthy vs breaker-open trie-only vs
-recovered throughput),
+recovered throughput, overload = the ADR-012 host-path ladder —
+healthy vs shedding (stalled consumer + CONNECT storm) vs recovered
+broker fan-out),
 MAXMQ_BENCH_SUBS/BATCH/ITERS/DEPTH override config #4's shape.
 """
 
@@ -1281,6 +1283,159 @@ def bench_degraded(n_subs: int = 100_000, batch: int = 8192,
     return d
 
 
+def bench_overload(n_clients: int = 8, msgs: int = 300) -> dict:
+    """ADR-012 overload ladder measurement (MAXMQ_BENCH_CONFIGS=overload):
+    a live broker + real TCP clients in three regimes — healthy QoS0
+    fan-out, a stalled consumer + CONNECT storm under load shedding,
+    and post-recovery (stall deadline fires, queue releases, watermarks
+    recover) — so the ladder's cost and the broker's liveness under
+    overload are numbers, not hopes. The slow consumer is driven
+    deterministically through the fault registry (client.write#<id>
+    hang), the storm through the per-listener token bucket."""
+    import asyncio
+
+    from maxmq_tpu import faults
+    from maxmq_tpu.broker import (Broker, BrokerOptions, Capabilities,
+                                  TCPListener)
+    from maxmq_tpu.hooks import AllowHook
+    from maxmq_tpu.mqtt_client import MQTTClient
+
+    payload = b"o" * 512
+
+    async def run() -> dict:
+        caps = Capabilities(
+            sys_topic_interval=0,
+            client_byte_budget=1 << 20,
+            broker_byte_budget=128 * 1024,
+            overload_high_water=0.5, overload_low_water=0.1,
+            # long enough that the WHOLE shedding phase is measured
+            # before the stall deadline frees the wedged consumer
+            stall_deadline_ms=4000,
+            connect_rate=0.001, connect_burst=n_clients + 2)
+        b = Broker(BrokerOptions(capabilities=caps))
+        b.add_hook(AllowHook())
+        lst = b.add_listener(TCPListener("t", "127.0.0.1:0"))
+        await b.serve()
+        port = lst._server.sockets[0].getsockname()[1]
+        subs = []
+        for i in range(n_clients):
+            c = MQTTClient(client_id=f"h{i}")
+            await c.connect("127.0.0.1", port)
+            await c.subscribe("bench/#")
+            subs.append(c)
+        pub = MQTTClient(client_id="pub")
+        await pub.connect("127.0.0.1", port)
+
+        async def measure(n: int) -> tuple[float, float]:
+            """n PUBACK-paced publishes fanning out as QoS0 deliveries;
+            (delivered/sec to span-of-last-delivery, delivered frac).
+            QoS1 on the inbound leg paces the publisher so the HEALTHY
+            phase measures fan-out, not self-inflicted queue growth."""
+            got = 0
+            for c in subs:                  # flush stragglers
+                while not c.messages.empty():
+                    c.messages.get_nowait()
+            t0 = time.perf_counter()
+            t_last = t0
+
+            async def drain(c):
+                nonlocal got, t_last
+                while True:
+                    try:
+                        await c.next_message(timeout=1.0)
+                    except asyncio.TimeoutError:
+                        return
+                    got += 1
+                    t_last = time.perf_counter()
+
+            for _ in range(n):
+                await pub.publish("bench/t", payload, qos=1)
+            await asyncio.gather(*(drain(c) for c in subs))
+            span = max(t_last - t0, 1e-9)
+            return round(got / span, 1), round(got / (n * len(subs)), 3)
+
+        async def poll(cond, timeout_s: float) -> bool:
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                if cond():
+                    return True
+                await asyncio.sleep(0.05)
+            return False
+
+        d: dict = {"config": "overload", "fanout_clients": n_clients,
+                   "messages_per_phase": msgs}
+        d["healthy_msgs_per_sec"], d["healthy_delivered_frac"] = \
+            await measure(msgs)
+
+        # regime 2: a stalled consumer drives the byte ledger over the
+        # high-water mark while a CONNECT storm hits the token bucket
+        slow = MQTTClient(client_id="slowpoke")
+        await slow.connect("127.0.0.1", port)
+        await slow.subscribe("bench/#")
+        faults.arm(f"{faults.CLIENT_WRITE}#slowpoke", "hang",
+                   count=-1, delay_s=30.0)
+        while not b.overload.shedding:        # grow the wedged queue
+            await pub.publish("bench/t", payload, qos=1)
+        refused = 0
+        for i in range(12):
+            c = MQTTClient(client_id=f"storm{i}")
+            try:
+                await c.connect("127.0.0.1", port, timeout=2.0)
+                await c.disconnect()
+            except Exception:
+                refused += 1
+        t0 = time.perf_counter()
+        ping_tasks = [subs[0].ping()]         # liveness through the shed
+        await asyncio.gather(*ping_tasks)
+        d["healthy_ping_ms_while_shedding"] = round(
+            (time.perf_counter() - t0) * 1e3, 2)
+        d["shedding_msgs_per_sec"], d["shedding_delivered_frac"] = \
+            await measure(msgs)
+
+        # regime 3: the stall deadline disconnects the wedged consumer,
+        # its queue releases, and the watermarks recover
+        t0 = time.perf_counter()
+        recovered = await poll(
+            lambda: b.overload.stalled_disconnects > 0
+            and not b.overload.shedding, timeout_s=15.0)
+        d["recovered"] = recovered
+        d["recovery_s"] = round(time.perf_counter() - t0, 2)
+        # disarm before measuring: an armed registry costs every writer
+        # a fire_detail probe per packet, which would bias the
+        # healthy-vs-recovered comparison
+        faults.disarm(f"{faults.CLIENT_WRITE}#slowpoke")
+        d["recovered_msgs_per_sec"], d["recovered_delivered_frac"] = \
+            await measure(msgs)
+
+        over = b.overload
+        d.update(connects_refused=over.connects_refused,
+                 storm_refused_observed=refused,
+                 sheds=over.sheds, recoveries=over.recoveries,
+                 shed_messages=over.shed_messages,
+                 budget_drops=over.budget_drops,
+                 qos_drops=over.qos_drops,
+                 stalled_disconnects=over.stalled_disconnects)
+        for c in subs + [pub]:
+            try:
+                await c.disconnect()
+            except Exception:
+                pass
+        await b.close()
+        return d
+
+    try:
+        d = asyncio.run(run())
+    finally:
+        faults.clear()      # a leaked armed fault must not outlive this
+    log(f"[overload] healthy={d['healthy_msgs_per_sec']}/s "
+        f"shedding={d['shedding_msgs_per_sec']}/s "
+        f"(frac {d['shedding_delivered_frac']}) "
+        f"recovered={d['recovered_msgs_per_sec']}/s "
+        f"refused={d['connects_refused']} "
+        f"stalls={d['stalled_disconnects']}")
+    return d
+
+
 def bench_cluster(subs: int = 100_000, batch: int = 8192,
                   msgs: int = 10_000) -> dict:
     log("[cluster] 8-dev CPU mesh subprocess ...")
@@ -1538,6 +1693,10 @@ def main() -> None:
         runs.append(("degraded_mode",
                      lambda: bench_degraded(n_subs=s(100_000),
                                             batch=s(8_192))))
+    if "overload" in which:
+        # ADR-012 host-path ladder: healthy vs shedding (stalled
+        # consumer + CONNECT storm) vs recovered broker throughput
+        runs.append(("overload", lambda: bench_overload()))
     if "5" in which:
         runs.append(("cluster", lambda: bench_cluster(subs=s(100_000))))
     if "e2e" in which:
@@ -1621,7 +1780,7 @@ def assemble_result(configs: list, link: dict, backend_name: str,
 CONFIG_DEADLINES = {"1": 900, "2": 900, "3": 1200, "4": 2400,
                     "4h": 2400, "lat": 900, "lath": 900, "latd": 900,
                     "latdo": 1200, "5": 2400, "e2e": 4200,
-                    "widthab": 1200}
+                    "widthab": 1200, "degraded": 1200, "overload": 900}
 
 
 def run_supervised(which: list[str]) -> None:

@@ -54,6 +54,15 @@ def capabilities_from_config(conf: Config) -> Capabilities:
         maximum_client_writes_pending=conf.mqtt_max_outbound_queue,
         maximum_inflight=conf.mqtt_max_inflight_messages,
         sys_topic_interval=float(conf.mqtt_sys_topic_interval),
+        # overload-protection ladder (ADR 012)
+        client_byte_budget=conf.broker_client_byte_budget,
+        broker_byte_budget=conf.broker_byte_budget,
+        connect_rate=float(conf.connect_rate),
+        connect_burst=conf.connect_burst,
+        connect_half_open_max=conf.connect_half_open_max,
+        stall_deadline_ms=conf.stall_deadline_ms,
+        overload_high_water=float(conf.broker_overload_high_water),
+        overload_low_water=float(conf.broker_overload_low_water),
     )
 
 

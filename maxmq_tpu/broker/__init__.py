@@ -1,15 +1,16 @@
 """Host-side broker runtime: server engine, sessions, listeners, QoS flows."""
 
-from .client import Client, ClientRegistry, PacketIDExhausted
+from .client import Client, ClientRegistry, OutboundQueue, PacketIDExhausted
 from .inflight import Inflight
 from .listeners import (Listener, Listeners, MockListener, SocketListener,
                         TCPListener, UnixListener, WSListener)
+from .overload import OverloadState, TokenBucket
 from .server import Broker, BrokerOptions, Capabilities
 from .sys_info import SysInfo
 
 __all__ = [
-    "Client", "ClientRegistry", "PacketIDExhausted", "Inflight",
-    "Listener", "Listeners", "MockListener", "SocketListener",
-    "TCPListener", "UnixListener", "WSListener", "Broker",
-    "BrokerOptions", "Capabilities", "SysInfo",
+    "Client", "ClientRegistry", "OutboundQueue", "PacketIDExhausted",
+    "Inflight", "Listener", "Listeners", "MockListener", "SocketListener",
+    "TCPListener", "UnixListener", "WSListener", "OverloadState",
+    "TokenBucket", "Broker", "BrokerOptions", "Capabilities", "SysInfo",
 ]

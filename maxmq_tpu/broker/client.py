@@ -13,6 +13,7 @@ import time
 from collections import deque
 from dataclasses import dataclass
 
+from .. import faults
 from ..matching.trie import TopicAliases
 from ..protocol.codec import PacketType as PT
 from ..protocol.packets import Packet, ProtocolError, Subscription, Will, parse_stream
@@ -36,6 +37,104 @@ class ClientProperties:
 
 class PacketIDExhausted(Exception):
     pass
+
+
+def _estimate_wire(packet: Packet) -> int:
+    """Cheap wire-size estimate for byte accounting: exact encoding is
+    deferred to the writer task, so the budget ledger uses topic+payload
+    plus a flat header/property allowance. The estimate is stored with
+    the queued item, so enqueue/dequeue accounting is always symmetric."""
+    if packet.type == PT.PUBLISH:
+        return 32 + len(packet.topic) + len(packet.payload or b"")
+    return 32
+
+
+def _droppable_qos0(item) -> bool:
+    """True for queued items the slow-consumer policy may shed: QoS0
+    PUBLISH deliveries only — never acks, control packets, QoS>0
+    publishes (those park on session rules), or the shutdown sentinel."""
+    if type(item) is bytes:
+        return (item[0] >> 4) == PT.PUBLISH and (item[0] & 0x06) == 0
+    return (item is not None and item.type == PT.PUBLISH
+            and item.fixed.qos == 0)
+
+
+class OutboundQueue:
+    """Bounded single-consumer outbound queue with wire-byte accounting
+    (ADR 012). Each entry carries the byte size charged at enqueue, so
+    the per-client ledger (``self.bytes``) and the broker-global ledger
+    (``overload.queued_bytes``) stay exact without re-deriving sizes at
+    dequeue. The sole consumer is the client's writer task."""
+
+    def __init__(self, maxsize: int, overload=None) -> None:
+        self._q: deque = deque()
+        self._maxsize = maxsize
+        self._getter: asyncio.Future | None = None
+        self._overload = overload
+        self.bytes = 0
+
+    def qsize(self) -> int:
+        return len(self._q)
+
+    def put_nowait(self, item, size: int = 0) -> None:
+        if self._maxsize and len(self._q) >= self._maxsize:
+            raise asyncio.QueueFull
+        self._q.append((item, size))
+        self.bytes += size
+        if self._overload is not None:
+            self._overload.note_put(size)
+        g = self._getter
+        if g is not None and not g.done():
+            g.set_result(None)
+
+    def get_nowait(self):
+        if not self._q:
+            raise asyncio.QueueEmpty
+        item, size = self._q.popleft()
+        self._account_out(size)
+        return item
+
+    async def get(self):
+        while not self._q:
+            self._getter = asyncio.get_running_loop().create_future()
+            try:
+                await self._getter
+            finally:
+                self._getter = None
+        return self.get_nowait()
+
+    def _account_out(self, size: int) -> None:
+        self.bytes -= size
+        if self._overload is not None:
+            self._overload.note_get(size)
+
+    def drop_oldest_qos0(self, need: int) -> tuple[list, int]:
+        """Shed the oldest droppable (QoS0 PUBLISH) entries until at
+        least ``need`` bytes are freed or none remain; other entries
+        keep their order. Returns (dropped items, bytes freed) — the
+        items so the caller can fire drop hooks for Packet entries."""
+        freed = 0
+        dropped: list = []
+        kept: deque = deque()
+        while self._q and freed < need:
+            item, size = self._q.popleft()
+            if _droppable_qos0(item):
+                freed += size
+                dropped.append(item)
+                self._account_out(size)
+            else:
+                kept.append((item, size))
+        while kept:
+            self._q.appendleft(kept.pop())
+        return dropped, freed
+
+    def release_all(self) -> None:
+        """Drop everything still queued and settle both byte ledgers
+        (client teardown: abandoned bytes must not pin the global
+        watermark above the recovery threshold forever)."""
+        while self._q:
+            _item, size = self._q.popleft()
+            self._account_out(size)
 
 
 class Client:
@@ -78,11 +177,20 @@ class Client:
 
         maxq = server.capabilities.maximum_client_writes_pending
         # bytes items are pre-encoded wire (QoS0 fan-out fast path);
-        # None is the writer-shutdown sentinel
-        self.outbound: asyncio.Queue[Packet | bytes | None] = \
-            asyncio.Queue(maxsize=maxq)
+        # None is the writer-shutdown sentinel. Byte-accounted against
+        # the per-client and broker budgets (ADR 012).
+        self.outbound = OutboundQueue(
+            maxq, overload=getattr(server, "overload", None))
         self._writer_task: asyncio.Task | None = None
         self._reader_task: asyncio.Task | None = None
+        # slow-consumer ledger (ADR 012): writer progress timestamp for
+        # the stall detector, the first fatal writer error, and
+        # per-client drop accounting surfaced via $SYS + /metrics
+        self.write_progress = time.monotonic()
+        self.write_error: str | None = None
+        self.dropped_msgs = 0
+        self.dropped_bytes = 0
+        self.drops_by_reason: dict[str, int] = {}
 
     # ------------------------------------------------------------------
 
@@ -141,6 +249,19 @@ class Client:
 
     def start(self) -> None:
         if self.writer is not None:
+            budget = self.server.capabilities.client_byte_budget
+            transport = getattr(self.writer, "transport", None)
+            if budget and transport is not None:
+                # cap the transport's own buffering so a slow consumer
+                # blocks the writer's drain() (and so shows up in the
+                # byte-accounted queue + stall detector) instead of
+                # hiding inside an unbounded transport buffer
+                try:
+                    transport.set_write_buffer_limits(
+                        high=min(budget, 65536))
+                except (AttributeError, RuntimeError):
+                    pass
+            self.write_progress = time.monotonic()
             self._writer_task = asyncio.get_running_loop().create_task(
                 self._write_loop(), name=f"mq-write-{self.id or id(self)}")
 
@@ -173,33 +294,72 @@ class Client:
             self.last_received = time.monotonic()
             buf.extend(chunk)
 
+    def _write_fault_delay(self) -> float:
+        """0.0 unless a client.write fault applies to this client —
+        then the seconds the writer must stall (hang mode). Kept sync
+        and gated on any_armed() so the idle-registry production cost
+        is one predicate call per written packet; raise-mode faults
+        propagate to the write loop as a recorded writer death."""
+        if not faults.REGISTRY.any_armed():
+            return 0.0
+        hit = faults.fire_detail(faults.CLIENT_WRITE, key=self.id)
+        return hit[1] if hit is not None and hit[0] == "hang" else 0.0
+
+    # greedy-burst byte cap: past this, the writer drains before
+    # dequeuing more, so a wedged consumer keeps its backlog in the
+    # ACCOUNTED queue (visible to stall detector + watermarks) instead
+    # of de-accounted inside the transport buffer (ADR 012)
+    BURST_BYTES = 65536
+
     async def _write_loop(self) -> None:
         assert self.writer is not None
         get_nowait = self.outbound.get_nowait
         try:
             while True:
                 packet = await self.outbound.get()
+                burst = 0
                 # greedy drain: one task wake-up flushes everything queued
-                # (one await per BURST, not per packet)
+                # (one await per BURST, not per packet), bounded in bytes
                 while packet is not None:
+                    stall = self._write_fault_delay()
+                    if stall:
+                        # deterministic slow consumer: stall THIS writer
+                        # without blocking the loop (tests/bench arm
+                        # client.write#<id>; see faults.fire_detail)
+                        await asyncio.sleep(stall)
                     if type(packet) is bytes:  # pre-encoded fast path
                         self.writer.write(packet)
                         info = self.server.info
                         info.bytes_sent += len(packet)
                         info.packets_sent += 1
+                        burst += len(packet)
                         if packet[0] >> 4 == PT.PUBLISH:
                             info.messages_sent += 1
                     else:
                         self._write_packet(packet)
+                        burst += _estimate_wire(packet)
+                    if burst >= self.BURST_BYTES:
+                        break
                     try:
                         packet = get_nowait()
                     except asyncio.QueueEmpty:
                         break
                 else:
                     break                      # drained a None: stop
+                self.write_progress = time.monotonic()
+                # flow control: past the transport high-water mark this
+                # blocks until the consumer catches up, backpressuring
+                # into the byte-accounted queue where the stall detector
+                # and budgets can see it (ADR 012)
+                await self.writer.drain()
+                self.write_progress = time.monotonic()
             await self._drain()
-        except (ConnectionError, asyncio.CancelledError, OSError):
+        except asyncio.CancelledError:
             pass
+        except (ConnectionError, OSError, faults.InjectedFault) as exc:
+            # a dead writer must be visible to the stall detector and
+            # stop_cause — not an apparently-healthy idle one
+            self.write_error = self.write_error or repr(exc)
 
     def _write_packet(self, packet: Packet) -> None:
         packet = self.server.hooks.modify("on_packet_encode", packet, self)
@@ -222,18 +382,76 @@ class Client:
         if self.writer is not None:
             try:
                 await self.writer.drain()
-            except (ConnectionError, OSError):
-                pass
+            except (ConnectionError, OSError) as exc:
+                # swallowed (shutdown path), but recorded: the stall
+                # detector and stop_cause must see the dead writer
+                self.write_error = self.write_error or repr(exc)
 
-    def send(self, packet: Packet) -> bool:
-        """Enqueue a packet for the writer task; False when the queue is full
-        (caller decides whether that drops a message)."""
+    def note_drop(self, reason: str, n: int = 1, size: int = 0) -> None:
+        """Per-client drop/stall accounting (ADR 012): what $SYS
+        top-offender reporting and the labelled metric read."""
+        self.dropped_msgs += n
+        self.dropped_bytes += size
+        self.drops_by_reason[reason] = \
+            self.drops_by_reason.get(reason, 0) + n
+
+    def _refuse_publish(self, size: int) -> str | None:
+        """Byte-budget admission for one queued PUBLISH delivery: free
+        room by shedding this client's oldest queued QoS0 publishes
+        first (oldest-first slow-consumer policy), then check the
+        global broker budget. Returns the refusal reason for the NEW
+        delivery, or None when admitted. The distinction matters for
+        attribution: "byte_budget" is THIS client's backpressure,
+        "global_budget" is broker-wide pressure some other consumer
+        caused — top_offenders only ranks the former."""
+        caps = self.server.capabilities
+        overload = self.server.overload
+        budget = caps.client_byte_budget
+        if budget and self.outbound.bytes + size > budget:
+            items, freed = self.outbound.drop_oldest_qos0(
+                self.outbound.bytes + size - budget)
+            if items:
+                self.note_drop("byte_budget", len(items), freed)
+                overload.budget_drops += len(items)
+                self.server.info.messages_dropped += len(items)
+                hooks = self.server.hooks
+                if hooks.overrides("on_publish_dropped"):
+                    for item in items:
+                        # pre-encoded wire sheds have no Packet to hand
+                        # the hook; counters above remain authoritative
+                        if type(item) is not bytes:
+                            hooks.notify("on_publish_dropped",
+                                         self, item)
+            if self.outbound.bytes + size > budget:
+                return "byte_budget"
+        if (caps.broker_byte_budget
+                and overload.queued_bytes + size > caps.broker_byte_budget):
+            return "global_budget"
+        return None
+
+    def send(self, packet: Packet, *, count_drops: bool = True) -> bool:
+        """Enqueue a packet for the writer task; False when the queue or
+        byte budget refused it (caller decides whether that drops a
+        message). Control packets are exempt from the byte budget —
+        they are small, and dropping acks would wedge the protocol.
+        ``count_drops=False`` suppresses refusal accounting for callers
+        whose refused message is NOT lost (inflight resend: it stays
+        parked and lands on a later resume)."""
         if self.closed or self.writer is None:
             return False
+        size = _estimate_wire(packet)
+        if packet.type == PT.PUBLISH and \
+                (reason := self._refuse_publish(size)) is not None:
+            if count_drops:
+                self.note_drop(reason, 1, size)
+                self.server.overload.budget_drops += 1
+            return False
         try:
-            self.outbound.put_nowait(packet)
+            self.outbound.put_nowait(packet, size)
             return True
         except asyncio.QueueFull:
+            if count_drops:
+                self.note_drop("queue_full", 1, size)
             return False
 
     def send_wire(self, wire: bytes) -> bool:
@@ -241,10 +459,17 @@ class Client:
         one encode shared by every subscriber on the same fixed flags)."""
         if self.closed or self.writer is None:
             return False
+        size = len(wire)
+        if (wire[0] >> 4) == PT.PUBLISH and \
+                (reason := self._refuse_publish(size)) is not None:
+            self.note_drop(reason, 1, size)
+            self.server.overload.budget_drops += 1
+            return False
         try:
-            self.outbound.put_nowait(wire)
+            self.outbound.put_nowait(wire, size)
             return True
         except asyncio.QueueFull:
+            self.note_drop("queue_full", 1, size)
             return False
 
     def send_now(self, packet: Packet) -> None:
@@ -268,6 +493,9 @@ class Client:
                 await asyncio.wait_for(self._writer_task, timeout=1.0)
             except (asyncio.TimeoutError, asyncio.CancelledError):
                 self._writer_task.cancel()
+        # settle the byte ledgers for anything never written: abandoned
+        # bytes must not pin the global watermark in shedding forever
+        self.outbound.release_all()
         if self.writer is not None:
             try:
                 self.writer.close()
@@ -286,7 +514,9 @@ class Client:
             q = p.copy()
             if q.type == PT.PUBLISH and force_dup:
                 q.fixed.dup = True
-            if self.send(q):
+            # a refused resend is parked, not dropped (it stays in
+            # inflight for the next resume): keep it off the drop books
+            if self.send(q, count_drops=False):
                 self.server.hooks.notify("on_qos_publish", self, q,
                                          time.time(), 1)
                 n += 1

@@ -23,6 +23,10 @@ class Listener:
         self.address = address
         self._server: asyncio.AbstractServer | None = None
         self._establish = None
+        # per-listener CONNECT admission gate (ADR 012): the broker
+        # installs a TokenBucket here when connect_rate is configured;
+        # an exhausted bucket refuses the socket before handshake work
+        self.gate = None
 
     @property
     def protocol(self) -> str:
@@ -399,6 +403,9 @@ class Listeners:
 
     def get(self, id_: str) -> Listener | None:
         return self._listeners.get(id_)
+
+    def all(self) -> list[Listener]:
+        return list(self._listeners.values())
 
     def __len__(self) -> int:
         return len(self._listeners)

@@ -16,6 +16,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from .. import faults
 from ..hooks.base import Hook, Hooks, RejectPacket
 from ..matching.topics import valid_filter, valid_topic_name
 from ..matching.trie import (SubscriberSet, TopicIndex,
@@ -26,6 +27,7 @@ from ..protocol.codec import (FixedHeader, MalformedPacketError,
 from ..protocol.packets import Packet, ProtocolError, Subscription
 from .client import Client, ClientRegistry, PacketIDExhausted
 from .listeners import Listener, Listeners
+from .overload import OverloadState, TokenBucket, top_offenders
 from .sys_info import SysInfo
 
 __version__ = "0.1.0"
@@ -83,6 +85,16 @@ class Capabilities:
     sys_topic_interval: float = 30.0  # seconds; 0 disables
     keepalive_grace: float = 1.5      # deadline = keepalive * grace
 
+    # -- overload-protection ladder (ADR 012); 0 disables each rung ----
+    client_byte_budget: int = 0       # per-client queued outbound bytes
+    broker_byte_budget: int = 0       # global queued outbound bytes
+    connect_rate: float = 0.0         # CONNECT admissions/sec per listener
+    connect_burst: int = 0            # bucket depth; 0 = max(1, rate)
+    connect_half_open_max: int = 0    # handshakes awaiting CONNECT
+    stall_deadline_ms: int = 0        # writer no-progress disconnect
+    overload_high_water: float = 0.8  # shed above budget * high_water
+    overload_low_water: float = 0.5   # recover below budget * low_water
+
 
 @dataclass
 class BrokerOptions:
@@ -126,6 +138,16 @@ class Broker:
         # broker's own trie (the rung BELOW the ADR-011 supervisor —
         # nonzero here means a failure got past the supervised matcher)
         self.matcher_degrades = 0
+        # overload-protection ladder (ADR 012): global byte ledger +
+        # watermark state, half-open handshake count, and retained
+        # deliveries parked while shedding (drained on recovery)
+        self.overload = OverloadState(self.capabilities)
+        self._half_open = 0
+        # (client_id, filter) -> (sub, existing): keyed so a client
+        # re-SUBSCRIBing during the shed window gets ONE delivery on
+        # recovery and the ledger is bounded by the subscription count
+        self._deferred_retained: dict[tuple[str, str],
+                                      tuple[Subscription, bool]] = {}
         self._running = False
         self.loop: asyncio.AbstractEventLoop | None = None
 
@@ -171,6 +193,14 @@ class Broker:
         self._running = True
         await self._restore_from_storage()
         await self._compile_matcher_tables()
+        if self.capabilities.connect_rate > 0:
+            # per-listener CONNECT token bucket (ADR 012): armed before
+            # accepting so the very first storm is already gated
+            for listener in self.listeners.all():
+                if listener.gate is None:
+                    listener.gate = TokenBucket(
+                        self.capabilities.connect_rate,
+                        self.capabilities.connect_burst)
         await self.listeners.serve_all(self._establish)
         self._housekeeper = self.loop.create_task(self._housekeeping_loop())
         if self.capabilities.sys_topic_interval > 0:
@@ -264,13 +294,50 @@ class Broker:
     # ------------------------------------------------------------------
 
     async def _establish(self, listener_id: str, reader, writer) -> None:
+        if not await self._admit_connection(listener_id):
+            try:
+                writer.close()
+            except Exception:
+                pass
+            return
         client = Client(self, reader, writer, listener_id)
+        client._half_open = True
+        self._half_open += 1
         try:
             await self._attach_client(client)
         except (ProtocolError, MalformedPacketError, ConnectionError, OSError):
             pass
         finally:
+            self._settle_half_open(client)
             await client.stop()
+
+    async def _admit_connection(self, listener_id: str) -> bool:
+        """Admission control (ADR 012): deterministic accept fault site,
+        per-listener CONNECT token bucket, half-open handshake cap. A
+        False refuses the socket before any handshake work is queued."""
+        try:
+            hit = faults.fire_detail(faults.LISTENER_ACCEPT)
+        except faults.InjectedFault:
+            self.overload.connects_refused += 1
+            return False
+        if hit is not None and hit[0] == "hang":
+            await asyncio.sleep(hit[1])
+        listener = self.listeners.get(listener_id)
+        gate = getattr(listener, "gate", None)
+        if gate is not None and not gate.allow():
+            self.overload.connects_refused += 1
+            return False
+        caps = self.capabilities
+        if (caps.connect_half_open_max
+                and self._half_open >= caps.connect_half_open_max):
+            self.overload.half_open_refused += 1
+            return False
+        return True
+
+    def _settle_half_open(self, client: Client) -> None:
+        if getattr(client, "_half_open", False):
+            client._half_open = False
+            self._half_open -= 1
 
     async def _attach_client(self, client: Client) -> None:
         packet, leftover = await self._read_connect(client)
@@ -297,6 +364,7 @@ class Broker:
         self.info.clients_total += 1
         client.start()
         self._send_connack(client, codes.Success, session_present)
+        self._settle_half_open(client)     # handshake completed
         if session_present:
             client.resend_inflight()
         self.hooks.notify("on_session_established", client, packet)
@@ -921,6 +989,8 @@ class Broker:
             return
         if sub.no_local and packet.origin == client_id:
             return  # v5 NoLocal [MQTT-3.8.3-3]
+        if self._shed_qos0(client, sub, packet):
+            return  # above the high-water mark: QoS0 fan-out shed
         if self._fast_qos0_eligible(client, sub, packet):
             self._send_fast_qos0(client, packet)
             return
@@ -933,12 +1003,46 @@ class Broker:
         if client.closed:
             return  # queued in inflight for session resume
         if not client.send(out):
+            self._count_refused_send(client, out)
+
+    def _shed_qos0(self, client: Client, sub: Subscription,
+                   packet: Packet) -> bool:
+        """Global load-shed (ADR 012): while above the high-water mark
+        effective-QoS0 fan-out is shed outright; QoS>0 continues on the
+        session/inflight rules."""
+        if (not self.overload.shedding or client.closed
+                or min(packet.fixed.qos, sub.qos,
+                       self.capabilities.maximum_qos) > 0):
+            return False
+        self.overload.shed_messages += 1
+        self.info.messages_dropped += 1
+        client.note_drop("shed")
+        return True
+
+    def _count_refused_send(self, client: Client, out: Packet) -> None:
+        """A delivery the outbound queue/byte budget refused. QoS>0 is
+        rolled back so it neither leaks send quota nor leaves a stale
+        inflight entry, and counts under its own reason — not the
+        generic messages_dropped (docs/migration.md, round 8)."""
+        self.hooks.notify("on_publish_dropped", client, out)
+        if out.fixed.qos > 0:
+            self._rollback_refused_qos(client, out)
+        else:
             self.info.messages_dropped += 1
-            self.hooks.notify("on_publish_dropped", client, out)
-            if out.fixed.qos > 0:
-                client.inflight.delete(out.packet_id)
-                client.inflight.return_send_quota()
-                self.info.inflight -= 1
+
+    def _rollback_refused_qos(self, client: Client, out: Packet,
+                              release_held: bool = True) -> None:
+        """The one QoS>0 rollback invariant (ADR 012): a refused
+        delivery leaks nothing — inflight entry dropped, send quota
+        returned, counted under qos_drops — and the freed quota is
+        offered to any PARKED message, which would otherwise wedge in
+        held_pids waiting for an ack that can never come."""
+        self.overload.qos_drops += 1
+        client.inflight.delete(out.packet_id)
+        client.inflight.return_send_quota()
+        self.info.inflight -= 1
+        if release_held:
+            self._release_held(client)
 
     def _build_outbound(self, client: Client, sub: Subscription,
                         packet: Packet) -> Packet:
@@ -1007,7 +1111,12 @@ class Broker:
             out = held.copy()
             self.hooks.notify("on_qos_publish", client, out, time.time(), 0)
             if not client.closed and not client.send(out):
-                self.info.messages_dropped += 1
+                # roll back the whole release: keeping the inflight
+                # entry while the quota stayed taken (the pre-ADR-012
+                # behavior) leaked quota and wedged a stale entry.
+                # release_held=False: the enclosing loop IS the drain.
+                self._rollback_refused_qos(client, out,
+                                           release_held=False)
                 self.hooks.notify("on_publish_dropped", client, out)
 
     def _process_puback(self, client: Client, packet: Packet) -> None:
@@ -1138,6 +1247,18 @@ class Broker:
             return
         if sub.retain_handling == 1 and existing:
             return
+        if self.overload.shedding:
+            # above the high-water mark retained bursts are deferred,
+            # not dropped: housekeeping re-runs this delivery once the
+            # broker recovers below the low-water mark (ADR 012)
+            if (client.id, sub.filter) not in self._deferred_retained:
+                self.overload.deferred_retained += 1
+            self._deferred_retained[(client.id, sub.filter)] = \
+                (sub, existing)
+            return
+        # delivering now satisfies any parked deferral for this pair —
+        # a stale entry would double-deliver at the next drain tick
+        self._deferred_retained.pop((client.id, sub.filter), None)
         now = time.time()
         maxexp = self.capabilities.maximum_message_expiry_interval
         for msg in self.topics.retained_for(sub.filter):
@@ -1170,6 +1291,10 @@ class Broker:
                 return
         if client.send(out):
             self.hooks.notify("on_retain_published", client, out)
+        elif out.fixed.qos > 0:
+            # refused retained delivery: same no-leak rollback as
+            # _count_refused_send (ADR 012)
+            self._rollback_refused_qos(client, out)
 
     def _process_unsubscribe(self, client: Client, packet: Packet) -> None:
         packet = self.hooks.modify("on_unsubscribe", packet, client)
@@ -1276,8 +1401,65 @@ class Broker:
                 self._check_will_delays(now)
                 self._check_expired_retained(now)
                 self._check_expired_inflight(now)
+                self._check_stalled_writers(mono)
+                self._check_overload_recovery()
         except asyncio.CancelledError:
             pass
+
+    def _check_stalled_writers(self, mono: float) -> None:
+        """Slow-consumer policy (ADR 012): a connected client whose
+        writer made no progress past the stall deadline while work is
+        queued — or whose writer died outright — is disconnected with
+        v5 QuotaExceeded/ServerBusy instead of eating drops forever.
+        The whole rung is off at stall_deadline_ms = 0, dead-writer
+        reaping included (the 'disabled by a zero' contract)."""
+        deadline = self.capabilities.stall_deadline_ms / 1000.0
+        if deadline <= 0:
+            return
+        for client in self.clients.connected():
+            dead = client.write_error is not None
+            stalled = (client.outbound.bytes > 0
+                       and mono - client.write_progress > deadline)
+            if not (dead or stalled):
+                continue
+            self.overload.stalled_disconnects += 1
+            client.note_drop("stall")
+            code = (codes.ErrServerBusy if dead
+                    else codes.ErrQuotaExceeded)
+            self.disconnect_client(client, code)
+            self._spawn(client.stop(ProtocolError(code)), "stall-stop")
+
+    def _check_overload_recovery(self) -> None:
+        """Watermark hysteresis backstop + deferred-retained drain: the
+        inline note_get path flips shedding off as queues drain, but a
+        broker whose queues were released wholesale (client teardown)
+        or idled must still recover and deliver parked retained."""
+        over = self.overload
+        if over.shedding and over.below_low_water():
+            over.shedding = False
+            over.recoveries += 1
+        if over.shedding or not self._deferred_retained:
+            return
+        for key in list(self._deferred_retained):
+            if over.shedding:
+                return  # a drained delivery re-entered shedding: stop
+            entry = self._deferred_retained.pop(key, None)
+            if entry is None:
+                continue
+            sub, existing = entry
+            cid, filt = key
+            client = self.clients.get(cid)
+            if client is None or filt not in client.subscriptions:
+                continue    # session purged or unsubscribed: drop it
+            if client.closed:
+                # persistent session offline at drain time: keep the
+                # delivery parked (no recount) — a resumed session
+                # never re-sends SUBSCRIBE, so discarding here would
+                # lose the retained message permanently; the entry
+                # dies with the session
+                self._deferred_retained[key] = entry
+                continue
+            self._publish_retained_to(client, sub, existing)
 
     def _check_keepalives(self, mono: float) -> None:
         grace = self.capabilities.keepalive_grace
@@ -1358,13 +1540,19 @@ class Broker:
         if not maximum:
             return
         for client in self.clients.all():
+            expired = 0
             for packet in client.inflight.all():
                 if packet.created > 0 and now > packet.created + maximum:
                     if client.inflight.delete(packet.packet_id):
                         self.info.inflight -= 1
                         self.info.inflight_dropped += 1
                         client.inflight.return_send_quota()
+                        expired += 1
                         self.hooks.notify("on_qos_dropped", client, packet)
+            if expired and not client.closed:
+                # the returned quota must reach parked messages: with
+                # nothing left inflight no ack will ever drain held_pids
+                self._release_held(client)
 
     async def _sys_topic_loop(self) -> None:
         try:
@@ -1410,6 +1598,7 @@ class Broker:
             "$SYS/broker/system/memory": info.memory_alloc,
             "$SYS/broker/system/threads": info.threads,
         }
+        entries.update(self._sys_overload_entries())
         for topic, value in entries.items():
             packet = Packet(fixed=FixedHeader(type=PT.PUBLISH, retain=True),
                             topic=topic, payload=str(value).encode(),
@@ -1418,6 +1607,29 @@ class Broker:
             if self.loop is not None:
                 self._spawn(self.publish_to_subscribers(packet),
                             "sys-fanout")
+
+    def _sys_overload_entries(self) -> dict:
+        """The ADR-012 overload ladder's $SYS subtree, incl. the bounded
+        top-offender report under $SYS/broker/clients/."""
+        import json
+        over = self.overload
+        return {
+            "$SYS/broker/overload/queued_bytes": over.queued_bytes,
+            "$SYS/broker/overload/shedding": int(over.shedding),
+            "$SYS/broker/overload/sheds": over.sheds,
+            "$SYS/broker/overload/recoveries": over.recoveries,
+            "$SYS/broker/overload/shed_messages": over.shed_messages,
+            "$SYS/broker/overload/budget_drops": over.budget_drops,
+            "$SYS/broker/overload/deferred_retained":
+                over.deferred_retained,
+            "$SYS/broker/overload/connects_refused":
+                over.connects_refused + over.half_open_refused,
+            "$SYS/broker/overload/stalled_disconnects":
+                over.stalled_disconnects,
+            "$SYS/broker/messages/qos_dropped": over.qos_drops,
+            "$SYS/broker/clients/top_dropped":
+                json.dumps(top_offenders(self.clients.all())),
+        }
 
     # ------------------------------------------------------------------
     # Persistence restore (v2/server.go:1297-1434)

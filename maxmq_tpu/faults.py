@@ -63,6 +63,8 @@ DEVICE_MATCH = "device.match"          # engine device-batch entry points
 DEVICE_RECOMPILE = "device.recompile"  # engine refresh()/table compile
 SERVICE_SOCKET = "service.socket"      # matcher-service client connection
 POOL_WORKER = "pool.worker"            # delivery-pool worker process
+CLIENT_WRITE = "client.write"          # broker client writer loop (ADR 012)
+LISTENER_ACCEPT = "listener.accept"    # broker connection accept (ADR 012)
 
 
 class _Spec:
@@ -107,6 +109,12 @@ class FaultRegistry:
     def armed(self, site: str) -> bool:
         return site in self._specs
 
+    def any_armed(self) -> bool:
+        """True when ANY site is armed — the cheap hot-path guard loop
+        code uses before paying a keyed fire_detail lookup (broker
+        writer loop: one call per written packet when idle)."""
+        return bool(self._specs)
+
     def arm_from_spec(self, spec: str) -> None:
         """Parse a ``MAXMQ_FAULTS``-style csv and arm each entry."""
         for entry in spec.split(","):
@@ -124,18 +132,14 @@ class FaultRegistry:
 
     # -- firing (the production-code side) -----------------------------
 
-    def fire(self, site: str) -> bool:
-        """Trip ``site`` if armed. ``raise`` mode raises InjectedFault,
-        ``hang`` sleeps ``delay_s`` then returns True; any other mode
-        returns True and the call site acts. Returns False when the site
-        is not armed (the hot-path common case: one dict membership test
-        on an empty dict)."""
+    def _take(self, site: str) -> _Spec | None:
+        """Pop (and count) the next armed spec for ``site``, or None."""
         if site not in self._specs:       # racy-but-safe fast path
-            return False
+            return None
         with self._lock:
             queue = self._specs.get(site)
             if not queue:
-                return False
+                return None
             spec = queue[0]
             if spec.remaining > 0:
                 spec.remaining -= 1
@@ -144,11 +148,41 @@ class FaultRegistry:
                     if not queue:
                         del self._specs[site]
             self.fired[site] = self.fired.get(site, 0) + 1
+        return spec
+
+    def fire(self, site: str) -> bool:
+        """Trip ``site`` if armed. ``raise`` mode raises InjectedFault,
+        ``hang`` sleeps ``delay_s`` then returns True; any other mode
+        returns True and the call site acts. Returns False when the site
+        is not armed (the hot-path common case: one dict membership test
+        on an empty dict)."""
+        spec = self._take(site)
+        if spec is None:
+            return False
         if spec.mode == "raise":
             raise InjectedFault(f"injected fault at {site}")
         if spec.mode == "hang":
             time.sleep(spec.delay_s)
         return True
+
+    def fire_detail(self, site: str,
+                    key: str | None = None) -> tuple[str, float] | None:
+        """Keyed, async-friendly firing for loop-thread sites (ADR 012).
+
+        Tries the instance-scoped arming ``site#key`` first (e.g.
+        ``client.write#slow-sub`` stalls ONE client's writer), then the
+        plain site. ``raise`` mode raises as :meth:`fire` does; every
+        other mode returns ``(mode, delay_s)`` and the CALL SITE acts —
+        an asyncio call site must ``await asyncio.sleep(delay_s)`` for
+        ``hang`` rather than let the registry block the event loop."""
+        spec = self._take(f"{site}#{key}") if key else None
+        if spec is None:
+            spec = self._take(site)
+        if spec is None:
+            return None
+        if spec.mode == "raise":
+            raise InjectedFault(f"injected fault at {site}")
+        return spec.mode, spec.delay_s
 
 
 REGISTRY = FaultRegistry()
@@ -158,7 +192,9 @@ arm = REGISTRY.arm
 disarm = REGISTRY.disarm
 clear = REGISTRY.clear
 armed = REGISTRY.armed
+any_armed = REGISTRY.any_armed
 fire = REGISTRY.fire
+fire_detail = REGISTRY.fire_detail
 arm_from_spec = REGISTRY.arm_from_spec
 
 # env arming: subprocess pool workers and bench's degraded-mode runs

@@ -24,19 +24,24 @@ from .utils.logger import Logger
 
 
 class Metric:
-    """A function-backed metric: value is read at scrape time."""
+    """A function-backed metric: value is read at scrape time. With
+    ``multi`` the fn returns an iterable of (labels_dict, value) pairs —
+    one metric family whose series set is computed per scrape (used for
+    the cardinality-bounded per-client overload offenders, ADR 012)."""
 
-    __slots__ = ("name", "kind", "help", "fn", "labels")
+    __slots__ = ("name", "kind", "help", "fn", "labels", "multi")
 
     def __init__(self, name: str, kind: str, help_: str,
                  fn: Callable[[], float],
-                 labels: dict[str, str] | None = None) -> None:
+                 labels: dict[str, str] | None = None,
+                 multi: bool = False) -> None:
         assert kind in ("counter", "gauge")
         self.name = name
         self.kind = kind
         self.help = help_
         self.fn = fn
         self.labels = labels or {}
+        self.multi = multi
 
 
 class Registry:
@@ -56,6 +61,13 @@ class Registry:
         with self._lock:
             self._metrics.append(Metric(name, "counter", help_, fn, labels))
 
+    def multi_func(self, name: str, kind: str, help_: str, fn) -> None:
+        """A family whose series are computed at scrape time: ``fn``
+        returns an iterable of (labels_dict, value). The fn owns the
+        cardinality bound (callers document it)."""
+        with self._lock:
+            self._metrics.append(Metric(name, kind, help_, fn, multi=True))
+
     def expose(self) -> str:
         with self._lock:
             metrics = list(self._metrics)
@@ -66,13 +78,21 @@ class Registry:
                 out.append(f"# HELP {m.name} {m.help}")
                 out.append(f"# TYPE {m.name} {m.kind}")
                 seen_header.add(m.name)
+            if m.multi:
+                try:
+                    series = list(m.fn())
+                except Exception:
+                    continue
+                for labels, value in series:
+                    out.append(f"{m.name}{{{_lbl(labels)}}} "
+                               f"{_fmt(float(value))}")
+                continue
             try:
                 value = float(m.fn())
             except Exception:
                 continue
             if m.labels:
-                lbl = ",".join(f'{k}="{v}"' for k, v in m.labels.items())
-                out.append(f"{m.name}{{{lbl}}} {_fmt(value)}")
+                out.append(f"{m.name}{{{_lbl(m.labels)}}} {_fmt(value)}")
             else:
                 out.append(f"{m.name} {_fmt(value)}")
         return "\n".join(out) + "\n"
@@ -80,6 +100,17 @@ class Registry:
 
 def _fmt(v: float) -> str:
     return str(int(v)) if v == int(v) else repr(v)
+
+
+def _lbl(labels: dict) -> str:
+    """Render a label set with Prometheus text-format escaping: label
+    values here include CLIENT-CHOSEN ids (the per-client offender
+    family), and one embedded quote/backslash/newline must corrupt one
+    label value, not the whole exposition page."""
+    def esc(v) -> str:
+        return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+                .replace("\n", "\\n"))
+    return ",".join(f'{k}="{esc(v)}"' for k, v in labels.items())
 
 
 def _dump_threads() -> str:
@@ -248,6 +279,58 @@ def register_broker_metrics(registry: Registry, broker) -> None:
                             lambda n=name: getattr(info, n))
     # matcher-side metrics (TPU path; no reference equivalent)
     _register_matcher_metrics(registry, broker)
+    # host-path overload ladder (ADR 012)
+    _register_overload_metrics(registry, broker)
+
+
+def _register_overload_metrics(registry: Registry, broker) -> None:
+    """ADR-012 overload-ladder observability: the global byte ledger +
+    watermark state, every ladder counter, and the cardinality-bounded
+    per-client top-offender family (at most overload.TOP_OFFENDERS
+    series per scrape; see docs/adr/012-overload-protection.md)."""
+    over = getattr(broker, "overload", None)
+    if over is None:
+        return
+    from .broker.overload import top_offenders
+    registry.gauge_func(
+        "maxmq_broker_overload_queued_bytes",
+        "Wire bytes queued across all client outbound queues",
+        lambda: over.queued_bytes)
+    registry.gauge_func(
+        "maxmq_broker_overload_shedding",
+        "1 while above the high-water mark (QoS0 fan-out shed, "
+        "retained delivery deferred)",
+        lambda: int(over.shedding))
+    for name, help_ in (
+            ("sheds", "Entries into the load-shedding regime"),
+            ("recoveries", "Exits back below the low-water mark"),
+            ("shed_messages", "QoS0 deliveries dropped while shedding"),
+            ("budget_drops",
+             "Deliveries dropped by the per-client/global byte budgets "
+             "(oldest-first QoS0 shed + refused new deliveries)"),
+            ("qos_drops",
+             "QoS>0 deliveries refused by a full queue and rolled back "
+             "(quota returned, inflight entry removed)"),
+            ("deferred_retained",
+             "Retained deliveries deferred to recovery by shedding"),
+            ("stalled_disconnects",
+             "Clients disconnected by the writer stall deadline")):
+        registry.counter_func(f"maxmq_broker_overload_{name}_total",
+                              help_, lambda n=name: getattr(over, n))
+    for reason, attr in (("rate", "connects_refused"),
+                         ("half_open", "half_open_refused")):
+        registry.counter_func(
+            "maxmq_broker_overload_connects_refused_total",
+            "Connections refused by admission control, by reason",
+            lambda a=attr: getattr(over, a), labels={"reason": reason})
+    registry.multi_func(
+        "maxmq_broker_client_dropped_messages_total", "counter",
+        "Deliveries dropped by a client's own backpressure (queue/byte "
+        "budget, stalls; global watermark sheds excluded), top "
+        "offenders only (cardinality bounded to overload.TOP_OFFENDERS "
+        "series)",
+        lambda: [({"client": row["client"]}, row["dropped"])
+                 for row in top_offenders(broker.clients.all())])
 
 
 def _register_matcher_metrics(registry: Registry, broker) -> None:

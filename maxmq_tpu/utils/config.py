@@ -65,6 +65,18 @@ class Config:
     mqtt_max_outbound_queue: int = 1024
     mqtt_sys_topic_interval: int = 1    # seconds between $SYS refreshes
 
+    # -- broker overload-protection ladder (ADR 012) -------------------------
+    # per-client queued outbound wire bytes; oldest QoS0 deliveries are
+    # shed first, then new deliveries refuse. 0 = count cap only.
+    broker_client_byte_budget: int = 8 << 20
+    broker_byte_budget: int = 0         # global queued-byte budget; 0 = off
+    connect_rate: float = 0.0           # CONNECT admissions/sec/listener
+    connect_burst: int = 0              # bucket depth; 0 = max(1, rate)
+    connect_half_open_max: int = 0      # cap on handshakes awaiting CONNECT
+    stall_deadline_ms: int = 60_000     # writer no-progress disconnect; 0 off
+    broker_overload_high_water: float = 0.8   # shed above budget * high
+    broker_overload_low_water: float = 0.5    # recover below budget * low
+
     # -- persistence --------------------------------------------------------
     storage_backend: str = ""           # "" | memory | sqlite
     storage_path: str = "maxmq.db"

@@ -1,0 +1,539 @@
+"""ADR-012 overload-protection ladder suite: byte-accounted outbound
+queues, oldest-first QoS0 shedding, the writer stall deadline, CONNECT
+admission control (token bucket + half-open cap), global load-shed
+watermarks with recovery, and the QoS>0 queue-full rollback fixes — all
+driven deterministically through the fault registry (``client.write`` /
+``listener.accept`` sites) against a real broker on a real TCP socket.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from test_broker_system import connect, running_broker
+
+from maxmq_tpu import faults
+from maxmq_tpu.broker.client import OutboundQueue
+from maxmq_tpu.broker.overload import TokenBucket, top_offenders
+from maxmq_tpu.metrics import Registry, register_broker_metrics
+from maxmq_tpu.mqtt_client import MQTTError
+from maxmq_tpu.protocol.codec import PacketType as PT
+from maxmq_tpu.protocol.packets import Packet
+from maxmq_tpu.protocol.codec import FixedHeader
+
+CONNECT_REFUSED = (MQTTError, ConnectionError, OSError,
+                   asyncio.TimeoutError, asyncio.IncompleteReadError)
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+async def try_connect(broker, client_id: str, version: int = 4):
+    """connect() with a short handshake deadline: admission-control
+    tests expect the refused socket to surface quickly."""
+    from maxmq_tpu.mqtt_client import MQTTClient
+    c = MQTTClient(client_id=client_id, version=version)
+    await c.connect("127.0.0.1", broker.test_port, timeout=2.0)
+    return c
+
+
+def stall_writer(client_id: str, delay_s: float = 30.0,
+                 count: int = -1) -> None:
+    """Deterministically stall ONE client's writer via the keyed
+    client.write fault site (hang mode = awaited sleep in the loop)."""
+    faults.arm(f"{faults.CLIENT_WRITE}#{client_id}", "hang",
+               count=count, delay_s=delay_s)
+
+
+async def poll(predicate, timeout: float = 5.0, what: str = ""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        await asyncio.sleep(0.05)
+    raise AssertionError(f"condition not reached in {timeout}s: {what}")
+
+
+# -- units: token bucket + byte-accounted queue ------------------------
+
+
+def test_token_bucket_burst_and_refill():
+    bucket = TokenBucket(rate=10.0, burst=2)
+    t0 = time.monotonic()
+    assert bucket.allow(t0) and bucket.allow(t0)
+    assert not bucket.allow(t0)            # burst exhausted
+    # +0.15s at 10/s refills 1.5 tokens: the margin keeps the assert
+    # robust to float rounding of (t0 + dt) - t0 at large t0
+    assert bucket.allow(t0 + 0.15)         # a token refilled
+    assert not bucket.allow(t0 + 0.15)     # only ~0.5 left
+    assert TokenBucket(rate=0.0).allow()   # rate 0 = unlimited
+
+
+def _pub0_wire(payload: bytes) -> bytes:
+    return bytes([0x30, len(payload) + 5, 0, 3]) + b"t/x" + payload
+
+
+def test_outbound_queue_drops_oldest_qos0_only():
+    q = OutboundQueue(maxsize=16)
+    ack = bytes((PT.PUBACK << 4, 2, 0, 1))          # never droppable
+    qos1 = Packet(fixed=FixedHeader(type=PT.PUBLISH, qos=1),
+                  topic="t/x", payload=b"keep", packet_id=7)
+    q.put_nowait(_pub0_wire(b"old1"), 9)
+    q.put_nowait(ack, 4)
+    q.put_nowait(qos1, 40)
+    q.put_nowait(_pub0_wire(b"old2"), 9)
+    q.put_nowait(_pub0_wire(b"new"), 8)
+    assert q.bytes == 70
+    dropped, freed = q.drop_oldest_qos0(15)
+    assert dropped == [_pub0_wire(b"old1"), _pub0_wire(b"old2")]
+    assert freed == 18
+    assert q.bytes == 52
+    # survivors keep their order: ack, qos1 publish, newest qos0
+    assert q.get_nowait() == ack
+    assert q.get_nowait() is qos1
+    assert q.get_nowait() == _pub0_wire(b"new")
+    assert q.bytes == 0
+    # an all-protected queue frees nothing
+    q.put_nowait(qos1, 40)
+    assert q.drop_oldest_qos0(100) == ([], 0)
+
+
+def test_top_offenders_is_bounded_and_sorted():
+    class C:
+        def __init__(self, cid, n, shed=0):
+            self.id, self.dropped_msgs, self.dropped_bytes = cid, n, n * 10
+            self.drops_by_reason = {"shed": shed} if shed else {}
+    clients = [C(f"c{i}", i) for i in range(20)]
+    # a healthy client hit only by GLOBAL sheds must not outrank (or
+    # even appear above) the slow consumers that caused the overload
+    clients.append(C("victim", 100, shed=100))
+    rows = top_offenders(clients)
+    assert len(rows) == 8                           # cardinality bound
+    assert rows[0]["client"] == "c19" and rows[0]["dropped"] == 19
+    assert [r["dropped"] for r in rows] == sorted(
+        (r["dropped"] for r in rows), reverse=True)
+    assert all(r["client"] != "victim" for r in rows)
+
+
+# -- admission control -------------------------------------------------
+
+
+async def test_connect_storm_token_bucket_refuses_sockets():
+    async with running_broker(connect_rate=0.001,
+                              connect_burst=2) as broker:
+        c1 = await connect(broker, "a")
+        c2 = await connect(broker, "b")
+        for i in range(3):                  # bucket exhausted: refused
+            with pytest.raises(CONNECT_REFUSED):
+                await try_connect(broker, f"storm{i}")
+        assert broker.overload.connects_refused >= 3
+        await c1.ping()                     # admitted clients unharmed
+        await c1.disconnect()
+        await c2.disconnect()
+
+
+async def test_half_open_handshake_cap():
+    async with running_broker(connect_half_open_max=1) as broker:
+        # a socket that never sends CONNECT occupies the only slot
+        _r, w = await asyncio.open_connection("127.0.0.1",
+                                              broker.test_port)
+        await asyncio.sleep(0.1)
+        with pytest.raises(CONNECT_REFUSED):
+            await try_connect(broker, "x")
+        assert broker.overload.half_open_refused >= 1
+        w.close()
+        await asyncio.sleep(0.2)            # slot settles on EOF
+        c = await connect(broker, "y")      # admitted again
+        await c.disconnect()
+
+
+async def test_listener_accept_fault_refuses_socket():
+    async with running_broker() as broker:
+        faults.arm(faults.LISTENER_ACCEPT, "raise", count=1)
+        with pytest.raises(CONNECT_REFUSED):
+            await try_connect(broker, "nope")
+        assert broker.overload.connects_refused == 1
+        c = await connect(broker, "yep")    # fault self-disarmed
+        await c.disconnect()
+
+
+# -- slow-consumer policy: byte budget + stall deadline ----------------
+
+
+async def test_byte_budget_sheds_oldest_keeps_newest():
+    async with running_broker(client_byte_budget=2048) as broker:
+        slow = await connect(broker, "slow")
+        await slow.subscribe("fire/#")
+        stall_writer("slow", delay_s=0.15)
+        pub = await connect(broker, "pub")
+        for i in range(10):
+            await pub.publish("fire/x", b"%02d" % i + b"z" * 400)
+        cl = broker.clients.get("slow")
+        await poll(lambda: cl.drops_by_reason.get("byte_budget", 0) > 0,
+                   what="byte-budget drops recorded")
+        assert broker.overload.budget_drops > 0
+        assert broker.info.messages_dropped > 0
+        assert cl.dropped_bytes > 0
+        # oldest-first: the NEWEST message survives the shed and lands
+        got = []
+        while True:
+            try:
+                got.append(await slow.next_message(timeout=3.0))
+            except asyncio.TimeoutError:
+                break
+        assert got and got[-1].payload.startswith(b"09")
+        assert len(got) < 10                # and some were truly shed
+        await pub.disconnect()
+        await slow.disconnect()
+
+
+async def test_stalled_writer_disconnected_with_quota_exceeded():
+    async with running_broker(stall_deadline_ms=300) as broker:
+        healthy = await connect(broker, "healthy")
+        slow = await connect(broker, "slow", version=5)
+        await slow.subscribe("s/#")
+        cl = broker.clients.get("slow")
+        stall_writer("slow", delay_s=30.0)
+        pub = await connect(broker, "pub")
+        for _ in range(4):
+            await pub.publish("s/t", b"x" * 64)
+        await slow.wait_closed(timeout=5)
+        assert slow.disconnect_packet is not None
+        assert slow.disconnect_packet.reason_code == 0x97  # QuotaExceeded
+        assert broker.overload.stalled_disconnects == 1
+        assert cl.drops_by_reason.get("stall") == 1
+        await healthy.ping()                # broker live throughout
+        await healthy.disconnect()
+        await pub.disconnect()
+
+
+async def test_burst_cap_keeps_wedged_backlog_accounted():
+    """The writer's greedy burst is byte-capped: a consumer whose
+    transport never drains must keep its backlog in the ACCOUNTED
+    queue (visible to the stall detector and global watermarks), not
+    silently de-accounted into the transport buffer."""
+    from maxmq_tpu.broker import Broker, BrokerOptions, Capabilities
+    from maxmq_tpu.broker.client import Client
+
+    broker = Broker(BrokerOptions(
+        capabilities=Capabilities(sys_topic_interval=0)))
+
+    class BlockedWriter:
+        def write(self, data): pass
+        async def drain(self): await asyncio.Event().wait()
+        def close(self): pass
+        def get_extra_info(self, name, default=None): return default
+
+    cl = Client(broker, None, BlockedWriter())
+    cl.id = "wedge"
+    wire = bytes([0x30, 0x7F]) + b"x" * 30000   # QoS0 PUBLISH-typed
+    for _ in range(5):
+        assert cl.send_wire(wire)
+    assert broker.overload.queued_bytes == 5 * len(wire)
+    cl.start()
+    await asyncio.sleep(0.2)
+    # the burst stopped at BURST_BYTES and parked on drain(): the
+    # remaining backlog is still on both byte ledgers
+    assert cl.outbound.bytes == 2 * len(wire)
+    assert broker.overload.queued_bytes == cl.outbound.bytes
+    cl._writer_task.cancel()
+
+
+async def test_dead_writer_recorded_not_silently_swallowed():
+    """Satellite: _drain used to swallow ConnectionError silently —
+    the failure must be recorded so the stall detector acts on it."""
+    async with running_broker(stall_deadline_ms=10_000) as broker:
+        c = await connect(broker, "w")
+        cl = broker.clients.get("w")
+
+        class DeadWriter:
+            """Delegates to the real transport but fails every drain —
+            a peer whose receive direction died under the broker."""
+            def __init__(self, real): self._real = real
+            def __getattr__(self, name): return getattr(self._real, name)
+            async def drain(self): raise ConnectionResetError("peer gone")
+        cl.writer = DeadWriter(cl.writer)
+        await cl._drain()
+        assert cl.write_error and "peer gone" in cl.write_error
+        # housekeeping treats a dead writer as an immediate stall even
+        # far below the 10s no-progress deadline
+        await poll(lambda: broker.overload.stalled_disconnects == 1,
+                   what="dead writer disconnected")
+        await c.wait_closed(timeout=5)
+
+
+# -- QoS>0 queue-full rollback (the send()-False leak fixes) -----------
+
+
+async def test_qos_drop_rolls_back_quota_and_inflight():
+    async with running_broker() as broker:
+        s = await connect(broker, "s1")
+        await s.subscribe(("q/#", 1))
+        cl = broker.clients.get("s1")
+        cl.send = lambda packet: False      # every delivery refused
+        p = await connect(broker, "p1")
+        await p.publish("q/a", b"x", qos=1)
+        await poll(lambda: broker.overload.qos_drops == 1,
+                   what="qos_drop counted")
+        assert len(cl.inflight) == 0        # no stale inflight entry
+        assert cl.inflight.send_quota == cl.inflight.maximum_send
+        assert broker.info.inflight == 0
+        assert broker.info.messages_dropped == 0   # distinct reason
+        await p.disconnect()
+        await s.disconnect()
+
+
+async def test_release_held_drop_rolls_back():
+    async with running_broker(receive_maximum=1) as broker:
+        s = await connect(broker, "s1")
+        await s.subscribe(("h/#", 1))
+        cl = broker.clients.get("s1")
+        stall_writer("s1", delay_s=0.3, count=1)
+        p = await connect(broker, "p1")
+        await p.publish("h/1", b"m1", qos=1)   # takes the only quota slot
+        await p.publish("h/2", b"m2", qos=1)   # parks on held_pids
+        await poll(lambda: len(cl.held_pids) == 1, what="m2 parked")
+        cl.send = lambda packet: False      # refuse the held release
+        msg = await s.next_message(timeout=5)  # m1 lands; client PUBACKs
+        assert msg.payload == b"m1"
+        await poll(lambda: broker.overload.qos_drops == 1,
+                   what="held release rolled back")
+        assert len(cl.inflight) == 0
+        assert cl.inflight.send_quota == 1  # quota returned
+        assert not cl.held_pids
+        await p.disconnect()
+        await s.disconnect()
+
+
+# -- keepalive + takeover under a wedged outbound path -----------------
+
+
+async def test_keepalive_enforced_while_writer_stalled():
+    async with running_broker(keepalive_grace=0.2,
+                              stall_deadline_ms=0) as broker:
+        c = await connect(broker, "ka", keepalive=1)
+        await c.subscribe("ka/#")
+        stall_writer("ka", delay_s=30.0)
+        p = await connect(broker, "pub", keepalive=0)
+        await p.publish("ka/t", b"wedge")
+        # no PINGREQ from "ka": the keepalive deadline still fires even
+        # though its writer is wedged mid-delivery
+        await c.wait_closed(timeout=5)
+        await poll(lambda: broker.clients.get("ka") is None
+                   or broker.clients.get("ka").closed,
+                   what="keepalive closed the stalled client")
+        await p.disconnect()
+
+
+async def test_takeover_with_full_outbound_resends_inflight_only():
+    """Session takeover while the old connection's outbound queue is
+    full: resume must re-deliver what is in INFLIGHT, not the overflow
+    the budget refused (which was rolled back, not left half-queued)."""
+    async with running_broker(client_byte_budget=600) as broker:
+        c1 = await connect(broker, "tk", clean_start=False)
+        await c1.subscribe(("tk/#", 1))
+        stall_writer("tk", delay_s=30.0)
+        pub = await connect(broker, "pub")
+        for i in range(6):
+            await pub.publish("tk/t", b"m%d" % i + b"f" * 180, qos=1)
+        cl = broker.clients.get("tk")
+        await poll(lambda: broker.overload.qos_drops > 0,
+                   what="overflow rolled back")
+        kept = {p.payload[:2] for p in cl.inflight.all()}
+        assert 0 < len(kept) < 6
+        faults.disarm(f"{faults.CLIENT_WRITE}#tk")   # new writer healthy
+        dropped = {b"m%d" % i for i in range(6)} - kept
+
+        async def drain_resumed(c):
+            got = set()
+            while True:
+                try:
+                    got.add((await c.next_message(timeout=1.0)).payload[:2])
+                except asyncio.TimeoutError:
+                    return got
+
+        c2 = await connect(broker, "tk", clean_start=False)
+        assert c2.connack.session_present
+        got = await drain_resumed(c2)
+        # only inflight is redelivered — never the rolled-back overflow
+        assert got and got <= kept and not (got & dropped)
+        # whatever the resend burst's own budget deferred stays inflight
+        # and lands on the NEXT resume (it was parked, not lost)
+        remaining = kept - got
+        await c2.disconnect()
+        if remaining:
+            c3 = await connect(broker, "tk", clean_start=False)
+            got2 = await drain_resumed(c3)
+            assert remaining <= got2 and not (got2 & dropped)
+            await c3.disconnect()
+        await pub.disconnect()
+
+
+# -- global watermarks: shed, defer retained, recover ------------------
+
+
+async def test_load_shed_watermarks_defer_retained_and_recover():
+    async with running_broker(broker_byte_budget=4096,
+                              overload_high_water=0.5,
+                              overload_low_water=0.25,
+                              stall_deadline_ms=0) as broker:
+        slow = await connect(broker, "slow")
+        await slow.subscribe("fire/#")
+        stall_writer("slow", delay_s=30.0)
+        healthy = await connect(broker, "healthy")
+        await healthy.subscribe("live/#")
+        pub = await connect(broker, "pub")
+        await pub.publish("ret/1", b"parked", retain=True)
+        for _ in range(8):                  # cross the high-water mark
+            await pub.publish("fire/x", b"z" * 600)
+        await poll(lambda: broker.overload.shedding,
+                   what="high water entered shedding")
+        assert broker.overload.sheds == 1
+        # shedding: QoS0 fan-out to the HEALTHY subscriber is shed too
+        await pub.publish("live/a", b"shed-me")
+        with pytest.raises(asyncio.TimeoutError):
+            await healthy.next_message(timeout=0.3)
+        assert broker.overload.shed_messages >= 1
+        # retained delivery defers instead of piling on
+        await healthy.subscribe("ret/#")
+        assert broker.overload.deferred_retained == 1
+        with pytest.raises(asyncio.TimeoutError):
+            await healthy.next_message(timeout=0.3)
+        # the slow consumer goes away: its queued bytes release and the
+        # broker recovers below the low-water mark
+        await slow.close()
+        await poll(lambda: not broker.overload.shedding,
+                   what="recovered below low water")
+        assert broker.overload.recoveries >= 1
+        # deferred retained lands after recovery (housekeeping drain)
+        msg = await healthy.next_message(timeout=5)
+        assert (msg.topic, msg.payload, msg.retain) == \
+            ("ret/1", b"parked", True)
+        # and live fan-out flows again
+        await pub.publish("live/b", b"back")
+        assert (await healthy.next_message(timeout=5)).payload == b"back"
+        await pub.disconnect()
+        await healthy.disconnect()
+
+
+async def test_deferred_retained_survives_offline_resume():
+    """A persistent session whose retained delivery was deferred by
+    shedding, then disconnected before recovery, must still get the
+    retained message on resume — a resumed session never re-sends
+    SUBSCRIBE, so a discarded deferral would lose it permanently."""
+    async with running_broker(broker_byte_budget=4096,
+                              overload_high_water=0.5,
+                              overload_low_water=0.25,
+                              stall_deadline_ms=0) as broker:
+        slow = await connect(broker, "slow")
+        await slow.subscribe("fire/#")
+        stall_writer("slow", delay_s=30.0)
+        pub = await connect(broker, "pub")
+        await pub.publish("ret/1", b"parked", retain=True)
+        for _ in range(8):
+            await pub.publish("fire/x", b"z" * 600)
+        await poll(lambda: broker.overload.shedding, what="shedding")
+        durable = await connect(broker, "durable", clean_start=False)
+        await durable.subscribe(("ret/#", 1))
+        assert broker.overload.deferred_retained == 1
+        await durable.close()           # offline before recovery
+        await slow.close()              # wedge releases -> recovery
+        await poll(lambda: not broker.overload.shedding, what="recovery")
+        await asyncio.sleep(1.2)        # a drain tick passes while offline
+        resumed = await connect(broker, "durable", clean_start=False)
+        assert resumed.connack.session_present
+        msg = await resumed.next_message(timeout=5)
+        assert (msg.topic, msg.payload, msg.retain) == \
+            ("ret/1", b"parked", True)
+        await resumed.disconnect()
+        await pub.disconnect()
+
+
+# -- observability -----------------------------------------------------
+
+
+async def test_overload_metrics_and_sys_tree_exposed():
+    async with running_broker(client_byte_budget=512) as broker:
+        reg = Registry()
+        register_broker_metrics(reg, broker)
+        slow = await connect(broker, "offender")
+        await slow.subscribe("m/#")
+        stall_writer("offender", delay_s=30.0)
+        pub = await connect(broker, "pub")
+        for _ in range(6):
+            await pub.publish("m/x", b"y" * 300)
+        cl = broker.clients.get("offender")
+        await poll(lambda: cl.dropped_msgs > 0, what="drops recorded")
+        text = reg.expose()
+        assert "maxmq_broker_overload_queued_bytes" in text
+        assert "maxmq_broker_overload_shedding 0" in text
+        assert "maxmq_broker_overload_budget_drops_total" in text
+        assert "maxmq_broker_overload_qos_drops_total" in text
+        assert ('maxmq_broker_overload_connects_refused_total'
+                '{reason="rate"} 0') in text
+        assert ('maxmq_broker_client_dropped_messages_total'
+                '{client="offender"}') in text
+        sys_entries = broker._sys_overload_entries()
+        assert sys_entries["$SYS/broker/overload/budget_drops"] > 0
+        assert "offender" in \
+            sys_entries["$SYS/broker/clients/top_dropped"]
+        await pub.disconnect()
+
+
+# -- the acceptance bar: the whole ladder, end to end ------------------
+
+
+async def test_overload_ladder_end_to_end():
+    """Stalled subscriber + CONNECT storm: the broker stays live for
+    healthy clients, disconnects the stalled consumer within the stall
+    deadline, sheds at the high-water mark, and recovers below the
+    low-water mark — all visible through maxmq_broker_overload_*."""
+    async with running_broker(broker_byte_budget=4096,
+                              overload_high_water=0.5,
+                              overload_low_water=0.25,
+                              stall_deadline_ms=400,
+                              connect_rate=0.001,
+                              connect_burst=3) as broker:
+        reg = Registry()
+        register_broker_metrics(reg, broker)
+        healthy = await connect(broker, "healthy")     # token 1
+        await healthy.subscribe("live/#")
+        slow = await connect(broker, "slowpoke", version=5)  # token 2
+        await slow.subscribe("firehose/#")
+        stall_writer("slowpoke", delay_s=30.0)
+        pub = await connect(broker, "pub")             # token 3
+        t_stall = time.monotonic()
+        for _ in range(8):
+            await pub.publish("firehose/x", b"z" * 600)
+        await poll(lambda: broker.overload.shedding,
+                   what="shedding at high water")
+        # CONNECT storm: bucket empty, sockets refused outright
+        for i in range(4):
+            with pytest.raises(CONNECT_REFUSED):
+                await try_connect(broker, f"storm{i}")
+        assert broker.overload.connects_refused >= 4
+        await healthy.ping()        # live for healthy clients throughout
+        # stalled consumer disconnected within the deadline (+1s tick)
+        await slow.wait_closed(timeout=5)
+        assert time.monotonic() - t_stall < 5.0
+        assert slow.disconnect_packet.reason_code == 0x97
+        assert broker.overload.stalled_disconnects == 1
+        # its released queue takes the broker below low water
+        await poll(lambda: not broker.overload.shedding,
+                   what="recovery below low water")
+        await pub.publish("live/b", b"recovered")
+        assert (await healthy.next_message(timeout=5)).payload \
+            == b"recovered"
+        text = reg.expose()
+        assert "maxmq_broker_overload_sheds_total 1" in text
+        assert "maxmq_broker_overload_recoveries_total" in text
+        assert "maxmq_broker_overload_stalled_disconnects_total 1" in text
+        assert ('maxmq_broker_overload_connects_refused_total'
+                '{reason="rate"} 4') in text
+        await pub.disconnect()
+        await healthy.disconnect()
