@@ -1436,6 +1436,140 @@ def bench_overload(n_clients: int = 8, msgs: int = 300) -> dict:
     return d
 
 
+def bench_cluster_federation(msgs: int = 400) -> dict:
+    """ADR-013 federation measurement (MAXMQ_BENCH_CONFIGS=cluster):
+    three in-process broker nodes in a line topology A-B-C with real
+    TCP bridge links. Measures publish throughput + mean latency at
+    0/1/2 forwarding hops (publisher at A, subscriber at A/B/C) and
+    the route-convergence time after a node joins — federation's cost
+    and convergence as numbers, not hopes."""
+    import asyncio
+
+    from maxmq_tpu.broker import (Broker, BrokerOptions, Capabilities,
+                                  TCPListener)
+    from maxmq_tpu.cluster import ClusterManager, PeerSpec
+    from maxmq_tpu.hooks import AllowHook
+    from maxmq_tpu.mqtt_client import MQTTClient
+
+    payload = b"f" * 256
+    line = {"A": ["B"], "B": ["A", "C"], "C": ["B"]}
+
+    async def make_node() -> Broker:
+        b = Broker(BrokerOptions(
+            capabilities=Capabilities(sys_topic_interval=0)))
+        b.add_hook(AllowHook())
+        lst = b.add_listener(TCPListener("t", "127.0.0.1:0"))
+        await b.serve()
+        b.test_port = lst._server.sockets[0].getsockname()[1]
+        return b
+
+    async def poll(cond, timeout_s: float) -> float:
+        t0 = time.perf_counter()
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if cond():
+                return time.perf_counter() - t0
+            await asyncio.sleep(0.01)
+        return -1.0
+
+    async def measure(pub, sub, topic: str, n: int) -> dict:
+        while not sub.messages.empty():
+            sub.messages.get_nowait()
+        lat_total = 0.0
+        t0 = time.perf_counter()
+        for _ in range(n):
+            sent = time.perf_counter()
+            await pub.publish(topic, payload)
+            msg = await sub.next_message(timeout=10)
+            lat_total += time.perf_counter() - sent
+            assert msg.payload == payload
+        span = time.perf_counter() - t0
+        return {"msgs_per_sec": round(n / span, 1),
+                "mean_latency_ms": round(lat_total / n * 1e3, 3)}
+
+    async def run() -> dict:
+        brokers = {n: await make_node() for n in line}
+        mgrs = {}
+        for name, peers in line.items():
+            mgr = ClusterManager(
+                brokers[name], name,
+                [PeerSpec(p, "127.0.0.1", brokers[p].test_port)
+                 for p in peers],
+                keepalive=2.0, backoff_initial_s=0.1)
+            brokers[name].attach_cluster(mgr)
+            await mgr.start()
+            mgrs[name] = mgr
+
+        d: dict = {"config": "cluster_federation", "nodes": 3,
+                   "topology": "line A-B-C",
+                   "messages_per_hop_config": msgs}
+        subs = {}
+        for name in line:
+            c = MQTTClient(client_id=f"sub-{name}")
+            await c.connect("127.0.0.1", brokers[name].test_port)
+            await c.subscribe(f"bench/{name}/#")
+            subs[name] = c
+        # convergence: subscriptions just made at B/C must be routable
+        # from A across the mesh (C's filter transits B)
+        conv = await poll(
+            lambda: mgrs["A"].routes.nodes_for("bench/C/x")
+            and mgrs["A"].routes.nodes_for("bench/B/x"), 30.0)
+        d["route_convergence_s"] = round(conv, 3)
+
+        pub = MQTTClient(client_id="pub")
+        await pub.connect("127.0.0.1", brokers["A"].test_port)
+        for hops, target in (("local", "A"), ("hop1", "B"),
+                             ("hop2", "C")):
+            r = await measure(pub, subs[target],
+                              f"bench/{target}/t", msgs)
+            d[f"{hops}_msgs_per_sec"] = r["msgs_per_sec"]
+            d[f"{hops}_mean_latency_ms"] = r["mean_latency_ms"]
+
+        # join convergence: a NEW node D dialing into A, measured from
+        # link start to its routes being visible at C (2 hops away)
+        brokers["D"] = await make_node()
+        sub_d = MQTTClient(client_id="sub-D")
+        await sub_d.connect("127.0.0.1", brokers["D"].test_port)
+        await sub_d.subscribe("bench/D/#")
+        mgr_d = ClusterManager(
+            brokers["D"], "D",
+            [PeerSpec("A", "127.0.0.1", brokers["A"].test_port)],
+            keepalive=2.0, backoff_initial_s=0.1)
+        brokers["D"].attach_cluster(mgr_d)
+        mgrs["A"].add_peer(
+            PeerSpec("D", "127.0.0.1", brokers["D"].test_port))
+        await mgr_d.start()
+        d["join_convergence_s"] = round(await poll(
+            lambda: bool(mgrs["C"].routes.nodes_for("bench/D/x")),
+            30.0), 3)
+
+        d.update(
+            forwards_sent=sum(m.forwards_sent for m in mgrs.values()),
+            forwards_delivered=sum(m.forwards_delivered
+                                   for m in mgrs.values()),
+            loops_dropped=sum(m.loops_dropped for m in mgrs.values()),
+            link_flaps=sum(m.link_flaps for m in mgrs.values()),
+            routes_held_total=sum(m.routes.remote_route_count
+                                  for m in mgrs.values()))
+        for c in list(subs.values()) + [pub, sub_d]:
+            try:
+                await c.disconnect()
+            except Exception:
+                pass
+        for b in brokers.values():
+            await b.close()
+        return d
+
+    d = asyncio.run(run())
+    log(f"[cluster-fed] local={d['local_msgs_per_sec']}/s "
+        f"1hop={d['hop1_msgs_per_sec']}/s "
+        f"2hop={d['hop2_msgs_per_sec']}/s "
+        f"conv={d['route_convergence_s']}s "
+        f"join={d['join_convergence_s']}s "
+        f"loops={d['loops_dropped']}")
+    return d
+
+
 def bench_cluster(subs: int = 100_000, batch: int = 8192,
                   msgs: int = 10_000) -> dict:
     log("[cluster] 8-dev CPU mesh subprocess ...")
@@ -1697,6 +1831,13 @@ def main() -> None:
         # ADR-012 host-path ladder: healthy vs shedding (stalled
         # consumer + CONNECT storm) vs recovered broker throughput
         runs.append(("overload", lambda: bench_overload()))
+    if "cluster" in which:
+        # ADR-013 federation: 3-node line topology over real bridge
+        # links — local vs 1-hop vs 2-hop throughput/latency + route
+        # convergence after a join
+        runs.append(("cluster_federation",
+                     lambda: bench_cluster_federation(
+                         msgs=max(32, int(400 * scale)))))
     if "5" in which:
         runs.append(("cluster", lambda: bench_cluster(subs=s(100_000))))
     if "e2e" in which:
@@ -1780,7 +1921,8 @@ def assemble_result(configs: list, link: dict, backend_name: str,
 CONFIG_DEADLINES = {"1": 900, "2": 900, "3": 1200, "4": 2400,
                     "4h": 2400, "lat": 900, "lath": 900, "latd": 900,
                     "latdo": 1200, "5": 2400, "e2e": 4200,
-                    "widthab": 1200, "degraded": 1200, "overload": 900}
+                    "widthab": 1200, "degraded": 1200, "overload": 900,
+                    "cluster": 900}
 
 
 def run_supervised(which: list[str]) -> None:

@@ -141,6 +141,26 @@ def supervisor_kwargs(conf: Config) -> dict:
                 backoff_max_s=conf.matcher_breaker_backoff_max_s)
 
 
+def build_cluster(conf: Config, broker: Broker, logger: Logger | None = None):
+    """Attach the federation manager (ADR 013) when ``cluster_node_id``
+    is set: bridge links to every ``cluster_peers`` entry, the
+    aggregated route table, and $cluster/* inbound handling. The links
+    start with broker.serve()."""
+    if not conf.cluster_node_id:
+        return None
+    from .cluster import ClusterManager
+    from .cluster.membership import parse_peers
+    manager = ClusterManager(
+        broker, conf.cluster_node_id, parse_peers(conf.cluster_peers),
+        link_qos=conf.cluster_link_qos,
+        max_hops=conf.cluster_max_hops,
+        link_byte_budget=conf.cluster_link_byte_budget,
+        keepalive=float(conf.cluster_link_keepalive),
+        logger=logger.with_prefix("cluster") if logger else None)
+    broker.attach_cluster(manager)
+    return manager
+
+
 def build_broker(conf: Config, logger: Logger) -> Broker:
     """Assemble a broker from config: capabilities, listeners, hooks,
     matcher. Mirrors internal/mqtt/server.go:38-118."""
@@ -168,6 +188,7 @@ def build_broker(conf: Config, logger: Logger) -> Broker:
         broker.add_listener(HTTPStatsListener(
             "sys-http", conf.mqtt_sys_http_address, lambda: broker.info))
     build_matcher(conf, broker)
+    build_cluster(conf, broker, logger)
     return broker
 
 

@@ -148,6 +148,9 @@ class Broker:
         # recovery and the ledger is bounded by the subscription count
         self._deferred_retained: dict[tuple[str, str],
                                       tuple[Subscription, bool]] = {}
+        # cluster federation manager (ADR 013); attached via
+        # attach_cluster, started/stopped with the broker lifecycle
+        self.cluster = None
         self._running = False
         self.loop: asyncio.AbstractEventLoop | None = None
 
@@ -188,6 +191,12 @@ class Broker:
         expose ``subscribers(topic) -> SubscriberSet``."""
         self.matcher = matcher
 
+    def attach_cluster(self, manager) -> None:
+        """Install the federation manager (ADR 013): bridge links start
+        with serve(), publishes consult its route table in the fan-out,
+        and inbound ``$cluster/*`` traffic is diverted to it."""
+        self.cluster = manager
+
     async def serve(self) -> None:
         self.loop = asyncio.get_running_loop()
         self._running = True
@@ -205,6 +214,9 @@ class Broker:
         self._housekeeper = self.loop.create_task(self._housekeeping_loop())
         if self.capabilities.sys_topic_interval > 0:
             self._sys_task = self.loop.create_task(self._sys_topic_loop())
+        if self.cluster is not None:
+            # after listeners: peers dialing back must find us accepting
+            await self.cluster.start()
         self.hooks.notify("on_started")
 
     async def _compile_matcher_tables(self) -> None:
@@ -257,6 +269,10 @@ class Broker:
         for task in (self._housekeeper, self._sys_task):
             if task is not None:
                 task.cancel()
+        if self.cluster is not None:
+            # bridges first: a dying broker must stop forwarding before
+            # its local fan-out stops
+            await self.cluster.close()
         self.listeners.stop_accepting_all()
         stops = []
         for client in self.clients.connected():
@@ -461,6 +477,8 @@ class Broker:
         for filt in list(client.subscriptions):
             if self.topics.unsubscribe(client.id, filt):
                 self.info.subscriptions -= 1
+                if self.cluster is not None:
+                    self.cluster.note_unsubscribe(filt)
         client.subscriptions.clear()
         self.clients.delete(client.id)
 
@@ -600,7 +618,11 @@ class Broker:
 
         self._resolve_inbound_alias(client, packet)
         if packet.topic.startswith("$") and not client.inline:
-            return  # clients may not publish into reserved $ topics
+            # clients may not publish into reserved $ topics — except
+            # $cluster/* arriving over an authenticated bridge link,
+            # which is the federation wire (ADR 013)
+            await self._process_cluster_inbound(client, packet)
+            return
         if not self.hooks.any_allow("on_acl_check", client, packet.topic, True):
             # [MQTT-3.3.5-2]: ack but do not deliver
             self._ack_publish(client, packet, success=False)
@@ -628,6 +650,23 @@ class Broker:
             # flight — that in-flight depth is what lets the MicroBatcher
             # form device-sized batches instead of per-connection pairs.
             await self._enqueue_publish(client, packet)
+
+    async def _process_cluster_inbound(self, client: Client,
+                                       packet: Packet) -> None:
+        """``$cluster/*`` publishes from a recognized bridge peer are
+        the federation wire: ack them on the normal QoS path (the link
+        QoS is the delivery guarantee between nodes) and hand them to
+        the ClusterManager. Everything else in the ``$`` namespace
+        from a network client stays dropped."""
+        mgr = self.cluster
+        if (mgr is None or not packet.topic.startswith("$cluster/")
+                or not mgr.is_bridge_client(client)):
+            return
+        if not self._check_publish_qos(client, packet):
+            return  # repeated QoS2 id: already re-acked
+        self._ack_publish(client, packet, success=True)
+        self.info.messages_received += 1
+        await mgr.handle_inbound(client, packet)
 
     @staticmethod
     def _resolve_inbound_alias(client: Client, packet: Packet) -> None:
@@ -850,6 +889,14 @@ class Broker:
         self._fan_out(subscribers, packet)
 
     def _fan_out(self, subscribers, packet: Packet) -> None:
+        """Local fan-out + cluster forwarding (ADR 013). Every publish
+        path funnels through here exactly once, so the route-table
+        consult happens once per publish regardless of matcher mode."""
+        self._fan_out_local(subscribers, packet)
+        if self.cluster is not None:
+            self.cluster.maybe_forward(packet)
+
+    def _fan_out_local(self, subscribers, packet: Packet) -> None:
         """Sync fan-out half (no awaits): shared-group selection + per-
         subscriber delivery. The trie path calls it directly so a QoS0
         publish costs no extra coroutine hop.
@@ -1234,8 +1281,18 @@ class Broker:
                            packet_id=packet.packet_id,
                            reason_codes=reason_codes))
         self.hooks.notify("on_subscribed", client, packet, reason_codes, counts)
+        self._cluster_note_subs(accepted)
         for sub, is_new in accepted:
             self._publish_retained_to(client, sub, existing=not is_new)
+
+    def _cluster_note_subs(self, accepted) -> None:
+        """Feed brand-new subscriptions into the federation route
+        table (ADR 013) so peers learn them as aggregated deltas."""
+        if self.cluster is None:
+            return
+        for sub, is_new in accepted:
+            if is_new:
+                self.cluster.note_subscribe(sub.filter)
 
     def _publish_retained_to(self, client: Client, sub: Subscription,
                              existing: bool) -> None:
@@ -1303,6 +1360,8 @@ class Broker:
             existed = self.topics.unsubscribe(client.id, sub.filter)
             if existed:
                 self.info.subscriptions -= 1
+                if self.cluster is not None:
+                    self.cluster.note_unsubscribe(sub.filter)
             client.subscriptions.pop(sub.filter, None)
             reason_codes.append(codes.Success.value if existed
                                 else codes.NoSubscriptionExisted.value)
@@ -1599,6 +1658,8 @@ class Broker:
             "$SYS/broker/system/threads": info.threads,
         }
         entries.update(self._sys_overload_entries())
+        if self.cluster is not None:
+            entries.update(self._sys_cluster_entries())
         for topic, value in entries.items():
             packet = Packet(fixed=FixedHeader(type=PT.PUBLISH, retain=True),
                             topic=topic, payload=str(value).encode(),
@@ -1629,6 +1690,22 @@ class Broker:
             "$SYS/broker/messages/qos_dropped": over.qos_drops,
             "$SYS/broker/clients/top_dropped":
                 json.dumps(top_offenders(self.clients.all())),
+        }
+
+    def _sys_cluster_entries(self) -> dict:
+        """The ADR-013 federation subtree: link/route health at a
+        glance from any MQTT client subscribed to $SYS."""
+        mgr = self.cluster
+        return {
+            "$SYS/broker/cluster/node_id": mgr.node_id,
+            "$SYS/broker/cluster/links_up": mgr.links_up,
+            "$SYS/broker/cluster/link_flaps": mgr.link_flaps,
+            "$SYS/broker/cluster/routes_held":
+                mgr.routes.remote_route_count,
+            "$SYS/broker/cluster/forwards_sent": mgr.forwards_sent,
+            "$SYS/broker/cluster/forwards_delivered":
+                mgr.forwards_delivered,
+            "$SYS/broker/cluster/loops_dropped": mgr.loops_dropped,
         }
 
     # ------------------------------------------------------------------

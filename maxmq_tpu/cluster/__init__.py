@@ -1,0 +1,19 @@
+"""Cluster federation layer (ADR 013): bridge links, aggregated route
+propagation, and cross-node publish forwarding over N broker
+processes."""
+
+from .bridge import BRIDGE_ID_PREFIX, BridgeLink
+from .manager import ClusterManager, DedupWindow
+from .membership import (Membership, PeerSpec, PeerSpecError,
+                         parse_peers, valid_node_id)
+from .routes import (RouteTable, RouteWireError, decode_delta,
+                     decode_snapshot, encode_delta, encode_snapshot,
+                     filter_subsumes, minimal_cover)
+
+__all__ = [
+    "BRIDGE_ID_PREFIX", "BridgeLink", "ClusterManager", "DedupWindow",
+    "Membership", "PeerSpec", "PeerSpecError", "parse_peers",
+    "valid_node_id", "RouteTable", "RouteWireError", "decode_delta",
+    "decode_snapshot", "encode_delta", "encode_snapshot",
+    "filter_subsumes", "minimal_cover",
+]

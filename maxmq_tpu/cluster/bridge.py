@@ -1,0 +1,290 @@
+"""Outbound bridge link to one cluster peer (ADR 013).
+
+A bridge is an ordinary MQTT v3.1.1 client connection (built on
+``mqtt_client.MQTTClient``) from this node to a peer broker, carrying
+three kinds of traffic on reserved ``$cluster/*`` topics: route
+snapshots/deltas, sync requests, and forwarded publishes. The peer
+recognizes the link by its ``$maxmq-cluster/<node>`` client id and
+diverts those topics to its own ClusterManager before the normal
+``$``-namespace drop (broker/server.py).
+
+Robustness rails, mirroring the ADR-011 supervisor and the ADR-012
+ledger:
+
+* **Reconnect** — one supervisor task per link: capped exponential
+  backoff between attempts, reset on a successful CONNACK; every
+  attempt and flap is counted. The deterministic ``cluster.link``
+  fault site (keyed per peer: ``cluster.link#<node>``) can kill or
+  hang the link on demand.
+* **Backpressure** — outbound traffic rides a byte-accounted
+  :class:`~..broker.client.OutboundQueue` wired into the broker's
+  ADR-012 overload ledger, so a slow/partitioned peer counts against
+  the global watermarks instead of buffering unboundedly. Forwarded
+  publishes past the link byte budget are refused (QoS0) or refused
+  *and rolled back* (QoS1: the provisional ack entry is withdrawn —
+  nothing leaks awaiting an ack that can never come); route/control
+  messages are budget-exempt, like acks in the broker's own queues.
+* **Liveness** — an idle link pings every ``keepalive`` seconds; a
+  failed ping tears the link down into the reconnect loop and marks
+  the peer down in the membership ledger.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from .. import faults
+from ..broker.client import OutboundQueue
+from ..mqtt_client import MQTTClient
+from ..protocol.codec import FixedHeader, PacketType as PT
+from ..protocol.packets import Packet
+
+BRIDGE_ID_PREFIX = "$maxmq-cluster/"
+
+# per-link queue entry cap (the byte budget is the real limit; this
+# bounds entry-count bookkeeping the same way broker queues are capped)
+LINK_QUEUE_MAX = 8192
+BURST_BYTES = 65536
+
+
+class BridgeLink:
+    """One supervised outbound link to a peer broker."""
+
+    def __init__(self, manager, spec, *, node_id: str, qos: int = 0,
+                 byte_budget: int = 4 << 20, keepalive: float = 10.0,
+                 backoff_initial_s: float = 0.5,
+                 backoff_max_s: float = 30.0,
+                 connect_timeout: float = 5.0) -> None:
+        self.manager = manager
+        self.spec = spec
+        self.node_id = node_id          # OUR node id (client identity)
+        self.peer = spec.node_id
+        self.qos = qos
+        self.byte_budget = byte_budget
+        self.keepalive = keepalive
+        self.backoff_initial_s = backoff_initial_s
+        self.backoff_max_s = backoff_max_s
+        self.connect_timeout = connect_timeout
+
+        broker = manager.broker
+        self.outbound = OutboundQueue(
+            LINK_QUEUE_MAX, overload=getattr(broker, "overload", None))
+        self.client: MQTTClient | None = None
+        self.connected = False
+        # what this link last told the peer (split-horizon aggregated
+        # set) + the per-link monotonic delta sequence; needs_snapshot
+        # marks a link whose last snapshot failed to enqueue and must
+        # be retried before any delta may flow
+        self.advertised: set[str] = set()
+        self.route_seq = 0
+        self.needs_snapshot = False
+
+        self.connect_attempts = 0
+        self.forwards_sent = 0
+        self.forwards_refused = 0
+        self.forwards_acked = 0
+        self.forward_ack_failures = 0
+        self.control_sent = 0
+        self._task: asyncio.Task | None = None
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    # Lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(
+            self._run(), name=f"cluster-link-{self.peer}")
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        await self._teardown("closed")
+
+    async def _run(self) -> None:
+        backoff = self.backoff_initial_s
+        while not self._closed:
+            self.connect_attempts += 1
+            st = self.manager.membership.get(self.peer)
+            if st is not None:
+                st.connect_attempts += 1
+            try:
+                await self._fire_link_fault()
+                await self._connect_once()
+                backoff = self.backoff_initial_s
+                await self._pump()
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:
+                await self._teardown(repr(exc)[:200])
+            if self._closed:
+                return
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2, self.backoff_max_s)
+
+    async def _fire_link_fault(self) -> None:
+        """Deterministic link fault (ADR 013): ``raise`` kills this
+        attempt/iteration into the reconnect backoff, ``hang`` stalls
+        the link without blocking the loop."""
+        hit = faults.fire_detail(faults.CLUSTER_LINK, key=self.peer)
+        if hit is not None and hit[0] == "hang":
+            await asyncio.sleep(hit[1])
+
+    async def _connect_once(self) -> None:
+        client = MQTTClient(
+            client_id=BRIDGE_ID_PREFIX + self.node_id,
+            keepalive=max(int(self.keepalive * 3), 1))
+        await client.connect(self.spec.host, self.spec.port,
+                             timeout=self.connect_timeout)
+        self.client = client
+        self.connected = True
+        self.manager.membership.note_up(self.peer)
+        self.manager.on_link_up(self)
+
+    async def _teardown(self, reason: str) -> None:
+        was_up = self.connected
+        self.connected = False
+        self.outbound.release_all()     # settle the ADR-012 ledger
+        client, self.client = self.client, None
+        if client is not None:
+            await client.close()
+        self.manager.membership.note_down(self.peer, reason)
+        if was_up:
+            self.manager.on_link_down(self, reason)
+
+    # ------------------------------------------------------------------
+    # Writer pump + keepalive
+    # ------------------------------------------------------------------
+
+    async def _pump(self) -> None:
+        """Writer + keepalive, each its own task, first failure tears
+        the link down. NOT wait_for(outbound.get(), ...): pre-3.12
+        wait_for can cancel the inner await after get_nowait() already
+        popped an item, silently losing an (already de-accounted)
+        forward — the same reason the broker's writer loop awaits its
+        queue bare (broker/client.py)."""
+        tasks = [
+            asyncio.get_running_loop().create_task(
+                self._writer_loop(self.client),
+                name=f"cluster-write-{self.peer}"),
+            asyncio.get_running_loop().create_task(
+                self._keepalive_loop(self.client),
+                name=f"cluster-ping-{self.peer}")]
+        try:
+            done, _pending = await asyncio.wait(
+                tasks, return_when=asyncio.FIRST_EXCEPTION)
+        finally:
+            for t in tasks:
+                if not t.done():
+                    t.cancel()
+            results = await asyncio.gather(*tasks,
+                                           return_exceptions=True)
+        for r in results:
+            if isinstance(r, Exception):
+                raise r
+        raise ConnectionError("bridge pump ended")    # unreachable
+
+    async def _writer_loop(self, client: MQTTClient) -> None:
+        while True:
+            item = await self.outbound.get()
+            burst = 0
+            while True:
+                await self._fire_link_fault()
+                client.writer.write(item)
+                burst += len(item)
+                if burst >= BURST_BYTES:
+                    break
+                try:
+                    item = self.outbound.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+            await client.writer.drain()
+            self.manager.membership.note_alive(self.peer)
+
+    async def _keepalive_loop(self, client: MQTTClient) -> None:
+        while True:
+            await asyncio.sleep(self.keepalive)
+            await self._fire_link_fault()
+            await client.ping(timeout=self.connect_timeout)
+            self.manager.membership.note_alive(self.peer)
+
+    # ------------------------------------------------------------------
+    # Enqueue side (called synchronously from the fan-out path)
+    # ------------------------------------------------------------------
+
+    def _encode_publish(self, topic: str, payload: bytes, qos: int,
+                        retain: bool, packet_id: int = 0) -> bytes:
+        return Packet(fixed=FixedHeader(type=PT.PUBLISH, qos=qos,
+                                        retain=retain),
+                      protocol_version=4, topic=topic, payload=payload,
+                      packet_id=packet_id).encode()
+
+    def forward(self, topic: str, payload: bytes, qos: int = 0) -> bool:
+        """Enqueue one forwarded publish; False = refused (link down,
+        byte budget, or queue full). A refused QoS1 forward rolls its
+        provisional ack entry back — the ADR-012 no-leak invariant
+        applied to the bridge. Ledger charges are the EXACT encoded
+        wire bytes (ADR 012's pre-encoded-wire discipline)."""
+        client = self.client
+        if not self.connected or client is None:
+            return False
+        pid = 0
+        if qos > 0:
+            pid = client._alloc_id()
+            fut = client._await_ack(PT.PUBACK, pid)
+            fut.add_done_callback(self._on_forward_ack)
+        wire = self._encode_publish(topic, payload, qos, False, pid)
+        if (self.byte_budget
+                and self.outbound.bytes + len(wire) > self.byte_budget):
+            self.forwards_refused += 1
+            if qos > 0:
+                self._rollback_refused_ack(client, pid)
+            return False
+        try:
+            self.outbound.put_nowait(wire, len(wire))
+        except asyncio.QueueFull:
+            self.forwards_refused += 1
+            if qos > 0:
+                self._rollback_refused_ack(client, pid)
+            return False
+        self.forwards_sent += 1
+        return True
+
+    def _rollback_refused_ack(self, client: MQTTClient,
+                              pid: int) -> None:
+        """Withdraw the ack entry a refused QoS1 forward registered:
+        the publish never hit the wire, so nothing may sit waiting for
+        a PUBACK that cannot come (mirrors the broker's
+        ``_rollback_refused_qos``)."""
+        fut = client._acks.pop((PT.PUBACK, pid), None)
+        if fut is not None and not fut.done():
+            fut.remove_done_callback(self._on_forward_ack)
+            fut.cancel()
+
+    def _on_forward_ack(self, fut: asyncio.Future) -> None:
+        if fut.cancelled() or fut.exception() is not None:
+            self.forward_ack_failures += 1
+        else:
+            self.forwards_acked += 1
+
+    def send_control(self, topic: str, payload: bytes,
+                     retain: bool = False) -> bool:
+        """Enqueue a route/control message. Budget-exempt (dropping
+        route deltas to save bytes would desync the mesh — the same
+        reasoning that exempts acks from the broker's client budgets),
+        but still accounted on the ledgers."""
+        if not self.connected or self.client is None:
+            return False
+        wire = self._encode_publish(topic, payload, 0, retain)
+        try:
+            self.outbound.put_nowait(wire, len(wire))
+        except asyncio.QueueFull:
+            return False
+        self.control_sent += 1
+        return True

@@ -1,0 +1,472 @@
+"""ClusterManager: the federation brain wired into one broker (ADR 013).
+
+Links N broker processes into one logical broker: outbound
+:class:`~.bridge.BridgeLink` per seed peer, an aggregated
+:class:`~.routes.RouteTable` answering "which peers need this publish",
+and the ``$cluster/*`` inbound dispatch the broker diverts to us from
+``process_publish``. Forwarding is route-driven and transitive (a
+middle node re-forwards using its own table, so a line topology spans
+hops), with three loop-prevention rails proven by the 3-node-cycle
+test: an origin-node guard (a node never accepts or forwards its own
+publishes back), a hop cap (``cluster_max_hops``), and per-origin
+message-id dedup (redundant paths in a cyclic mesh deliver once).
+
+Reserved wire topics (all inside the operator-reserved ``$cluster/#``
+namespace; ordinary clients cannot publish ``$`` topics):
+
+* ``$cluster/routes/<node>``          retained compressed snapshot
+* ``$cluster/routes/<node>/delta``    incremental add/del, per-link seq
+* ``$cluster/sync/<node>``            "resend me your snapshot"
+* ``$cluster/fwd/<origin>/<epoch>/<msgid>/<hops>/<flags>/<topic...>``
+  forwarded publish: origin node id, origin's boot epoch, per-origin
+  monotonic message id, hops traversed, flags = original QoS digit
+  (+ ``r`` for retained), then the original topic verbatim. The epoch
+  scopes the dedup window: a restarted origin restarts its message
+  ids, and without the epoch every peer would silently drop its first
+  window of forwards as replayed duplicates.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from .. import faults
+from ..matching.topics import parse_share, valid_topic_name
+from ..protocol.codec import FixedHeader, PacketType as PT
+from ..protocol.packets import Packet
+from .bridge import BRIDGE_ID_PREFIX, BridgeLink
+from .membership import Membership, PeerSpec, valid_node_id
+from .routes import (RouteTable, RouteWireError, decode_delta,
+                     decode_snapshot, encode_delta, encode_snapshot)
+
+DEDUP_WINDOW = 8192     # per-origin forwarded-message-id memory
+
+
+class DedupWindow:
+    """Bounded per-(origin, boot-epoch) seen-set: admits each message
+    id once. The epoch tags which origin incarnation the window
+    belongs to — a fresh epoch replaces the window wholesale."""
+
+    __slots__ = ("_seen", "_order", "cap", "epoch")
+
+    def __init__(self, cap: int = DEDUP_WINDOW, epoch: int = 0) -> None:
+        self._seen: set[int] = set()
+        self._order: deque[int] = deque()
+        self.cap = cap
+        self.epoch = epoch
+
+    def admit(self, msgid: int) -> bool:
+        if msgid in self._seen:
+            return False
+        self._seen.add(msgid)
+        self._order.append(msgid)
+        if len(self._order) > self.cap:
+            self._seen.discard(self._order.popleft())
+        return True
+
+
+class ClusterManager:
+    """Federation state + forwarding policy for one broker process."""
+
+    def __init__(self, broker, node_id: str, peers: list[PeerSpec], *,
+                 link_qos: int = 0, max_hops: int = 3,
+                 link_byte_budget: int = 4 << 20,
+                 keepalive: float = 10.0,
+                 backoff_initial_s: float = 0.5,
+                 backoff_max_s: float = 30.0,
+                 epoch: int | None = None, logger=None) -> None:
+        if not valid_node_id(node_id):
+            raise ValueError(f"bad cluster node id {node_id!r}")
+        if any(p.node_id == node_id for p in peers):
+            raise ValueError("cluster_peers lists this node itself")
+        self.broker = broker
+        self.node_id = node_id
+        self.link_qos = min(max(link_qos, 0), 1)
+        self.max_hops = max_hops
+        self.log = logger
+        self.routes = RouteTable(
+            node_id, epoch if epoch is not None
+            else int(time.time() * 1000))
+        self.membership = Membership(peers)
+        self._link_kw = dict(node_id=node_id, qos=self.link_qos,
+                             byte_budget=link_byte_budget,
+                             keepalive=keepalive,
+                             backoff_initial_s=backoff_initial_s,
+                             backoff_max_s=backoff_max_s)
+        self.links: dict[str, BridgeLink] = {
+            p.node_id: BridgeLink(self, p, **self._link_kw)
+            for p in peers}
+        self._seen: dict[str, DedupWindow] = {}
+        self._next_msg_id = 0
+        self._refresh_pending = False
+        self._retry_pending = False
+        self._started = False
+
+        # counters (read tear-free by the metrics scrape thread)
+        self.forwards_delivered = 0     # remote publishes fanned out here
+        self.loops_dropped = 0          # origin echo + duplicate path
+        self.hops_dropped = 0           # onward forward past the cap
+        self.forwards_skipped_down = 0  # target peer's link was down
+        self.snapshots_applied = 0
+        self.deltas_applied = 0
+        self.route_desyncs = 0
+        self.route_apply_failures = 0
+        self.syncs_sent = 0
+        self.inbound_rejected = 0       # malformed/spoofed $cluster wire
+
+    # ------------------------------------------------------------------
+    # Lifecycle (driven by Broker.serve / Broker.close)
+    # ------------------------------------------------------------------
+
+    async def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        # seed the aggregated local set from pre-existing (restored)
+        # subscriptions; everything after flows through note_subscribe
+        for filt, _cid, _sub, _group in \
+                self.broker.topics.all_subscriptions():
+            self._note_filter(filt, add=True, refresh=False)
+        for link in self.links.values():
+            link.start()
+
+    async def close(self) -> None:
+        self._started = False
+        for link in self.links.values():
+            await link.close()
+
+    def add_peer(self, spec: PeerSpec) -> BridgeLink:
+        """Dynamically admit a peer beyond the boot seed list (node
+        join): registers it in membership and starts its bridge link.
+        Existing peers learn the newcomer's routes transitively."""
+        from .membership import PeerState
+        if spec.node_id == self.node_id or spec.node_id in self.links:
+            raise ValueError(f"peer {spec.node_id!r} already present")
+        self.membership.peers[spec.node_id] = PeerState(spec=spec)
+        link = BridgeLink(self, spec, **self._link_kw)
+        self.links[spec.node_id] = link
+        if self._started:
+            link.start()
+        return link
+
+    def is_bridge_client(self, client) -> bool:
+        cid = getattr(client, "id", "")
+        return (cid.startswith(BRIDGE_ID_PREFIX)
+                and cid[len(BRIDGE_ID_PREFIX):] in self.membership.peers)
+
+    # ------------------------------------------------------------------
+    # Local subscription tracking (called by broker/server.py)
+    # ------------------------------------------------------------------
+
+    def note_subscribe(self, filt: str) -> None:
+        self._note_filter(filt, add=True)
+
+    def note_unsubscribe(self, filt: str) -> None:
+        self._note_filter(filt, add=False)
+
+    def _note_filter(self, filt: str, add: bool,
+                     refresh: bool = True) -> None:
+        group, inner = parse_share(filt)
+        filt = inner if group else filt
+        if not filt or filt.startswith("$"):
+            return      # $-topics are never federated
+        if add:
+            changed = self.routes.note_local_subscribe(filt)
+        else:
+            changed = self.routes.note_local_unsubscribe(filt)
+        if changed and refresh:
+            self._schedule_refresh()
+
+    # ------------------------------------------------------------------
+    # Route advertisement (split-horizon deltas, snapshot on link-up)
+    # ------------------------------------------------------------------
+
+    def _schedule_refresh(self) -> None:
+        """Debounced re-advertisement: one pass per loop turn no matter
+        how many subscriptions changed in it."""
+        if self._refresh_pending or not self._started:
+            return
+        self._refresh_pending = True
+        loop = getattr(self.broker, "loop", None)
+        if loop is None:
+            self._refresh_pending = False
+            return
+        loop.call_soon(self._refresh_advertisements)
+
+    def _refresh_advertisements(self) -> None:
+        self._refresh_pending = False
+        for link in self.links.values():
+            if not link.connected:
+                continue    # the reconnect snapshot will catch it up
+            if link.needs_snapshot:
+                self._send_snapshot(link)   # unsent snapshot first: a
+                continue                    # delta atop it would gap
+            desired = self.routes.advertisement_for(link.peer)
+            if desired == link.advertised:
+                continue
+            add = desired - link.advertised
+            rem = link.advertised - desired
+            ok = link.send_control(
+                f"$cluster/routes/{self.node_id}/delta",
+                encode_delta(self.node_id, self.routes.epoch,
+                             link.route_seq + 1, add, rem))
+            if ok:
+                link.route_seq += 1
+                link.advertised = desired
+            else:
+                # a delta we couldn't queue would silently desync the
+                # peer: fall back to a full snapshot on this link
+                self._send_snapshot(link)
+
+    def _send_snapshot(self, link: BridgeLink) -> bool:
+        """Send the full advertisement on one link. ``advertised``/
+        ``route_seq`` advance ONLY on a successful enqueue — marking a
+        never-sent snapshot as delivered would leave the peer
+        routeless while we believe it is caught up; failures mark the
+        link and retry shortly."""
+        desired = self.routes.advertisement_for(link.peer)
+        ok = link.send_control(
+            f"$cluster/routes/{self.node_id}",
+            encode_snapshot(self.node_id, self.routes.epoch,
+                            link.route_seq + 1, desired),
+            retain=True)
+        if ok:
+            link.route_seq += 1
+            link.advertised = desired
+            link.needs_snapshot = False
+        else:
+            link.needs_snapshot = True
+            self._retry_refresh_later()
+        return ok
+
+    def _retry_refresh_later(self) -> None:
+        """A failed control enqueue (wedged link queue) retries on a
+        short delay instead of spinning the loop turn."""
+        loop = getattr(self.broker, "loop", None)
+        if loop is None or self._retry_pending:
+            return
+        self._retry_pending = True
+
+        def fire() -> None:
+            self._retry_pending = False
+            self._refresh_advertisements()
+
+        loop.call_later(0.1, fire)
+
+    def on_link_up(self, link: BridgeLink) -> None:
+        self._send_snapshot(link)
+
+    def on_link_down(self, link: BridgeLink, reason: str) -> None:
+        # routes are KEPT: a flapping link must not churn the mesh's
+        # tables; a peer that actually restarted re-announces with a
+        # fresh epoch, which flushes its old routes on arrival
+        if self.log is not None:
+            self.log.warn("cluster link down", peer=link.peer,
+                          reason=reason)
+
+    # ------------------------------------------------------------------
+    # Forwarding decision (called from the broker fan-out, sync)
+    # ------------------------------------------------------------------
+
+    def maybe_forward(self, packet: Packet) -> None:
+        """Forward one locally fanned-out publish to every peer whose
+        advertised routes match (retained messages flood so any future
+        remote subscriber finds them), once per peer, guarded by the
+        origin/hop rails."""
+        topic = packet.topic
+        if topic.startswith("$"):
+            return
+        origin = getattr(packet, "_cluster_origin", None)
+        via = getattr(packet, "_cluster_via", None)
+        hops = getattr(packet, "_cluster_hops", 0)
+        if origin is None:
+            origin = self.node_id
+            epoch = self.routes.epoch
+            self._next_msg_id += 1
+            msgid = self._next_msg_id
+        else:
+            epoch = packet._cluster_epoch
+            msgid = packet._cluster_msgid
+        if packet.fixed.retain:
+            targets = set(self.links)       # flood retained state
+        else:
+            targets = set(self.routes.nodes_for(topic))
+        targets.discard(origin)
+        targets.discard(via)
+        if not targets:
+            return
+        if hops >= self.max_hops:
+            self.hops_dropped += 1
+            return
+        flags = f"{min(packet.fixed.qos, self.link_qos)}" + \
+            ("r" if packet.fixed.retain else "")
+        envelope = (f"$cluster/fwd/{origin}/{epoch}/{msgid}/{hops + 1}/"
+                    f"{flags}/{topic}")
+        for node in targets:
+            link = self.links.get(node)
+            if link is None or not link.connected:
+                self.forwards_skipped_down += 1
+                continue
+            link.forward(envelope, packet.payload,
+                         qos=min(packet.fixed.qos, self.link_qos))
+
+    # ------------------------------------------------------------------
+    # Inbound $cluster/* dispatch (from broker.process_publish)
+    # ------------------------------------------------------------------
+
+    async def handle_inbound(self, client, packet: Packet) -> None:
+        sender = client.id[len(BRIDGE_ID_PREFIX):]
+        levels = packet.topic.split("/")
+        kind = levels[1] if len(levels) > 1 else ""
+        if kind == "fwd" and len(levels) >= 8:
+            await self._handle_fwd(client, sender, levels, packet)
+        elif kind == "routes" and len(levels) >= 3:
+            self._handle_routes(sender, levels, packet)
+        elif kind == "sync" and len(levels) == 3:
+            self._handle_sync(levels[2])
+        else:
+            self.inbound_rejected += 1
+
+    async def _handle_fwd(self, client, sender: str, levels: list[str],
+                          packet: Packet) -> None:
+        try:
+            origin, epoch = levels[2], int(levels[3])
+            msgid, hops, flags = int(levels[4]), int(levels[5]), levels[6]
+            qos = min(int(flags[0]), self.link_qos)
+            retain = "r" in flags
+        except (ValueError, IndexError):
+            self.inbound_rejected += 1
+            return
+        topic = "/".join(levels[7:])
+        if topic.startswith("$") or not valid_topic_name(topic):
+            # a bridge peer must never smuggle $-state overwrites or
+            # wildcard "topics" into the local fan-out/retain store
+            self.inbound_rejected += 1
+            return
+        if origin == self.node_id:
+            self.loops_dropped += 1     # our own publish came back
+            return
+        window = self._seen.get(origin)
+        if window is None or epoch > window.epoch:
+            # fresh origin incarnation: its message ids restarted, so
+            # the old window no longer means "already delivered"
+            window = self._seen[origin] = DedupWindow(epoch=epoch)
+        elif epoch < window.epoch:
+            self.loops_dropped += 1     # stale incarnation replay
+            return
+        if not window.admit(msgid):
+            self.loops_dropped += 1     # redundant path in the mesh
+            return
+        out = Packet(fixed=FixedHeader(type=PT.PUBLISH, qos=qos,
+                                       retain=retain),
+                     topic=topic, payload=packet.payload,
+                     origin=f"$cluster/{origin}", created=time.time())
+        out._cluster_origin = origin
+        out._cluster_epoch = epoch
+        out._cluster_via = sender
+        out._cluster_hops = hops
+        out._cluster_msgid = msgid
+        if retain:
+            self.broker.retain_message(client, out)
+        self.forwards_delivered += 1
+        # re-enters the normal local fan-out (order-preserving publish
+        # pipeline when a matcher is attached) AND maybe_forward for
+        # the onward hop
+        await self.broker.publish_to_subscribers(out)
+
+    def _handle_routes(self, sender: str, levels: list[str],
+                       packet: Packet) -> None:
+        node = levels[2]
+        if node != sender:
+            self.inbound_rejected += 1  # spoofed advertisement
+            return
+        is_delta = len(levels) >= 4 and levels[3] == "delta"
+        try:
+            faults.fire(faults.CLUSTER_ROUTE_APPLY)
+            if is_delta:
+                self._apply_delta(node, packet.payload)
+            else:
+                self._apply_snapshot(node, packet.payload)
+        except (faults.InjectedFault, RouteWireError):
+            # a failed SNAPSHOT apply must desync too: the sender has
+            # already marked this link caught-up, so without a resync
+            # request no delta would ever repair the hole
+            self.route_apply_failures += 1
+            self._desync(node)
+
+    def _apply_snapshot(self, node: str, payload: bytes) -> None:
+        wnode, epoch, seq, filters = decode_snapshot(payload)
+        if wnode != node:
+            self.inbound_rejected += 1
+            return
+        if self.routes.apply_snapshot(node, epoch, seq, filters):
+            self.snapshots_applied += 1
+            self.membership.note_alive(node)
+            st = self.membership.get(node)
+            if st is not None:
+                st.epoch = epoch
+            self._retain_observable(node, payload)
+            self._schedule_refresh()    # transitive re-advertisement
+
+    def _apply_delta(self, node: str, payload: bytes) -> None:
+        wnode, epoch, seq, add, rem = decode_delta(payload)
+        if wnode != node:
+            self.inbound_rejected += 1
+            return
+        if self.routes.apply_delta(node, epoch, seq, add, rem):
+            self.deltas_applied += 1
+            self.membership.note_alive(node)
+            self._schedule_refresh()
+        else:
+            self._desync(node)
+
+    def _desync(self, node: str) -> None:
+        """A delta gap/epoch mismatch: flush what we hold for the node
+        (stale routes must not forward) and ask it for a fresh
+        snapshot over OUR link to it."""
+        self.route_desyncs += 1
+        self.routes.flush_node(node)
+        self._schedule_refresh()
+        link = self.links.get(node)
+        if link is not None and link.connected:
+            if link.send_control(f"$cluster/sync/{self.node_id}", b""):
+                self.syncs_sent += 1
+
+    def _handle_sync(self, requester: str) -> None:
+        link = self.links.get(requester)
+        if link is not None and link.connected:
+            self._send_snapshot(link)
+
+    def _retain_observable(self, node: str, payload: bytes) -> None:
+        """Keep the latest applied snapshot retained in the local trie
+        so operators can inspect cluster state by subscribing to
+        ``$cluster/routes/#`` on any node."""
+        self.broker.topics.retain(Packet(
+            fixed=FixedHeader(type=PT.PUBLISH, retain=True),
+            topic=f"$cluster/routes/{node}", payload=payload,
+            origin=f"$cluster/{node}", created=time.time()))
+
+    # ------------------------------------------------------------------
+    # Aggregates for metrics / $SYS
+    # ------------------------------------------------------------------
+
+    @property
+    def forwards_sent(self) -> int:
+        return sum(lk.forwards_sent for lk in self.links.values())
+
+    @property
+    def forwards_refused(self) -> int:
+        return sum(lk.forwards_refused for lk in self.links.values())
+
+    @property
+    def link_flaps(self) -> int:
+        return sum(st.flaps for st in self.membership.peers.values())
+
+    @property
+    def connect_attempts(self) -> int:
+        return sum(lk.connect_attempts for lk in self.links.values())
+
+    @property
+    def links_up(self) -> int:
+        return sum(1 for lk in self.links.values() if lk.connected)

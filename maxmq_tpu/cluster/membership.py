@@ -1,0 +1,111 @@
+"""Cluster membership: static seed list + liveness from bridge
+keepalives (ADR 013).
+
+Membership here is deliberately NOT a consensus protocol: the peer set
+is the operator-supplied seed list (``cluster_peers``), and the only
+dynamic fact tracked per peer is link liveness — last successful
+keepalive/connect, connection state, and the flap count. A peer whose
+link is down keeps its routes in the table (delivery degrades to
+local-only while forwards to it are skipped); a peer that RESTARTED is
+detected by the higher epoch in its first snapshot, which flushes the
+old incarnation's routes (routes.py).
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from dataclasses import dataclass, field
+
+# node ids ride inside ``$cluster/...`` topic levels: one level, no
+# wildcards, no separators
+_NODE_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
+
+
+class PeerSpecError(ValueError):
+    pass
+
+
+def valid_node_id(node_id: str) -> bool:
+    return bool(_NODE_ID_RE.match(node_id))
+
+
+@dataclass(frozen=True)
+class PeerSpec:
+    node_id: str
+    host: str
+    port: int
+
+
+def parse_peers(spec: str) -> list[PeerSpec]:
+    """Parse ``cluster_peers``: comma-separated ``node@host:port``
+    entries (``nodeB@10.0.0.2:1883,nodeC@10.0.0.3:1883``)."""
+    peers: list[PeerSpec] = []
+    seen: set[str] = set()
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        node_id, at, addr = entry.partition("@")
+        host, colon, port_s = addr.rpartition(":")
+        if not at or not colon or not host:
+            raise PeerSpecError(
+                f"bad peer {entry!r} (want node@host:port)")
+        if not valid_node_id(node_id):
+            raise PeerSpecError(f"bad peer node id {node_id!r}")
+        if node_id in seen:
+            raise PeerSpecError(f"duplicate peer node id {node_id!r}")
+        try:
+            port = int(port_s)
+        except ValueError:
+            raise PeerSpecError(f"bad peer port {port_s!r}") from None
+        seen.add(node_id)
+        peers.append(PeerSpec(node_id, host, port))
+    return peers
+
+
+@dataclass
+class PeerState:
+    spec: PeerSpec
+    connected: bool = False
+    last_seen: float = 0.0          # monotonic; last keepalive/connect
+    epoch: int = 0                  # last snapshot epoch seen
+    flaps: int = 0                  # up->down transitions
+    connect_attempts: int = 0
+    last_error: str = ""
+    extras: dict = field(default_factory=dict)
+
+
+class Membership:
+    """Peer liveness ledger, updated by the bridge links."""
+
+    def __init__(self, peers: list[PeerSpec]) -> None:
+        self.peers: dict[str, PeerState] = {
+            p.node_id: PeerState(spec=p) for p in peers}
+
+    def get(self, node_id: str) -> PeerState | None:
+        return self.peers.get(node_id)
+
+    def note_up(self, node_id: str) -> None:
+        st = self.peers.get(node_id)
+        if st is not None:
+            st.connected = True
+            st.last_seen = time.monotonic()
+
+    def note_alive(self, node_id: str) -> None:
+        st = self.peers.get(node_id)
+        if st is not None:
+            st.last_seen = time.monotonic()
+
+    def note_down(self, node_id: str, error: str = "") -> None:
+        st = self.peers.get(node_id)
+        if st is None:
+            return
+        if st.connected:
+            st.flaps += 1
+        st.connected = False
+        if error:
+            st.last_error = error
+
+    def live_nodes(self) -> list[str]:
+        return [n for n, st in self.peers.items() if st.connected]

@@ -1,0 +1,271 @@
+"""Aggregated cluster route table + snapshot/delta wire codec (ADR 013).
+
+Each node advertises the set of topic filters it (or anything reachable
+through it) has subscribers for. The table here stores what REMOTE
+peers advertised — keyed by direct-peer node id — and answers the one
+hot-path question ``nodes_for(topic)``: which peers need a copy of this
+publish. Remote filters live in a :class:`~..matching.trie.TopicIndex`
+whose "client ids" are node ids, so matching reuses the exact wildcard
+semantics (and C-backed SubscriberSet) of the local matcher instead of
+a second, subtly different matcher; results are memoized in a
+``VersionedTopicCache`` keyed on the index's subscription version.
+
+Advertisements are *aggregated*: a filter subsumed by a broader one
+from the same advertiser is never put on the wire (``sport/#`` at a
+peer subsumes ``sport/+/score`` — arXiv:1811.07088's subscription
+aggregation), so route-table size tracks the distinct filter shapes,
+not the subscription count.
+
+Wire format (versioned, JSON payloads on reserved ``$cluster/routes/*``
+topics):
+
+* snapshot — zlib-compressed ``{"v":1,"node","epoch","seq","filters"}``
+  published to ``$cluster/routes/<node>`` (retained on the receiving
+  broker for observability); replaces everything known about the node.
+* delta — plain ``{"v":1,"node","epoch","seq","add","del"}`` published
+  to ``$cluster/routes/<node>/delta``; applies only when ``epoch``
+  matches and ``seq`` is exactly ``last_seq + 1`` — any gap is a
+  desync and the receiver must request a fresh snapshot.
+
+Epochs are per-process-boot monotonic stamps: a restarted peer's first
+snapshot carries a higher epoch, flushing every stale route the old
+incarnation advertised (including a stale RETAINED snapshot replayed
+by a broker — lower epochs are ignored).
+"""
+
+from __future__ import annotations
+
+import json
+import zlib
+
+from ..matching.topics import split_levels
+from ..matching.trie import TopicIndex, VersionedTopicCache
+from ..protocol.packets import Subscription
+
+WIRE_VERSION = 1
+
+# topic level budget for route payloads; a snapshot beyond this refuses
+# to decode rather than let one peer OOM the cluster control plane
+MAX_SNAPSHOT_BYTES = 8 << 20
+
+
+class RouteWireError(ValueError):
+    """A route snapshot/delta payload that failed to decode."""
+
+
+def filter_subsumes(general: str, specific: str) -> bool:
+    """True when every topic matching ``specific`` also matches
+    ``general`` (so advertising ``general`` alone loses nothing).
+    Level rules mirror the trie walk: ``#`` covers the parent level and
+    everything deeper [MQTT-4.7.1.2], ``+`` covers exactly one level
+    [MQTT-4.7.1-3]. ``$``-prefixed filters are never advertised (the
+    cluster refuses to forward ``$`` topics), so the root-level
+    dollar exception never arises here."""
+    if general == specific:
+        return True
+    glv = split_levels(general)
+    slv = split_levels(specific)
+    for i, gl in enumerate(glv):
+        if gl == "#":
+            return True
+        if i >= len(slv):
+            return False
+        sl = slv[i]
+        if gl == "+":
+            if sl == "#":
+                return False    # specific reaches deeper than one level
+            continue
+        if gl != sl:
+            return False        # literal mismatch, or specific is the
+    return len(glv) == len(slv)  # broader one ('+'/'#' vs literal)
+
+
+def minimal_cover(filters) -> set[str]:
+    """The aggregated advertisement: drop every filter subsumed by a
+    DIFFERENT filter in the set. O(n^2) level walks over the distinct
+    filter shapes — advertisements aggregate per filter, never per
+    subscription, so n stays small even at 1M subscriptions."""
+    fs = set(filters)
+    out = set()
+    for f in fs:
+        if not any(g != f and filter_subsumes(g, f) for g in fs):
+            out.add(f)
+    return out
+
+
+# ----------------------------------------------------------------------
+# Wire codec
+# ----------------------------------------------------------------------
+
+
+def encode_snapshot(node: str, epoch: int, seq: int, filters) -> bytes:
+    return zlib.compress(json.dumps(
+        {"v": WIRE_VERSION, "node": node, "epoch": epoch, "seq": seq,
+         "filters": sorted(filters)}).encode())
+
+
+def decode_snapshot(payload: bytes) -> tuple[str, int, int, list[str]]:
+    try:
+        raw = zlib.decompress(payload, bufsize=65536)
+        if len(raw) > MAX_SNAPSHOT_BYTES:
+            raise RouteWireError("snapshot too large")
+        d = json.loads(raw)
+        if d.get("v") != WIRE_VERSION:
+            raise RouteWireError(f"unknown wire version {d.get('v')!r}")
+        return (str(d["node"]), int(d["epoch"]), int(d["seq"]),
+                [str(f) for f in d["filters"]])
+    except RouteWireError:
+        raise
+    except Exception as exc:
+        raise RouteWireError(f"bad snapshot: {exc!r}") from exc
+
+
+def encode_delta(node: str, epoch: int, seq: int,
+                 add, remove) -> bytes:
+    return json.dumps(
+        {"v": WIRE_VERSION, "node": node, "epoch": epoch, "seq": seq,
+         "add": sorted(add), "del": sorted(remove)}).encode()
+
+
+def decode_delta(payload: bytes
+                 ) -> tuple[str, int, int, list[str], list[str]]:
+    try:
+        d = json.loads(payload)
+        if d.get("v") != WIRE_VERSION:
+            raise RouteWireError(f"unknown wire version {d.get('v')!r}")
+        return (str(d["node"]), int(d["epoch"]), int(d["seq"]),
+                [str(f) for f in d["add"]], [str(f) for f in d["del"]])
+    except RouteWireError:
+        raise
+    except Exception as exc:
+        raise RouteWireError(f"bad delta: {exc!r}") from exc
+
+
+# ----------------------------------------------------------------------
+# The table
+# ----------------------------------------------------------------------
+
+
+class NodeRoutes:
+    """What one direct peer currently advertises."""
+
+    __slots__ = ("epoch", "seq", "filters")
+
+    def __init__(self, epoch: int, seq: int, filters: set[str]) -> None:
+        self.epoch = epoch
+        self.seq = seq
+        self.filters = filters
+
+
+class RouteTable:
+    """Local aggregated filters + per-peer advertised filter sets.
+
+    Single-threaded: every mutation and query runs on the broker's
+    asyncio loop (the inner TopicIndex carries its own lock, but this
+    class adds no cross-thread contract)."""
+
+    def __init__(self, node_id: str, epoch: int) -> None:
+        self.node_id = node_id
+        self.epoch = epoch
+        # local aggregated refcounts: filter -> live subscription count
+        self.local: dict[str, int] = {}
+        self.nodes: dict[str, NodeRoutes] = {}
+        self._index = TopicIndex()          # remote filters, cid=node
+        self._cache = VersionedTopicCache(maxsize=2048)
+
+    # -- local side ----------------------------------------------------
+
+    def note_local_subscribe(self, filt: str) -> bool:
+        """Count one local subscription under its aggregated filter;
+        True when the filter is new (advertisements may change)."""
+        n = self.local.get(filt, 0)
+        self.local[filt] = n + 1
+        return n == 0
+
+    def note_local_unsubscribe(self, filt: str) -> bool:
+        n = self.local.get(filt, 0)
+        if n <= 1:
+            existed = self.local.pop(filt, None) is not None
+            return existed
+        self.local[filt] = n - 1
+        return False
+
+    def advertisement_for(self, peer: str) -> set[str]:
+        """The aggregated filter set this node advertises to ``peer``:
+        local filters plus everything learned from OTHER peers (routes
+        are transitive — a line topology forwards across the middle
+        node), minus anything learned only from ``peer`` itself (split
+        horizon: never advertise a peer's own routes back at it)."""
+        pool = set(self.local)
+        for node, nr in self.nodes.items():
+            if node != peer:
+                pool |= nr.filters
+        return minimal_cover(pool)
+
+    # -- remote side ---------------------------------------------------
+
+    def apply_snapshot(self, node: str, epoch: int, seq: int,
+                       filters) -> bool:
+        """Replace everything known about ``node``. False = stale
+        (older epoch, or an older seq within the same epoch — e.g. a
+        retained snapshot from before the peer restarted)."""
+        nr = self.nodes.get(node)
+        if nr is not None and (epoch < nr.epoch
+                               or (epoch == nr.epoch and seq < nr.seq)):
+            return False
+        fresh = set(filters)
+        if nr is not None:
+            for f in nr.filters - fresh:
+                self._index.unsubscribe(node, f)
+            add = fresh - nr.filters
+        else:
+            add = fresh
+        for f in add:
+            self._index.subscribe(node, Subscription(filter=f))
+        self.nodes[node] = NodeRoutes(epoch, seq, fresh)
+        return True
+
+    def apply_delta(self, node: str, epoch: int, seq: int,
+                    add, remove) -> bool:
+        """Apply an incremental update; False = desync (unknown node,
+        epoch mismatch, or a sequence gap) — the caller must flush and
+        request a fresh snapshot."""
+        nr = self.nodes.get(node)
+        if nr is None or epoch != nr.epoch or seq != nr.seq + 1:
+            return False
+        for f in remove:
+            if f in nr.filters:
+                nr.filters.discard(f)
+                self._index.unsubscribe(node, f)
+        for f in add:
+            if f not in nr.filters:
+                nr.filters.add(f)
+                self._index.subscribe(node, Subscription(filter=f))
+        nr.seq = seq
+        return True
+
+    def flush_node(self, node: str) -> int:
+        """Drop everything a peer advertised (restart with a fresh
+        epoch, or a desync awaiting resync). Returns routes dropped."""
+        nr = self.nodes.pop(node, None)
+        if nr is None:
+            return 0
+        for f in nr.filters:
+            self._index.unsubscribe(node, f)
+        return len(nr.filters)
+
+    def nodes_for(self, topic: str) -> frozenset[str]:
+        """Direct peers whose advertised filters match ``topic`` — the
+        forward target set, memoized per (topic, table version)."""
+        version = self._index.sub_version
+        hit = self._cache.get(topic, version)
+        if hit is not None:
+            return hit
+        matched = self._index.subscribers(topic)
+        result = frozenset(matched.subscriptions)
+        self._cache.put(topic, version, result)
+        return result
+
+    @property
+    def remote_route_count(self) -> int:
+        return sum(len(nr.filters) for nr in self.nodes.values())

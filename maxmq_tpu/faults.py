@@ -65,6 +65,8 @@ SERVICE_SOCKET = "service.socket"      # matcher-service client connection
 POOL_WORKER = "pool.worker"            # delivery-pool worker process
 CLIENT_WRITE = "client.write"          # broker client writer loop (ADR 012)
 LISTENER_ACCEPT = "listener.accept"    # broker connection accept (ADR 012)
+CLUSTER_LINK = "cluster.link"          # bridge link connect/pump (ADR 013)
+CLUSTER_ROUTE_APPLY = "cluster.route_apply"  # route snapshot/delta apply
 
 
 class _Spec:

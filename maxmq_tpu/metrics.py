@@ -281,6 +281,76 @@ def register_broker_metrics(registry: Registry, broker) -> None:
     _register_matcher_metrics(registry, broker)
     # host-path overload ladder (ADR 012)
     _register_overload_metrics(registry, broker)
+    # cluster federation (ADR 013)
+    _register_cluster_metrics(registry, broker)
+
+
+# per-peer link-series cardinality bound, mirroring the ADR-012
+# offender metric's discipline: the peer set is operator-supplied and
+# small, but the exposition page must stay bounded regardless
+CLUSTER_PEER_SERIES = 8
+
+
+def _register_cluster_metrics(registry: Registry, broker) -> None:
+    """ADR-013 federation observability: route-table size, delta/
+    snapshot churn, forward/loop counters, and per-peer link health
+    (bounded to CLUSTER_PEER_SERIES series, label values escaped by
+    the shared exposition path — peer ids are operator config, but the
+    page must survive a hostile one)."""
+    mgr = getattr(broker, "cluster", None)
+    if mgr is None:
+        return
+    registry.gauge_func(
+        "maxmq_cluster_routes_held",
+        "Remote topic filters currently held in the route table",
+        lambda: mgr.routes.remote_route_count)
+    registry.gauge_func(
+        "maxmq_cluster_links_up",
+        "Bridge links currently connected", lambda: mgr.links_up)
+    for name, help_ in (
+            ("snapshots_applied", "Route snapshots applied"),
+            ("deltas_applied", "Route deltas applied"),
+            ("route_desyncs",
+             "Delta gaps/epoch mismatches that flushed a peer's routes "
+             "and requested a fresh snapshot"),
+            ("route_apply_failures",
+             "Route payloads that failed to decode/apply"),
+            ("forwards_sent", "Publishes forwarded to peers"),
+            ("forwards_delivered",
+             "Remote publishes fanned out to local subscribers"),
+            ("forwards_refused",
+             "Forwards refused by a link's byte budget/queue "
+             "(QoS1 entries rolled back)"),
+            ("forwards_skipped_down",
+             "Forward targets skipped because the link was down "
+             "(local-only degradation)"),
+            ("loops_dropped",
+             "Forwards dropped by the origin-echo/dedup loop guards"),
+            ("hops_dropped", "Onward forwards dropped by the hop cap"),
+            ("link_flaps", "Bridge link up->down transitions"),
+            ("connect_attempts",
+             "Bridge connect attempts (incl. backoff retries)")):
+        registry.counter_func(f"maxmq_cluster_{name}_total", help_,
+                              lambda n=name: getattr(mgr, n))
+
+    def _peer_series(attr):
+        links = sorted(mgr.links.items())[:CLUSTER_PEER_SERIES]
+        return [({"peer": peer}, attr(link)) for peer, link in links]
+
+    registry.multi_func(
+        "maxmq_cluster_link_state", "gauge",
+        "Per-peer bridge link state (1 connected, 0 down); cardinality "
+        "bounded to the first CLUSTER_PEER_SERIES peers",
+        lambda: _peer_series(lambda lk: 1.0 if lk.connected else 0.0))
+    registry.multi_func(
+        "maxmq_cluster_link_queued_bytes", "gauge",
+        "Per-peer bridge outbound queued bytes (accounted on the "
+        "ADR-012 ledger); same cardinality bound",
+        lambda: _peer_series(lambda lk: lk.outbound.bytes))
+    registry.multi_func(
+        "maxmq_cluster_link_forwards_total", "counter",
+        "Per-peer forwards enqueued; same cardinality bound",
+        lambda: _peer_series(lambda lk: lk.forwards_sent))
 
 
 def _register_overload_metrics(registry: Registry, broker) -> None:

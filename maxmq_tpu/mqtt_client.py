@@ -54,6 +54,14 @@ class MQTTClient:
         self.reader: asyncio.StreamReader | None = None
         self.writer = None
         self.connack: Packet | None = None
+        # CONNACK outcome surfaced to callers even when connect()
+        # raises (bridge links log the broker's refusal reason instead
+        # of a bare MQTTError, ADR 013)
+        self.connack_reason: int | None = None
+        self.session_present: bool | None = None
+        # first fatal transport error; the read loop used to swallow
+        # these silently (mirrors broker Client.write_error, ADR 012)
+        self.transport_error: str | None = None
         self.messages: asyncio.Queue[Message] = asyncio.Queue()
         self.disconnect_packet: Packet | None = None
         self._acks: dict[tuple[int, int], asyncio.Future] = {}
@@ -86,6 +94,8 @@ class MQTTClient:
                 if fh.type != PT.CONNACK:
                     raise MQTTError(f"expected CONNACK, got {fh.type}")
                 self.connack = Packet.decode(fh, body, self.version)
+                self.connack_reason = self.connack.reason_code
+                self.session_present = self.connack.session_present
                 if self.connack.reason_code >= 0x80 or (
                         self.version < 5 and self.connack.reason_code != 0):
                     raise MQTTError(
@@ -123,8 +133,13 @@ class MQTTClient:
                 if not chunk:
                     break
                 buf.extend(chunk)
-        except (ConnectionError, asyncio.CancelledError, OSError):
+        except asyncio.CancelledError:
             pass
+        except (ConnectionError, OSError) as exc:
+            # swallowed (the loop must end either way), but recorded:
+            # a bridge supervisor reports WHY its link died, and tests
+            # can assert on it instead of guessing (ADR 013)
+            self.transport_error = self.transport_error or repr(exc)
         finally:
             self._closed.set()
             for fut in self._acks.values():
@@ -272,8 +287,10 @@ class MQTTClient:
                                      protocol_version=self.version,
                                      reason_code=reason_code).encode())
             await self.writer.drain()
-        except (ConnectionError, OSError):
-            pass
+        except (ConnectionError, OSError) as exc:
+            # shutdown path: swallowed but recorded (write_error
+            # pattern, ADR 012/013)
+            self.transport_error = self.transport_error or repr(exc)
         await self.close()
 
     async def close(self) -> None:

@@ -77,6 +77,14 @@ class Config:
     broker_overload_high_water: float = 0.8   # shed above budget * high
     broker_overload_low_water: float = 0.5    # recover below budget * low
 
+    # -- cluster federation (ADR 013) ----------------------------------------
+    cluster_node_id: str = ""           # non-empty enables federation
+    cluster_peers: str = ""             # "nodeB@host:1884,nodeC@host:1885"
+    cluster_link_qos: int = 0           # forward QoS cap on bridge links
+    cluster_max_hops: int = 3           # forwarded-publish hop ceiling
+    cluster_link_byte_budget: int = 4 << 20  # per-link queued bytes; 0 off
+    cluster_link_keepalive: float = 10.0     # bridge ping interval, seconds
+
     # -- persistence --------------------------------------------------------
     storage_backend: str = ""           # "" | memory | sqlite
     storage_path: str = "maxmq.db"

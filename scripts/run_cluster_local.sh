@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Spin up a 3-node local federation (ADR 013) for manual poking:
+#
+#   node-a  mqtt :1883  metrics :8881
+#   node-b  mqtt :1884  metrics :8882
+#   node-c  mqtt :1885  metrics :8883
+#
+# Line topology a-b-c (peer lists symmetric, as deployments require).
+# Try it:
+#   mosquitto_sub -p 1885 -t 'demo/#' &          # subscriber at C
+#   mosquitto_pub -p 1883 -t demo/x -m hi        # publish at A (2 hops)
+#   curl -s localhost:8881/metrics | grep maxmq_cluster_
+#   mosquitto_sub -p 1883 -t '$SYS/broker/cluster/#' -v
+#
+# Ctrl-C tears all three down.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PY="${PYTHON:-python}"
+pids=()
+cleanup() { kill "${pids[@]}" 2>/dev/null || true; wait 2>/dev/null || true; }
+trap cleanup EXIT INT TERM
+
+start_node() { # name mqtt_port metrics_port peers
+  MAXMQ_CLUSTER_NODE_ID="$1" \
+  MAXMQ_MQTT_TCP_ADDRESS="127.0.0.1:$2" \
+  MAXMQ_METRICS_ADDRESS="127.0.0.1:$3" \
+  MAXMQ_CLUSTER_PEERS="$4" \
+  MAXMQ_LOG_LEVEL="${MAXMQ_LOG_LEVEL:-info}" \
+  MAXMQ_MATCHER="${MAXMQ_MATCHER:-trie}" \
+  "$PY" -m maxmq_tpu start --no-banner &
+  pids+=($!)
+  echo "started $1 (mqtt :$2, metrics :$3, pid ${pids[-1]})"
+}
+
+start_node node-a 1883 8881 "node-b@127.0.0.1:1884"
+start_node node-b 1884 8882 "node-a@127.0.0.1:1883,node-c@127.0.0.1:1885"
+start_node node-c 1885 8883 "node-b@127.0.0.1:1884"
+
+echo "3-node cluster up; Ctrl-C to stop"
+wait

@@ -1,0 +1,533 @@
+"""Cluster federation e2e suite (ADR 013): route propagation with
+aggregation/subsumption, transitive 2-hop forwarding with exactly-once
+delivery, loop prevention on a cyclic mesh, link-flap recovery under
+``cluster.link`` faults, stale-epoch route flush on peer restart,
+retained visibility across nodes, and the QoS1 forward ack-rollback
+invariant — all against real brokers on real TCP sockets, driven
+deterministically (no sleeps standing in for convergence)."""
+
+import asyncio
+import time
+from contextlib import asynccontextmanager
+
+import pytest
+
+from maxmq_tpu import faults
+from maxmq_tpu.broker import Broker, BrokerOptions, Capabilities, TCPListener
+from maxmq_tpu.cluster import (BRIDGE_ID_PREFIX, ClusterManager, DedupWindow,
+                               PeerSpec, PeerSpecError, decode_delta,
+                               decode_snapshot, encode_delta,
+                               encode_snapshot, filter_subsumes,
+                               minimal_cover, parse_peers)
+from maxmq_tpu.cluster.routes import RouteTable, RouteWireError
+from maxmq_tpu.hooks import AllowHook
+from maxmq_tpu.mqtt_client import MQTTClient
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+async def make_node(**caps) -> Broker:
+    caps.setdefault("sys_topic_interval", 0)
+    b = Broker(BrokerOptions(capabilities=Capabilities(**caps)))
+    b.add_hook(AllowHook())
+    listener = b.add_listener(TCPListener("t", "127.0.0.1:0"))
+    await b.serve()
+    b.test_port = listener._server.sockets[0].getsockname()[1]
+    return b
+
+
+def make_manager(brokers: dict[str, Broker], name: str,
+                 peers: list[str], **kw) -> ClusterManager:
+    specs = [PeerSpec(p, "127.0.0.1", brokers[p].test_port)
+             for p in peers]
+    kw.setdefault("keepalive", 0.5)
+    kw.setdefault("backoff_initial_s", 0.05)
+    kw.setdefault("backoff_max_s", 0.5)
+    mgr = ClusterManager(brokers[name], name, specs, **kw)
+    brokers[name].attach_cluster(mgr)
+    return mgr
+
+
+@asynccontextmanager
+async def cluster(topology: dict[str, list[str]], **kw):
+    """Build one broker + manager per topology entry (peer lists must
+    be symmetric, as deployments require) and tear everything down."""
+    brokers: dict[str, Broker] = {}
+    managers: dict[str, ClusterManager] = {}
+    for name in topology:
+        brokers[name] = await make_node()
+    for name, peers in topology.items():
+        managers[name] = make_manager(brokers, name, peers, **kw)
+        await managers[name].start()
+    try:
+        yield brokers, managers
+    finally:
+        for b in brokers.values():
+            await b.close()
+
+
+async def wait_for(predicate, timeout: float = 10.0, what: str = ""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        await asyncio.sleep(0.02)
+    raise AssertionError(f"condition not reached in {timeout}s: {what}")
+
+
+async def connect(broker: Broker, client_id: str, **kw) -> MQTTClient:
+    c = MQTTClient(client_id=client_id, **kw)
+    await c.connect("127.0.0.1", broker.test_port)
+    return c
+
+
+# ----------------------------------------------------------------------
+# Units: subsumption, cover, wire codec, dedup, peer parsing
+# ----------------------------------------------------------------------
+
+
+def test_filter_subsumes():
+    yes = [("sport/#", "sport/+/score"), ("sport/#", "sport"),
+           ("#", "a/b/c"), ("+/+", "a/b"), ("sport/+", "sport/x"),
+           ("a/#", "a/#"), ("+/#", "a/b/c/d")]
+    no = [("sport/+/score", "sport/#"), ("sport/+", "sport/x/y"),
+          ("a/b", "a/+"), ("a/b", "a/#"), ("a/+", "a/#"),
+          ("a/b", "a/b/c"), ("a/b/c", "a/b"), ("+", "a/b")]
+    for g, f in yes:
+        assert filter_subsumes(g, f), (g, f)
+    for g, f in no:
+        assert not filter_subsumes(g, f), (g, f)
+
+
+def test_minimal_cover():
+    assert minimal_cover(["sport/#", "sport/+/score", "news/x"]) == \
+        {"sport/#", "news/x"}
+    assert minimal_cover(["#", "a", "b/+"]) == {"#"}
+    assert minimal_cover([]) == set()
+    # equal filters collapse, non-overlapping survive
+    assert minimal_cover(["a/+", "a/+", "b"]) == {"a/+", "b"}
+
+
+def test_wire_codec_roundtrip():
+    payload = encode_snapshot("n1", 7, 3, {"a/#", "b/+/c"})
+    assert decode_snapshot(payload) == ("n1", 7, 3, ["a/#", "b/+/c"])
+    payload = encode_delta("n1", 7, 4, {"x"}, {"y", "z"})
+    assert decode_delta(payload) == ("n1", 7, 4, ["x"], ["y", "z"])
+    for bad in (b"junk", b"", b"\x78\x9c"):
+        with pytest.raises(RouteWireError):
+            decode_snapshot(bad)
+    with pytest.raises(RouteWireError):
+        decode_delta(b'{"v": 99}')
+
+
+def test_route_table_epoch_seq_rules():
+    rt = RouteTable("me", epoch=1)
+    assert rt.apply_snapshot("p", 5, 1, ["a/#"])
+    assert rt.nodes_for("a/x") == frozenset({"p"})
+    # delta chain applies in order, gaps desync
+    assert rt.apply_delta("p", 5, 2, ["b"], [])
+    assert not rt.apply_delta("p", 5, 4, ["c"], [])     # gap
+    assert not rt.apply_delta("p", 6, 3, ["c"], [])     # epoch mismatch
+    # stale snapshot (older epoch or older seq) is ignored
+    assert not rt.apply_snapshot("p", 4, 99, ["zzz"])
+    assert not rt.apply_snapshot("p", 5, 1, ["zzz"])
+    assert rt.nodes_for("b") == frozenset({"p"})
+    # a fresh epoch replaces everything the old incarnation advertised
+    assert rt.apply_snapshot("p", 6, 1, ["c/#"])
+    assert rt.nodes_for("b") == frozenset()
+    assert rt.nodes_for("c/d") == frozenset({"p"})
+    assert rt.flush_node("p") == 1
+    assert rt.nodes_for("c/d") == frozenset()
+
+
+def test_advertisement_split_horizon_and_aggregation():
+    rt = RouteTable("me", epoch=1)
+    rt.note_local_subscribe("sport/+/score")
+    rt.note_local_subscribe("sport/#")
+    rt.apply_snapshot("p1", 1, 1, ["news/#"])
+    rt.apply_snapshot("p2", 1, 1, ["sport/tennis"])
+    # to p1: local cover (sport/# subsumes both locals AND p2's
+    # sport/tennis) + p2's routes; p1's own routes never echo back
+    assert rt.advertisement_for("p1") == {"sport/#"}
+    assert rt.advertisement_for("p2") == {"sport/#", "news/#"}
+    # refcounts: two subscribers on one filter, one unsubscribe keeps it
+    rt.note_local_subscribe("sport/#")
+    assert not rt.note_local_unsubscribe("sport/#")
+    assert rt.note_local_unsubscribe("sport/#")
+    assert rt.advertisement_for("p1") == {"sport/+/score",
+                                          "sport/tennis"}
+
+
+def test_dedup_window():
+    w = DedupWindow(cap=4)
+    assert all(w.admit(i) for i in range(4))
+    assert not w.admit(2)           # duplicate inside the window
+    assert w.admit(5) and w.admit(6)
+    assert w.admit(0)               # evicted: admitted again (bounded)
+
+
+def test_parse_peers():
+    peers = parse_peers("b@10.0.0.2:1883, c@host:1885")
+    assert peers == [PeerSpec("b", "10.0.0.2", 1883),
+                     PeerSpec("c", "host", 1885)]
+    assert parse_peers("") == []
+    for bad in ("b@nohost", "b@host:xx", "noat:1883", "b b@h:1",
+                "b@h:1,b@h:2"):
+        with pytest.raises(PeerSpecError):
+            parse_peers(bad)
+
+
+def test_manager_rejects_bad_identity():
+    broker = Broker(BrokerOptions())
+    with pytest.raises(ValueError):
+        ClusterManager(broker, "has/slash", [])
+    with pytest.raises(ValueError):
+        ClusterManager(broker, "a", [PeerSpec("a", "h", 1)])
+
+
+# ----------------------------------------------------------------------
+# e2e: propagation, forwarding, loops, faults
+# ----------------------------------------------------------------------
+
+LINE = {"A": ["B"], "B": ["A", "C"], "C": ["B"]}
+MESH = {"A": ["B", "C"], "B": ["A", "C"], "C": ["A", "B"]}
+
+
+async def test_route_propagation_and_aggregation():
+    async with cluster({"A": ["B"], "B": ["A"]}) as (brokers, mgrs):
+        sub = await connect(brokers["B"], "sub")
+        await sub.subscribe("sport/+/score", "sport/#", "news/x")
+        await wait_for(lambda: mgrs["A"].routes.nodes.get("B") and
+                       mgrs["A"].routes.nodes["B"].filters ==
+                       {"sport/#", "news/x"},
+                       what="aggregated routes at A")
+        # subsumption: sport/+/score never crossed the wire
+        assert mgrs["A"].routes.nodes_for("sport/t/score") == \
+            frozenset({"B"})
+        # dropping the broad filter re-advertises the narrow one
+        await sub.unsubscribe("sport/#")
+        await wait_for(lambda: mgrs["A"].routes.nodes["B"].filters ==
+                       {"sport/+/score", "news/x"},
+                       what="re-advertisement after unsubscribe")
+        await sub.disconnect()
+
+
+async def test_two_hop_exactly_once_with_qos():
+    """Line A-B-C: a QoS1 publish at A reaches the subscriber at C
+    (two hops, transitive routes) exactly once at the link-capped
+    QoS."""
+    async with cluster(LINE, link_qos=1) as (brokers, mgrs):
+        sub = await connect(brokers["C"], "sub")
+        await sub.subscribe("sport/#", qos=1)
+        await wait_for(lambda: mgrs["A"].routes.nodes_for("sport/x"),
+                       what="2-hop route visible at A")
+        pub = await connect(brokers["A"], "pub")
+        await pub.publish("sport/tennis", b"m1", qos=1)
+        msg = await sub.next_message(timeout=5)
+        assert (msg.topic, msg.payload, msg.qos) == \
+            ("sport/tennis", b"m1", 1)
+        # exactly once: no duplicate within a grace window
+        with pytest.raises(asyncio.TimeoutError):
+            await sub.next_message(timeout=0.4)
+        assert mgrs["B"].forwards_delivered == 1    # the relay hop
+        assert mgrs["C"].forwards_delivered == 1
+        await pub.disconnect()
+        await sub.disconnect()
+
+
+async def test_loop_prevention_on_cycle():
+    """Full 3-node mesh: redundant paths (direct + relayed) must
+    collapse to exactly one delivery per subscriber via the
+    origin/dedup guards; nothing circulates forever."""
+    async with cluster(MESH) as (brokers, mgrs):
+        sub_b = await connect(brokers["B"], "sub-b")
+        sub_c = await connect(brokers["C"], "sub-c")
+        await sub_b.subscribe("t/#")
+        await sub_c.subscribe("t/#")
+        await wait_for(
+            lambda: mgrs["A"].routes.nodes_for("t/x") >= {"B", "C"},
+            what="cycle routes at A")
+        pub = await connect(brokers["A"], "pub")
+        for i in range(3):
+            await pub.publish("t/x", b"m%d" % i)
+        for sub in (sub_b, sub_c):
+            got = [await sub.next_message(timeout=5) for _ in range(3)]
+            assert [m.payload for m in got] == [b"m0", b"m1", b"m2"]
+            with pytest.raises(asyncio.TimeoutError):
+                await sub.next_message(timeout=0.4)
+        # the redundant relayed copies were dropped by the guards
+        await wait_for(lambda: sum(m.loops_dropped
+                                   for m in mgrs.values()) >= 3,
+                       what="dedup drops observed")
+        await pub.disconnect()
+        await sub_b.disconnect()
+        await sub_c.disconnect()
+
+
+async def test_link_flap_recovery_local_only_degradation():
+    """Killing the A-B link (cluster.link fault) degrades A's
+    publishes to local-only; reconnect restores forwarding with no
+    duplicates or loops."""
+    async with cluster({"A": ["B"], "B": ["A"]}) as (brokers, mgrs):
+        sub = await connect(brokers["B"], "sub")
+        await sub.subscribe("t/#")
+        await wait_for(lambda: mgrs["A"].routes.nodes_for("t/x"),
+                       what="routes at A")
+        pub = await connect(brokers["A"], "pub")
+        await pub.publish("t/x", b"before")
+        assert (await sub.next_message(timeout=5)).payload == b"before"
+
+        # kill A's link to B: the pump's next activity (keepalive ping
+        # at the latest) trips the armed fault
+        link = mgrs["A"].links["B"]
+        faults.arm(f"{faults.CLUSTER_LINK}#B", "raise", count=1)
+        await wait_for(lambda: not link.connected, what="link down")
+        skipped = mgrs["A"].forwards_skipped_down
+        await pub.publish("t/x", b"during")
+        await wait_for(
+            lambda: mgrs["A"].forwards_skipped_down > skipped,
+            what="forward skipped while down")
+        with pytest.raises(asyncio.TimeoutError):
+            await sub.next_message(timeout=0.4)   # local-only at B
+
+        await wait_for(lambda: link.connected, what="link recovered")
+        assert mgrs["A"].link_flaps >= 1
+        await pub.publish("t/x", b"after")
+        assert (await sub.next_message(timeout=5)).payload == b"after"
+        with pytest.raises(asyncio.TimeoutError):
+            await sub.next_message(timeout=0.4)   # and exactly once
+        await pub.disconnect()
+        await sub.disconnect()
+
+
+async def test_stale_epoch_flush_on_peer_restart():
+    """A restarted peer announces a fresh epoch; its old advertised
+    routes are flushed even though the delta chain broke."""
+    brokers = {"A": await make_node(), "B": await make_node()}
+    port_b = brokers["B"].test_port
+    mgr_a = make_manager(brokers, "A", ["B"])
+    mgr_b = make_manager(brokers, "B", ["A"], epoch=1000)
+    await mgr_a.start()
+    await mgr_b.start()
+    try:
+        sub = await connect(brokers["B"], "sub")
+        await sub.subscribe("old/#")
+        await wait_for(lambda: mgr_a.routes.nodes_for("old/x"),
+                       what="routes from first incarnation")
+        # B restarts: same address, fresh epoch, no subscribers
+        await brokers["B"].close()
+        b2 = Broker(BrokerOptions(
+            capabilities=Capabilities(sys_topic_interval=0)))
+        b2.add_hook(AllowHook())
+        b2.add_listener(TCPListener("t", f"127.0.0.1:{port_b}"))
+        brokers["B"] = b2
+        mgr_b2 = make_manager(brokers, "B", ["A"], epoch=2000)
+        await b2.serve()
+        b2.test_port = port_b
+        await wait_for(lambda: mgr_b2.links["A"].connected,
+                       what="restarted B redialed A")
+        await wait_for(
+            lambda: mgr_a.routes.nodes.get("B") is not None
+            and mgr_a.routes.nodes["B"].epoch == 2000
+            and not mgr_a.routes.nodes["B"].filters,
+            what="stale routes flushed by the fresh epoch")
+        assert mgr_a.routes.nodes_for("old/x") == frozenset()
+    finally:
+        for b in brokers.values():
+            await b.close()
+
+
+async def test_retained_message_visible_across_nodes():
+    """Retained state floods the mesh: a subscriber that appears at a
+    DIFFERENT node after the publish still gets the retained copy."""
+    async with cluster({"A": ["B"], "B": ["A"]}) as (brokers, mgrs):
+        await wait_for(lambda: mgrs["A"].links["B"].connected,
+                       what="link up")
+        pub = await connect(brokers["A"], "pub")
+        await pub.publish("state/door", b"open", retain=True)
+        await wait_for(
+            lambda: brokers["B"].topics.retained_get("state/door")
+            is not None, what="retained replicated to B")
+        sub = await connect(brokers["B"], "late-sub")
+        await sub.subscribe("state/#")
+        msg = await sub.next_message(timeout=5)
+        assert (msg.topic, msg.payload, msg.retain) == \
+            ("state/door", b"open", True)
+        # retained clear propagates too
+        await pub.publish("state/door", b"", retain=True)
+        await wait_for(
+            lambda: brokers["B"].topics.retained_get("state/door")
+            is None, what="retained clear replicated")
+        await pub.disconnect()
+        await sub.disconnect()
+
+
+async def test_qos1_forward_ack_rollback_on_refused_send():
+    """A QoS1 forward the link queue refuses must withdraw its
+    provisional ack entry (the ADR-012 no-leak invariant on the
+    bridge) — and an accepted one completes the PUBACK round trip."""
+    async with cluster({"A": ["B"], "B": ["A"]},
+                       link_qos=1) as (brokers, mgrs):
+        link = mgrs["A"].links["B"]
+        await wait_for(lambda: link.connected, what="link up")
+        acks_before = dict(link.client._acks)
+        # accepted forward: acked by the peer broker
+        assert link.forward("$cluster/fwd/A/900/1/1/q/t", b"ok", qos=1)
+        await wait_for(lambda: link.forwards_acked == 1,
+                       what="PUBACK round trip")
+        # refused forward: wedge the queue entry cap
+        link.outbound._maxsize = 1
+        link.outbound.put_nowait(b"\x00", 1)       # fills the queue
+        assert not link.forward("$cluster/fwd/A/901/1/1/q/t",
+                                b"no", qos=1)
+        assert link.forwards_refused == 1
+        assert link.client._acks == acks_before    # nothing leaked
+        # byte-budget refusal path counts without touching acks either
+        link.outbound._maxsize = 8192
+        link.byte_budget = 8
+        assert not link.forward("$cluster/fwd/A/902/1/1/q/t",
+                                b"x" * 64, qos=1)
+        assert link.forwards_refused == 2
+        assert link.client._acks == acks_before
+
+
+async def test_route_apply_fault_desyncs_then_resyncs():
+    """An injected cluster.route_apply failure on a delta flushes the
+    peer's routes and the sync-request round trip restores them."""
+    async with cluster({"A": ["B"], "B": ["A"]}) as (brokers, mgrs):
+        sub = await connect(brokers["B"], "sub")
+        await sub.subscribe("one/#")
+        await wait_for(lambda: mgrs["A"].routes.nodes_for("one/x"),
+                       what="initial route")
+        faults.arm(faults.CLUSTER_ROUTE_APPLY, "raise", count=1)
+        await sub.subscribe("two/#")       # delta A fails to apply
+        await wait_for(lambda: mgrs["A"].route_apply_failures == 1,
+                       what="apply fault fired")
+        await wait_for(
+            lambda: mgrs["A"].routes.nodes_for("one/x")
+            and mgrs["A"].routes.nodes_for("two/x"),
+            what="resynced after desync")
+        assert mgrs["A"].route_desyncs >= 1
+        await sub.disconnect()
+
+
+async def test_forward_dedup_is_epoch_scoped_and_topics_validated():
+    """A restarted origin restarts its message ids under a fresh
+    epoch: the dedup window must admit them (not swallow them as
+    replays), while stale-incarnation replays and $-topic/wildcard
+    smuggling stay rejected."""
+    from maxmq_tpu.protocol.codec import FixedHeader, PacketType as PT
+    from maxmq_tpu.protocol.packets import Packet
+    async with cluster({"A": ["B"], "B": ["A"]}) as (brokers, mgrs):
+        a = mgrs["A"]
+
+        async def fwd(topic: str) -> bool:
+            p = Packet(fixed=FixedHeader(type=PT.PUBLISH),
+                       topic=topic, payload=b"x")
+            before = a.forwards_delivered
+            await a._handle_fwd(None, "B", topic.split("/"), p)
+            return a.forwards_delivered > before
+
+        assert await fwd("$cluster/fwd/B/1/1/1/0/t/x")
+        assert not await fwd("$cluster/fwd/B/1/1/1/0/t/x")  # duplicate
+        # fresh epoch, same msgid: a restarted B must get through
+        assert await fwd("$cluster/fwd/B/2/1/1/0/t/x")
+        # stale incarnation replay stays dropped
+        assert not await fwd("$cluster/fwd/B/1/2/1/0/t/x")
+        assert a.loops_dropped == 2
+        # inner-topic validation: $-state and wildcards never enter
+        rejected = a.inbound_rejected
+        assert not await fwd("$cluster/fwd/B/2/7/1/0r/$SYS/broker/x")
+        assert not await fwd("$cluster/fwd/B/2/8/1/0/a/#")
+        assert a.inbound_rejected == rejected + 2
+
+
+async def test_reserved_namespace_rejects_non_bridge_clients():
+    """$cluster/* from an ordinary client is dropped, and a client
+    merely wearing the bridge id prefix for an unknown peer is too."""
+    async with cluster({"A": ["B"], "B": ["A"]}) as (brokers, mgrs):
+        sub = await connect(brokers["A"], "sub")
+        await sub.subscribe("t/#")
+        evil = await connect(brokers["A"], "evil")
+        await evil.publish("$cluster/fwd/Z/1/1/0/t/x", b"spoof")
+        evil2 = await connect(brokers["A"], BRIDGE_ID_PREFIX + "Z")
+        await evil2.publish("$cluster/fwd/Z/2/1/0/t/x", b"spoof2")
+        with pytest.raises(asyncio.TimeoutError):
+            await sub.next_message(timeout=0.5)
+        assert mgrs["A"].forwards_delivered == 0
+        for c in (sub, evil, evil2):
+            await c.disconnect()
+
+
+async def test_cluster_metrics_and_sys_exposed():
+    from maxmq_tpu.metrics import Registry, register_broker_metrics
+    async with cluster({"A": ["B"], "B": ["A"]}) as (brokers, mgrs):
+        sub = await connect(brokers["B"], "sub")
+        await sub.subscribe("m/#")
+        await wait_for(lambda: mgrs["A"].routes.nodes_for("m/x"),
+                       what="routes at A")
+        pub = await connect(brokers["A"], "pub")
+        await pub.publish("m/x", b"hi")
+        assert (await sub.next_message(timeout=5)).payload == b"hi"
+        registry = Registry()
+        register_broker_metrics(registry, brokers["A"])
+        page = registry.expose()
+        assert "maxmq_cluster_routes_held 1" in page
+        assert "maxmq_cluster_links_up 1" in page
+        assert "maxmq_cluster_forwards_sent_total 1" in page
+        assert 'maxmq_cluster_link_state{peer="B"} 1' in page
+        sys = brokers["A"]._sys_cluster_entries()
+        assert sys["$SYS/broker/cluster/node_id"] == "A"
+        assert sys["$SYS/broker/cluster/forwards_sent"] == 1
+        await pub.disconnect()
+        await sub.disconnect()
+
+
+async def test_bootstrap_builds_cluster_from_config():
+    from maxmq_tpu.bootstrap import build_broker
+    from maxmq_tpu.utils.config import Config
+    from maxmq_tpu.utils.logger import new_logger
+    conf = Config(cluster_node_id="n1",
+                  cluster_peers="n2@127.0.0.1:19999",
+                  cluster_link_qos=1, cluster_max_hops=2,
+                  mqtt_tcp_address="127.0.0.1:0",
+                  metrics_enabled=False, matcher="")
+    broker = build_broker(conf, new_logger(level="error"))
+    mgr = broker.cluster
+    assert mgr is not None and mgr.node_id == "n1"
+    assert mgr.link_qos == 1 and mgr.max_hops == 2
+    assert set(mgr.links) == {"n2"}
+    # no cluster_node_id: no manager attached
+    conf2 = Config(mqtt_tcp_address="127.0.0.1:0",
+                   metrics_enabled=False, matcher="")
+    assert build_broker(conf2, new_logger(level="error")).cluster is None
+
+
+async def test_client_surfaces_connack_and_transport_errors():
+    """mqtt_client hardening (ADR 013 satellite): CONNACK reason and
+    session-present are caller-visible, and a torn transport is
+    recorded instead of swallowed."""
+    broker = await make_node()
+    try:
+        c = MQTTClient(client_id="persist", clean_start=False)
+        await c.connect("127.0.0.1", broker.test_port)
+        assert c.connack_reason == 0 and c.session_present is False
+        await c.subscribe("a/b", qos=1)
+        await c.disconnect()
+        c2 = MQTTClient(client_id="persist", clean_start=False)
+        await c2.connect("127.0.0.1", broker.test_port)
+        assert c2.session_present is True
+        # server-side stop tears the transport mid-session: the read
+        # loop records the cause instead of dying silently
+        server_client = broker.clients.get("persist")
+        server_client.writer.transport.abort()
+        await c2.wait_closed(timeout=5)
+        assert c2.transport_error is not None or c2._closed.is_set()
+        await c2.close()
+    finally:
+        await broker.close()
