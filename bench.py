@@ -33,7 +33,9 @@ the ADR-010 kernel-width A/B, degraded = the ADR-011 ladder under
 injected device faults — healthy vs breaker-open trie-only vs
 recovered throughput, overload = the ADR-012 host-path ladder —
 healthy vs shedding (stalled consumer + CONNECT storm) vs recovered
-broker fan-out),
+broker fan-out, durable = the ADR-014 storage pipeline — QoS1
+throughput/ack latency under storage_sync always vs batched vs off,
+plus recovery-time-to-first-CONNACK after SIGKILL),
 MAXMQ_BENCH_SUBS/BATCH/ITERS/DEPTH override config #4's shape.
 """
 
@@ -1436,6 +1438,183 @@ def bench_overload(n_clients: int = 8, msgs: int = 300) -> dict:
     return d
 
 
+def bench_durable(msgs: int = 600, window: int = 64) -> dict:
+    """ADR-014 durability-policy measurement (MAXMQ_BENCH_CONFIGS=
+    durable): QoS1 publish throughput + mean PUBACK latency against a
+    real SQLite-backed broker under storage_sync = always (acks ride
+    the group-commit fsync barrier) vs batched (acks immediate, one
+    fsync per window) vs off — the Pulsar study's per-message-fsync vs
+    group-commit lever as numbers on this box. One offline persistent
+    QoS1 subscriber makes every publish carry an inflight record, so
+    the journal is on the measured path. Also measures recovery time
+    to first CONNACK after a SIGKILL — the ROADMAP's 'broker restart
+    must not refuse to boot' scenario."""
+    import asyncio
+    import shutil
+    import signal
+    import socket
+    import tempfile
+
+    from maxmq_tpu.broker import (Broker, BrokerOptions, Capabilities,
+                                  TCPListener)
+    from maxmq_tpu.hooks import AllowHook
+    from maxmq_tpu.hooks.journal import (SQLITE_SYNC_BY_POLICY,
+                                         WriteBehindStore)
+    from maxmq_tpu.hooks.storage import SQLiteStore, StorageHook
+    from maxmq_tpu.mqtt_client import MQTTClient
+
+    workdir = tempfile.mkdtemp(prefix="maxmq-durable-")
+    payload = b"d" * 256
+
+    async def measure_policy(policy: str) -> dict:
+        path = os.path.join(workdir, f"{policy}.db")
+        store = WriteBehindStore(
+            SQLiteStore(path, synchronous=SQLITE_SYNC_BY_POLICY[policy]),
+            policy=policy)
+        b = Broker(BrokerOptions(capabilities=Capabilities(
+            sys_topic_interval=0)))
+        b.add_hook(AllowHook())
+        b.add_hook(StorageHook(store))
+        lst = b.add_listener(TCPListener("t", "127.0.0.1:0"))
+        await b.serve()
+        port = lst._server.sockets[0].getsockname()[1]
+        sub = MQTTClient(client_id=f"dur-sub-{policy}", clean_start=False)
+        await sub.connect("127.0.0.1", port)
+        await sub.subscribe(("dur/#", 1))
+        await sub.disconnect()          # offline: every publish -> inflight
+        pub = MQTTClient(client_id=f"dur-pub-{policy}")
+        await pub.connect("127.0.0.1", port)
+        lat: list[float] = []
+
+        async def one(i: int) -> None:
+            t0 = time.perf_counter()
+            await pub.publish(f"dur/{i % 50}", payload, qos=1, timeout=30.0)
+            lat.append(time.perf_counter() - t0)
+
+        await one(-1)                   # warm the path off the clock
+        lat.clear()                     # ...and off the latency stats
+        # PUBACK-paced depth 1: the per-MESSAGE durability price — under
+        # `always` every publish waits its own commit+fsync barrier;
+        # under `batched`/`off` the ack releases at loop speed. This is
+        # the headline policy comparison (the acceptance bar).
+        t0 = time.perf_counter()
+        for i in range(msgs):
+            await one(i)
+        paced_span = time.perf_counter() - t0
+        paced_lat = sorted(lat)
+        # pipelined window: `window` concurrent publishers — group
+        # commit amortizes the fsync across the window, which is how
+        # `always` stays viable at fan-in (the Pulsar-study lever)
+        lat.clear()
+        t0 = time.perf_counter()
+        for base in range(0, msgs, window):
+            await asyncio.gather(*(one(i) for i in
+                                   range(base, min(base + window, msgs))))
+        piped_span = time.perf_counter() - t0
+        d = {"policy": policy,
+             "qos1_msgs_per_sec": round(msgs / paced_span, 1),
+             "mean_ack_ms": round(
+                 sum(paced_lat) / len(paced_lat) * 1e3, 3),
+             "p99_ack_ms": round(
+                 paced_lat[int(len(paced_lat) * 0.99)] * 1e3, 3),
+             "qos1_pipelined_msgs_per_sec": round(msgs / piped_span, 1),
+             "commits": store.commits,
+             "ops_per_commit": round(
+                 store.ops_written / max(store.commits, 1), 1),
+             "barrier_waits": b.storage_barrier_waits}
+        await pub.disconnect()
+        await b.close()
+        return d
+
+    def measure_recovery() -> dict:
+        """SIGKILL a loaded subprocess broker; time restart->CONNACK."""
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        path = os.path.join(workdir, "recovery.db")
+        script = ("import asyncio, os\n"
+                  "from maxmq_tpu.bootstrap import "
+                  "new_logger_from_config, run_server\n"
+                  "from maxmq_tpu.utils.config import load_config\n"
+                  "conf = load_config(path=None, env=os.environ)\n"
+                  "asyncio.run(run_server("
+                  "conf, new_logger_from_config(conf)))\n")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = (os.path.dirname(os.path.abspath(__file__))
+                             + os.pathsep + env.get("PYTHONPATH", ""))
+        env.update(MAXMQ_MQTT_TCP_ADDRESS=f"127.0.0.1:{port}",
+                   MAXMQ_STORAGE_BACKEND="sqlite",
+                   MAXMQ_STORAGE_PATH=path,
+                   MAXMQ_STORAGE_SYNC="always",
+                   MAXMQ_METRICS_ENABLED="false", MAXMQ_MATCHER="trie",
+                   MAXMQ_MQTT_SYS_TOPIC_INTERVAL="0",
+                   MAXMQ_LOG_LEVEL="error", JAX_PLATFORMS="cpu")
+        env.pop("MAXMQ_FAULTS", None)
+
+        async def connack_ok(timeout_s: float) -> float:
+            t0 = time.perf_counter()
+            deadline = t0 + timeout_s
+            while time.perf_counter() < deadline:
+                c = MQTTClient(client_id="dur-probe")
+                try:
+                    await c.connect("127.0.0.1", port, timeout=1.0)
+                    await c.disconnect()
+                    return time.perf_counter() - t0
+                except Exception:
+                    await asyncio.sleep(0.02)
+            raise TimeoutError("no CONNACK within deadline")
+
+        async def preload() -> None:
+            sub = MQTTClient(client_id="dur-rec-sub", clean_start=False)
+            await sub.connect("127.0.0.1", port)
+            await sub.subscribe(("rec/#", 1))
+            await sub.disconnect()
+            pub = MQTTClient(client_id="dur-rec-pub")
+            await pub.connect("127.0.0.1", port)
+            for i in range(200):
+                await pub.publish(f"rec/{i % 20}", payload, qos=1,
+                                  retain=(i % 5 == 0), timeout=30.0)
+            await pub.disconnect()
+
+        proc = subprocess.Popen([sys.executable, "-c", script], env=env)
+        try:
+            asyncio.run(connack_ok(30.0))
+            asyncio.run(preload())
+        finally:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+        proc = subprocess.Popen([sys.executable, "-c", script], env=env)
+        try:
+            recovery_s = asyncio.run(connack_ok(30.0))
+        finally:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+        return {"recovery_to_first_connack_s": round(recovery_s, 3),
+                "preloaded_qos1_msgs": 200}
+
+    try:
+        d: dict = {"config": "durable", "messages": msgs,
+                   "pipeline_window": window,
+                   "policies": [asyncio.run(measure_policy(p))
+                                for p in ("always", "batched", "off")]}
+        d.update(measure_recovery())
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    by = {row["policy"]: row for row in d["policies"]}
+    d["batched_vs_always_speedup"] = round(
+        by["batched"]["qos1_msgs_per_sec"]
+        / max(by["always"]["qos1_msgs_per_sec"], 1e-9), 2)
+    log(f"[durable] always={by['always']['qos1_msgs_per_sec']}/s "
+        f"(ack {by['always']['mean_ack_ms']}ms) "
+        f"batched={by['batched']['qos1_msgs_per_sec']}/s "
+        f"(ack {by['batched']['mean_ack_ms']}ms) "
+        f"off={by['off']['qos1_msgs_per_sec']}/s "
+        f"speedup={d['batched_vs_always_speedup']}x "
+        f"recovery={d['recovery_to_first_connack_s']}s")
+    return d
+
+
 def bench_cluster_federation(msgs: int = 400) -> dict:
     """ADR-013 federation measurement (MAXMQ_BENCH_CONFIGS=cluster):
     three in-process broker nodes in a line topology A-B-C with real
@@ -1831,6 +2010,11 @@ def main() -> None:
         # ADR-012 host-path ladder: healthy vs shedding (stalled
         # consumer + CONNECT storm) vs recovered broker throughput
         runs.append(("overload", lambda: bench_overload()))
+    if "durable" in which:
+        # ADR-014 storage pipeline: QoS1 throughput/ack latency under
+        # storage_sync always vs batched vs off + kill-recovery time
+        runs.append(("durable",
+                     lambda: bench_durable(msgs=max(64, int(600 * scale)))))
     if "cluster" in which:
         # ADR-013 federation: 3-node line topology over real bridge
         # links — local vs 1-hop vs 2-hop throughput/latency + route
@@ -1922,7 +2106,7 @@ CONFIG_DEADLINES = {"1": 900, "2": 900, "3": 1200, "4": 2400,
                     "4h": 2400, "lat": 900, "lath": 900, "latd": 900,
                     "latdo": 1200, "5": 2400, "e2e": 4200,
                     "widthab": 1200, "degraded": 1200, "overload": 900,
-                    "cluster": 900}
+                    "cluster": 900, "durable": 900}
 
 
 def run_supervised(which: list[str]) -> None:

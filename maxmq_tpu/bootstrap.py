@@ -161,6 +161,34 @@ def build_cluster(conf: Config, broker: Broker, logger: Logger | None = None):
     return manager
 
 
+def build_storage(conf: Config) -> "StorageHook | None":
+    """The ADR-014 persistence pipeline: backend store (SQLite opened
+    with the ``storage_sync``-derived synchronous pragma) behind a
+    write-behind journal, so hook writes never fsync on the event loop
+    and QoS acks can ride the durability barrier under ``always``."""
+    if not conf.storage_backend:
+        return None
+    from .hooks.journal import SQLITE_SYNC_BY_POLICY, WriteBehindStore
+    policy = conf.storage_sync
+    if policy not in SQLITE_SYNC_BY_POLICY:
+        raise ValueError(f"unknown storage_sync {policy!r} "
+                         f"(want always|batched|off)")
+    if conf.storage_backend == "memory":
+        inner = MemoryStore()
+    else:
+        inner = SQLiteStore(conf.storage_path,
+                            synchronous=SQLITE_SYNC_BY_POLICY[policy])
+    store = WriteBehindStore(
+        inner, policy=policy,
+        batch_ms=conf.storage_batch_ms,
+        batch_ops=conf.storage_batch_ops,
+        queue_bytes=conf.storage_queue_bytes,
+        breaker_threshold=conf.storage_breaker_threshold,
+        backoff_s=float(conf.storage_breaker_backoff_s),
+        backoff_max_s=float(conf.storage_breaker_backoff_max_s))
+    return StorageHook(store)
+
+
 def build_broker(conf: Config, logger: Logger) -> Broker:
     """Assemble a broker from config: capabilities, listeners, hooks,
     matcher. Mirrors internal/mqtt/server.go:38-118."""
@@ -172,10 +200,9 @@ def build_broker(conf: Config, logger: Logger) -> Broker:
         broker.add_hook(LedgerHook(Ledger.from_file(conf.auth_ledger)))
     else:
         broker.add_hook(AllowHook())
-    if conf.storage_backend:
-        store = (MemoryStore() if conf.storage_backend == "memory"
-                 else SQLiteStore(conf.storage_path))
-        broker.add_hook(StorageHook(store))
+    storage = build_storage(conf)
+    if storage is not None:
+        broker.add_hook(storage)
     if conf.mqtt_tcp_address:
         broker.add_listener(TCPListener("tcp", conf.mqtt_tcp_address,
                                         reuse_port=conf.workers > 1))

@@ -163,6 +163,11 @@ class Client:
         # outbound QoS packets parked on an exhausted send quota, FIFO;
         # released as acks return quota (see Broker._release_held)
         self.held_pids: deque[int] = deque()
+        # inbound QoS acks awaiting the storage durability barrier
+        # (ADR 014), FIFO: [MQTT-4.6.0-2] PUBACK order must match
+        # PUBLISH arrival order even when a later publish's barrier
+        # clears first (see Broker._ack_publish_durable)
+        self.pending_durable_acks: deque = deque()
         self.aliases: TopicAliases | None = None
         self.keepalive = 0
         self.requested_keepalive = 0

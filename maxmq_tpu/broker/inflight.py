@@ -18,6 +18,11 @@ class Inflight:
 
     def __init__(self, receive_maximum: int = 0, send_maximum: int = 0) -> None:
         self._messages: dict[int, Packet] = {}
+        # packet ids whose record is known to be in the persistence
+        # pipeline/store (written by the storage hook, or restored from
+        # it at boot) — lets resend-on-resume skip byte-identical
+        # journal rewrites (ADR 014)
+        self._stored: set[int] = set()
         self.maximum_receive = receive_maximum
         self.receive_quota = receive_maximum
         self.maximum_send = send_maximum
@@ -27,16 +32,29 @@ class Inflight:
         return len(self._messages)
 
     def set(self, packet: Packet) -> bool:
-        """Store/replace; True when the packet id was not present before."""
+        """Store/replace; True when the packet id was not present before.
+        A (re)set invalidates the stored marker: the persisted form no
+        longer matches until the storage hook rewrites it."""
         is_new = packet.packet_id not in self._messages
         self._messages[packet.packet_id] = packet
+        self._stored.discard(packet.packet_id)
         return is_new
 
     def get(self, packet_id: int) -> Packet | None:
         return self._messages.get(packet_id)
 
     def delete(self, packet_id: int) -> bool:
+        self._stored.discard(packet_id)
         return self._messages.pop(packet_id, None) is not None
+
+    # -- persistence markers (ADR 014) --------------------------------------
+
+    def note_stored(self, packet_id: int) -> None:
+        if packet_id in self._messages:
+            self._stored.add(packet_id)
+
+    def stored(self, packet_id: int) -> bool:
+        return packet_id in self._stored
 
     def all(self) -> list[Packet]:
         """Inflight packets ordered by creation time (for resend-on-resume)."""
@@ -45,6 +63,7 @@ class Inflight:
     def clone(self) -> "Inflight":
         other = Inflight(self.maximum_receive, self.maximum_send)
         other._messages = {k: v.copy() for k, v in self._messages.items()}
+        other._stored = set(self._stored)
         return other
 
     # -- quotas (clamped to maxima) -----------------------------------------

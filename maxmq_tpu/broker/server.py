@@ -151,6 +151,13 @@ class Broker:
         # cluster federation manager (ADR 013); attached via
         # attach_cluster, started/stopped with the broker lifecycle
         self.cluster = None
+        # crash-consistent storage pipeline (ADR 014): the storage
+        # hook/journal discovered at serve(); under storage_sync=always
+        # QoS acks release through the journal's durability barrier
+        self._storage_hook = None
+        self._journal = None
+        self.boot_epoch = 0             # persisted monotonic boot counter
+        self.storage_barrier_waits = 0  # acks that waited on a barrier
         self._running = False
         self.loop: asyncio.AbstractEventLoop | None = None
 
@@ -200,6 +207,12 @@ class Broker:
     async def serve(self) -> None:
         self.loop = asyncio.get_running_loop()
         self._running = True
+        # ADR 014: find the persistence hook (and its write-behind
+        # journal, if it rides one) before restore — the durability
+        # barrier and boot-epoch bump both hang off it
+        self._storage_hook = next(
+            (h for h in self.hooks if hasattr(h, "bump_boot_epoch")), None)
+        self._journal = getattr(self._storage_hook, "journal", None)
         await self._restore_from_storage()
         await self._compile_matcher_tables()
         if self.capabilities.connect_rate > 0:
@@ -624,8 +637,9 @@ class Broker:
             await self._process_cluster_inbound(client, packet)
             return
         if not self.hooks.any_allow("on_acl_check", client, packet.topic, True):
-            # [MQTT-3.3.5-2]: ack but do not deliver
-            self._ack_publish(client, packet, success=False)
+            # [MQTT-3.3.5-2]: ack but do not deliver (behind any acks
+            # still parked on a durability barrier, [MQTT-4.6.0-2])
+            self._ack_publish_ordered(client, packet, success=False)
             return
         if not self._check_publish_qos(client, packet):
             return  # QoS2 dedup re-acked without re-delivery
@@ -633,23 +647,48 @@ class Broker:
         try:
             packet = self.hooks.modify("on_publish", packet, client)
         except RejectPacket as r:
-            self._ack_publish(client, packet, success=r.ack_success)
+            self._ack_publish_ordered(client, packet, success=r.ack_success)
             return
 
         self.info.messages_received += 1
         if packet.fixed.retain:
             self.retain_message(client, packet)
-        self._ack_publish(client, packet, success=True)
+        await self._route_publish(client, packet)
+
+    async def _route_publish(self, client: Client, packet: Packet) -> None:
+        """Ack + fan out an accepted publish. Durability barrier
+        (ADR 014, storage_sync=always): the QoS ack must cover the
+        publish's storage writes — and those are enqueued by the
+        FAN-OUT (inflight records for QoS subscribers) as well as the
+        retain rewrite — so under a barrier the ack moves after fan-out
+        and releases on the journal's commit."""
+        durable = (packet.fixed.qos > 0 and not client.inline
+                   and self._journal is not None
+                   and self._journal.barrier_needed)
+        if not durable:
+            self._ack_publish(client, packet, success=True)
+        elif packet.fixed.qos == 2:
+            # the QoS2 dedup window opens NOW, not when the barrier
+            # resolves: a client that times out and retransmits the
+            # same id mid-barrier must be deduped, not redelivered
+            # (_ack_publish re-adds on send — a set, idempotent)
+            client.pubrec_inbound.add(packet.packet_id)
         if self.matcher is None:
-            self._fan_out(self._match_cached(packet.topic), packet)
-            self.hooks.notify("on_published", client, packet)
+            subscribers = self._match_cached(packet.topic)
+            if durable:
+                # shared with the pipeline consumer: fan-out failures
+                # are logged, and the ack STILL releases durably
+                self._pub_deliver(subscribers, client, packet, True)
+            else:
+                self._fan_out(subscribers, packet)
+                self.hooks.notify("on_published", client, packet)
         else:
             # pipelined: dispatch the match NOW, fan out in arrival order
             # from the consumer task. The read loop returns immediately,
             # so a single connection can keep thousands of publishes in
             # flight — that in-flight depth is what lets the MicroBatcher
             # form device-sized batches instead of per-connection pairs.
-            await self._enqueue_publish(client, packet)
+            await self._enqueue_publish(client, packet, durable_ack=durable)
 
     async def _process_cluster_inbound(self, client: Client,
                                        packet: Packet) -> None:
@@ -771,6 +810,48 @@ class Broker:
                 client.inflight.return_receive_quota()
             self._send_ack(client, PT.PUBREC, packet, reason)
 
+    def _ack_publish_durable(self, client: Client, packet: Packet) -> None:
+        """Release the success ack through the journal's durability
+        barrier (ADR 014, ``storage_sync=always``): PUBACK/PUBREC go
+        out only once every storage write this publish enqueued —
+        retained rewrite + per-subscriber inflight records — has been
+        group-committed. The event loop never waits: the barrier is a
+        future resolved from the writer thread. A degraded journal
+        (breaker open) returns no barrier — a dead disk must not wedge
+        every QoS1 publisher.
+
+        Acks drain through a per-client FIFO: a later publish whose
+        barrier clears first (or that needed none) must not overtake an
+        earlier ack still waiting [MQTT-4.6.0-2]."""
+        jr = self._journal
+        fut = jr.barrier(self.loop) if jr is not None else None
+        if fut is None and not client.pending_durable_acks:
+            self._ack_publish(client, packet, success=True)
+            return
+        client.pending_durable_acks.append((fut, packet, True))
+        if fut is None:
+            self._drain_durable_acks(client)
+        else:
+            self.storage_barrier_waits += 1
+            fut.add_done_callback(
+                lambda _f: self._drain_durable_acks(client))
+
+    def _ack_publish_ordered(self, client: Client, packet: Packet,
+                             success: bool) -> None:
+        """A barrier-free ack (ACL refusal, rejected publish) that must
+        still honor per-client ack order: if earlier acks are parked on
+        a barrier, queue behind them instead of overtaking."""
+        if not client.pending_durable_acks:
+            self._ack_publish(client, packet, success)
+            return
+        client.pending_durable_acks.append((None, packet, success))
+
+    def _drain_durable_acks(self, client: Client) -> None:
+        q = client.pending_durable_acks
+        while q and (q[0][0] is None or q[0][0].done()):
+            _fut, packet, success = q.popleft()
+            self._ack_publish(client, packet, success=success)
+
     def _send_ack(self, client: Client, ptype: int, packet: Packet,
                   reason: int) -> None:
         """QoS acks run once per QoS>0 publish: a success ack is a fixed
@@ -803,10 +884,13 @@ class Broker:
     # offending connection's read loop instead of growing without limit
     PUB_PIPELINE_BOUND = 8192
 
-    async def _enqueue_publish(self, client: Client, packet: Packet) -> None:
+    async def _enqueue_publish(self, client: Client, packet: Packet,
+                               durable_ack: bool = False) -> None:
         """Matcher-mode publish path: start the match immediately (the
         batcher coalesces concurrent ones into device batches) and queue
-        the (future, packet) pair for the in-order fan-out consumer."""
+        the (future, packet) pair for the in-order fan-out consumer.
+        ``durable_ack`` carries the ADR-014 barrier obligation: the
+        consumer acks after fan-out, through the journal barrier."""
         if self._pub_consumer is None:
             if not self._running:
                 # late publish after close() tore the pipeline down (the
@@ -814,12 +898,14 @@ class Broker:
                 # serve it synchronously off the CPU trie
                 self._fan_out(self.topics.subscribers(packet.topic), packet)
                 self.hooks.notify("on_published", client, packet)
+                if durable_ack:
+                    self._ack_publish_durable(client, packet)
                 return
             self._pub_queue = asyncio.Queue(maxsize=self.PUB_PIPELINE_BOUND)
             self._pub_consumer = self.loop.create_task(
                 self._pub_pipeline_loop(), name="publish-pipeline")
         await self._pub_queue.put((self._dispatch_match(packet.topic),
-                                   client, packet))
+                                   client, packet, durable_ack))
 
     def _dispatch_match(self, topic: str) -> asyncio.Future:
         enq = getattr(self.matcher, "enqueue", None)
@@ -832,7 +918,7 @@ class Broker:
         result, fan out, fire on_published. A matcher failure degrades
         that one publish to the CPU trie — delivery never silently drops."""
         while True:
-            fut, client, packet = await self._pub_queue.get()
+            fut, client, packet, durable_ack = await self._pub_queue.get()
             try:
                 try:
                     subscribers = await fut
@@ -854,20 +940,31 @@ class Broker:
                             "matcher failed; trie fallback",
                             topic=packet.topic, error=repr(exc))
                     subscribers = self.topics.subscribers(packet.topic)
-                try:
-                    self._fan_out(subscribers, packet)
-                    if client is not None:
-                        self.hooks.notify("on_published", client, packet)
-                except Exception as exc:
-                    # a raising hook must cost this publish, not the
-                    # consumer: a dead consumer would wedge every
-                    # matcher-mode publisher behind a full queue
-                    if self.log is not None:
-                        self.log.with_prefix("broker").error(
-                            "publish fan-out failed", topic=packet.topic,
-                            error=repr(exc))
+                self._pub_deliver(subscribers, client, packet, durable_ack)
             finally:
                 self._pub_queue.task_done()
+
+    def _pub_deliver(self, subscribers, client, packet: Packet,
+                     durable_ack: bool) -> None:
+        """One pipeline delivery: fan out, notify, and (under the
+        ADR-014 barrier) release the publisher's ack durably."""
+        try:
+            self._fan_out(subscribers, packet)
+            if client is not None:
+                self.hooks.notify("on_published", client, packet)
+        except Exception as exc:
+            # a raising hook must cost this publish, not the
+            # consumer: a dead consumer would wedge every
+            # matcher-mode publisher behind a full queue
+            if self.log is not None:
+                self.log.with_prefix("broker").error(
+                    "publish fan-out failed", topic=packet.topic,
+                    error=repr(exc))
+        if durable_ack and client is not None:
+            # even after a failed fan-out the ack must release (the
+            # barrier covers what DID get written) or the publisher
+            # wedges behind a PUBACK that never comes
+            self._ack_publish_durable(client, packet)
 
     async def publish_to_subscribers(self, packet: Packet) -> None:
         """Parity: v2/server.go:766-868. Matching goes through the pluggable
@@ -881,7 +978,8 @@ class Broker:
         if self.matcher is not None:
             if self._pub_consumer is not None:
                 await self._pub_queue.put(
-                    (self._dispatch_match(packet.topic), None, packet))
+                    (self._dispatch_match(packet.topic), None, packet,
+                     False))
                 return
             subscribers = await self._match_async(packet.topic)
         else:
@@ -1660,6 +1758,8 @@ class Broker:
         entries.update(self._sys_overload_entries())
         if self.cluster is not None:
             entries.update(self._sys_cluster_entries())
+        if self._storage_hook is not None:
+            entries.update(self._sys_storage_entries())
         for topic, value in entries.items():
             packet = Packet(fixed=FixedHeader(type=PT.PUBLISH, retain=True),
                             topic=topic, payload=str(value).encode(),
@@ -1692,6 +1792,36 @@ class Broker:
                 json.dumps(top_offenders(self.clients.all())),
         }
 
+    def _sys_storage_entries(self) -> dict:
+        """The ADR-014 storage-pipeline subtree: journal pressure,
+        commit health, breaker state, and what restore had to set
+        aside — readable from any MQTT client subscribed to $SYS."""
+        hook = self._storage_hook
+        entries = {
+            "$SYS/broker/storage/boot_epoch": self.boot_epoch,
+            "$SYS/broker/storage/quarantined": hook.quarantined,
+            "$SYS/broker/storage/journal_sheds": hook.journal_sheds,
+            "$SYS/broker/storage/barrier_waits": self.storage_barrier_waits,
+        }
+        jr = self._journal
+        if jr is not None:
+            entries.update({
+                "$SYS/broker/storage/policy": jr.policy,
+                "$SYS/broker/storage/queue_depth": jr.queue_depth,
+                "$SYS/broker/storage/queued_bytes": jr.queued_bytes_now,
+                "$SYS/broker/storage/commits": jr.commits,
+                "$SYS/broker/storage/commit_failures": jr.commit_failures,
+                "$SYS/broker/storage/breaker_state": jr.breaker_state,
+                "$SYS/broker/storage/degraded_seconds":
+                    round(jr.degraded_seconds, 3),
+                "$SYS/broker/storage/dirty": int(jr.dirty),
+            })
+        backing = jr.inner if jr is not None else hook.store
+        corruptions = getattr(backing, "corruptions", None)
+        if corruptions is not None:
+            entries["$SYS/broker/storage/corruptions"] = corruptions
+        return entries
+
     def _sys_cluster_entries(self) -> dict:
         """The ADR-013 federation subtree: link/route health at a
         glance from any MQTT client subscribed to $SYS."""
@@ -1713,6 +1843,30 @@ class Broker:
     # ------------------------------------------------------------------
 
     async def _restore_from_storage(self) -> None:
+        self._restore_sessions()
+        for rec in self.hooks.first_non_empty("stored_retained_messages"):
+            packet = rec.to_packet()
+            self.topics.retain(packet)
+            self._note_retained_expiry(packet)
+            self.info.retained += 1
+        for rec in self.hooks.first_non_empty("stored_inflight_messages"):
+            client = self.clients.get(rec.client_id)
+            if client is not None:
+                packet = rec.to_packet()
+                client.inflight.set(packet)
+                # restored FROM the store: resend-on-resume must not
+                # rewrite a byte-identical record (ADR 014)
+                client.inflight.note_stored(packet.packet_id)
+                self.info.inflight += 1
+        stored_info = self.hooks.first_non_empty("stored_sys_info")
+        if stored_info is not None:
+            for k in ("bytes_received", "bytes_sent", "messages_received",
+                      "messages_sent", "messages_dropped", "packets_received",
+                      "packets_sent", "clients_maximum", "clients_total"):
+                setattr(self.info, k, getattr(stored_info, k, 0))
+        self._bump_boot_epoch()
+
+    def _restore_sessions(self) -> None:
         for rec in self.hooks.first_non_empty("stored_clients"):
             client = Client(self, None, None, rec.listener)
             client.id = rec.client_id
@@ -1734,22 +1888,22 @@ class Broker:
             client = self.clients.get(rec.client_id)
             if client is not None:
                 client.subscriptions[rec.filter] = sub
-        for rec in self.hooks.first_non_empty("stored_retained_messages"):
-            packet = rec.to_packet()
-            self.topics.retain(packet)
-            self._note_retained_expiry(packet)
-            self.info.retained += 1
-        for rec in self.hooks.first_non_empty("stored_inflight_messages"):
-            client = self.clients.get(rec.client_id)
-            if client is not None:
-                client.inflight.set(rec.to_packet())
-                self.info.inflight += 1
-        stored_info = self.hooks.first_non_empty("stored_sys_info")
-        if stored_info is not None:
-            for k in ("bytes_received", "bytes_sent", "messages_received",
-                      "messages_sent", "messages_dropped", "packets_received",
-                      "packets_sent", "clients_maximum", "clients_total"):
-                setattr(self.info, k, getattr(stored_info, k, 0))
+
+    def _bump_boot_epoch(self) -> None:
+        """Persisted monotonic boot epoch (ADR 014): strictly increases
+        across restarts/kills; the cluster layer (ADR 013) adopts it in
+        place of wall-clock epochs. No storage hook (or a failed bump):
+        wall-clock ms keeps the pre-ADR-014 behavior."""
+        bump = getattr(self._storage_hook, "bump_boot_epoch", None)
+        if bump is not None:
+            try:
+                self.boot_epoch = bump()
+            except Exception as exc:
+                if self.log is not None:
+                    self.log.with_prefix("broker").error(
+                        "boot-epoch bump failed", error=repr(exc)[:200])
+        if not self.boot_epoch:
+            self.boot_epoch = int(time.time() * 1000)
 
     # non-PUBLISH packet dispatch (PUBLISH stays inline in
     # _process_packet: it is the only async handler and the hot path)

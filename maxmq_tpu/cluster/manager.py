@@ -88,6 +88,7 @@ class ClusterManager:
         self.routes = RouteTable(
             node_id, epoch if epoch is not None
             else int(time.time() * 1000))
+        self._epoch_pinned = epoch is not None
         self.membership = Membership(peers)
         self._link_kw = dict(node_id=node_id, qos=self.link_qos,
                              byte_budget=link_byte_budget,
@@ -123,6 +124,16 @@ class ClusterManager:
         if self._started:
             return
         self._started = True
+        # adopt the broker's PERSISTED monotonic boot epoch (ADR 014;
+        # closes the ADR-013 wall-clock limitation: a clock that steps
+        # backwards across a restart can no longer make peers swallow
+        # this incarnation's routes/messages as stale replays). Runs
+        # before any link starts, so no advertisement has carried the
+        # constructor's wall-clock fallback yet. An explicit epoch=
+        # constructor arg (tests) stays authoritative.
+        boot_epoch = getattr(self.broker, "boot_epoch", 0)
+        if boot_epoch and not self._epoch_pinned:
+            self.routes.epoch = boot_epoch
         # seed the aggregated local set from pre-existing (restored)
         # subscriptions; everything after flows through note_subscribe
         for filt, _cid, _sub, _group in \

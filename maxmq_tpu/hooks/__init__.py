@@ -2,6 +2,7 @@
 
 from .auth import ACLRule, AllowHook, AuthRule, Ledger, LedgerHook
 from .base import Hook, Hooks, RejectPacket
+from .journal import WriteBehindStore
 from .storage import (ClientRecord, MemoryStore, MessageRecord, SQLiteStore,
                       StorageHook, Store, SubscriptionRecord)
 
@@ -9,5 +10,5 @@ __all__ = [
     "ACLRule", "AllowHook", "AuthRule", "Ledger", "LedgerHook",
     "Hook", "Hooks", "RejectPacket",
     "ClientRecord", "MemoryStore", "MessageRecord", "SQLiteStore",
-    "StorageHook", "Store", "SubscriptionRecord",
+    "StorageHook", "Store", "SubscriptionRecord", "WriteBehindStore",
 ]

@@ -283,6 +283,8 @@ def register_broker_metrics(registry: Registry, broker) -> None:
     _register_overload_metrics(registry, broker)
     # cluster federation (ADR 013)
     _register_cluster_metrics(registry, broker)
+    # crash-consistent storage pipeline (ADR 014)
+    _register_storage_metrics(registry, broker)
 
 
 # per-peer link-series cardinality bound, mirroring the ADR-012
@@ -351,6 +353,95 @@ def _register_cluster_metrics(registry: Registry, broker) -> None:
         "maxmq_cluster_link_forwards_total", "counter",
         "Per-peer forwards enqueued; same cardinality bound",
         lambda: _peer_series(lambda lk: lk.forwards_sent))
+
+
+def _register_storage_metrics(registry: Registry, broker) -> None:
+    """ADR-014 storage-pipeline observability: journal pressure (queue
+    depth/bytes), group-commit health (latency, batch size, failures),
+    the degradation breaker, and what restore had to quarantine. Duck-
+    typed off the storage hook so custom Store implementations degrade
+    to the subset they expose."""
+    hook = next((h for h in broker.hooks
+                 if hasattr(h, "bump_boot_epoch")), None)
+    if hook is None:
+        return
+    registry.counter_func(
+        "maxmq_storage_quarantined_records_total",
+        "Torn/undecodable records set aside at restore instead of "
+        "aborting boot", lambda: hook.quarantined)
+    registry.counter_func(
+        "maxmq_storage_journal_sheds_total",
+        "QoS0-irrelevant journal rewrites shed while the broker was "
+        "load-shedding past the journal watermark",
+        lambda: hook.journal_sheds)
+    registry.counter_func(
+        "maxmq_storage_rewrites_skipped_total",
+        "Redundant inflight resend rewrites elided (record already in "
+        "the pipeline/store)", lambda: hook.rewrites_skipped)
+    registry.gauge_func(
+        "maxmq_storage_boot_epoch",
+        "Persisted monotonic boot counter (strictly increases across "
+        "restarts; adopted by the cluster layer)",
+        lambda: broker.boot_epoch)
+    registry.counter_func(
+        "maxmq_storage_barrier_waits_total",
+        "QoS acks released through the storage_sync=always durability "
+        "barrier", lambda: broker.storage_barrier_waits)
+    jr = getattr(hook, "journal", None)
+    backing = jr.inner if jr is not None else hook.store
+    if getattr(backing, "corruptions", None) is not None:
+        registry.counter_func(
+            "maxmq_storage_corruptions_total",
+            "Storage files that failed the open-time integrity check "
+            "and were moved aside + recreated",
+            lambda: backing.corruptions)
+    if jr is None:
+        return
+    for name, help_, fn in (
+            ("queue_depth", "Journal ops awaiting group commit",
+             lambda: jr.queue_depth),
+            ("queue_bytes", "Journal bytes awaiting group commit",
+             lambda: jr.queued_bytes_now),
+            ("breaker_state",
+             "Storage breaker state (0=closed, 1=open, 2=half-open)",
+             lambda: jr.breaker_state),
+            ("last_commit_seconds", "Duration of the last group commit",
+             lambda: jr.last_commit_s),
+            ("last_batch_ops", "Ops in the last group commit",
+             lambda: jr.last_batch_ops),
+            ("largest_batch_ops", "Largest group commit since start",
+             lambda: jr.largest_batch_ops),
+            ("dirty",
+             "1 when a write was lost or parked past its durability "
+             "promise (degraded-mode writes, shed rewrites)",
+             lambda: int(jr.dirty))):
+        registry.gauge_func(f"maxmq_storage_{name}", help_, fn)
+    for name, help_, fn in (
+            ("commits", "Group commits applied to the backend",
+             lambda: jr.commits),
+            ("commit_failures", "Group commits that failed (batch "
+             "parked and retried)", lambda: jr.commit_failures),
+            ("put_failures", "Writes dropped at the journal enqueue "
+             "boundary", lambda: jr.put_failures),
+            ("ops_written", "Individual ops committed to the backend",
+             lambda: jr.ops_written),
+            ("ops_coalesced", "Same-key writes merged in the journal "
+             "before commit", lambda: jr.coalesced),
+            ("queue_overflows", "Enqueues that landed past the journal "
+             "byte watermark", lambda: jr.overflows),
+            ("breaker_trips", "Times the storage breaker opened "
+             "(memory-backed degraded writes)", lambda: jr.breaker_trips),
+            ("breaker_recoveries", "Half-open reprobes that restored "
+             "the backend and replayed the parked journal",
+             lambda: jr.breaker_recoveries),
+            ("barriers_released_degraded", "Durability barriers "
+             "released undurable because the breaker opened",
+             lambda: jr.barriers_released_degraded),
+            ("commit_seconds", "Cumulative time in backend commits",
+             lambda: jr.commit_seconds_total),
+            ("degraded_seconds", "Cumulative wall time with the "
+             "storage breaker not closed", lambda: jr.degraded_seconds)):
+        registry.counter_func(f"maxmq_storage_{name}_total", help_, fn)
 
 
 def _register_overload_metrics(registry: Registry, broker) -> None:

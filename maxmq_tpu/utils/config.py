@@ -89,6 +89,18 @@ class Config:
     storage_backend: str = ""           # "" | memory | sqlite
     storage_path: str = "maxmq.db"
 
+    # -- crash-consistent storage pipeline (ADR 014) --------------------------
+    # durability policy: always = QoS acks release through a fsync
+    # barrier (group-committed); batched = one fsync per batch window
+    # (acks immediate, crash can lose the window); off = no fsync
+    storage_sync: str = "batched"
+    storage_batch_ms: int = 20          # group-commit window (batched/off)
+    storage_batch_ops: int = 512        # max ops per backend transaction
+    storage_queue_bytes: int = 4 << 20  # journal watermark; sheds above
+    storage_breaker_threshold: int = 5  # consecutive commit failures
+    storage_breaker_backoff_s: float = 1.0       # first reprobe delay
+    storage_breaker_backoff_max_s: float = 30.0  # backoff doubles to here
+
     # -- auth ---------------------------------------------------------------
     auth_ledger: str = ""               # path to rules (.json/.yaml); empty
                                         # = allow-all

@@ -1,0 +1,202 @@
+"""Kill-recovery harness (ADR 014): a REAL broker subprocess is
+SIGKILLed — no graceful close, no flush — and restarted on the same
+SQLite file. What `storage_sync=always` promises must hold against
+that: every PUBACKed QoS1 message survives, sessions/subscriptions/
+retained restore, a hand-torn record quarantines instead of aborting
+boot, and the persisted boot_epoch strictly increases across kills.
+
+The subprocess runs the production bootstrap (run_server) configured
+purely through MAXMQ_* env, with the trie matcher so boots stay in the
+hundreds of milliseconds. The publisher streams PUBACK-paced QoS1
+while the test kills the broker mid-stream — the acked set at kill
+time is exactly the durability obligation."""
+
+import asyncio
+import os
+import signal
+import socket
+import sqlite3
+import subprocess
+import sys
+import time
+
+from maxmq_tpu.mqtt_client import MQTTClient
+
+BROKER_SCRIPT = """
+import asyncio, os
+from maxmq_tpu.bootstrap import new_logger_from_config, run_server
+from maxmq_tpu.utils.config import load_config
+conf = load_config(path=None, env=os.environ)
+asyncio.run(run_server(conf, new_logger_from_config(conf)))
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_broker(tmp_path, db_path: str, port: int,
+                  sync: str = "always") -> subprocess.Popen:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(
+        MAXMQ_MQTT_TCP_ADDRESS=f"127.0.0.1:{port}",
+        MAXMQ_STORAGE_BACKEND="sqlite",
+        MAXMQ_STORAGE_PATH=db_path,
+        MAXMQ_STORAGE_SYNC=sync,
+        MAXMQ_METRICS_ENABLED="false",
+        MAXMQ_MATCHER="trie",
+        MAXMQ_MQTT_SYS_TOPIC_INTERVAL="0",
+        MAXMQ_LOG_LEVEL="error",
+        JAX_PLATFORMS="cpu",
+    )
+    env.pop("MAXMQ_FAULTS", None)       # a leaked arming must not leak in
+    return subprocess.Popen([sys.executable, "-c", BROKER_SCRIPT],
+                            env=env, cwd=str(tmp_path))
+
+
+async def _wait_ready(port: int, proc: subprocess.Popen,
+                      timeout: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        assert proc.poll() is None, \
+            f"broker subprocess died at boot (rc={proc.returncode})"
+        try:
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            w.close()
+            return
+        except OSError:
+            await asyncio.sleep(0.05)
+    raise AssertionError("broker subprocess never started accepting")
+
+
+def _kill(proc: subprocess.Popen) -> None:
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=10)
+
+
+def _read_kv(db_path: str, bucket: str) -> dict:
+    conn = sqlite3.connect(db_path)
+    try:
+        rows = conn.execute(
+            "SELECT key, value FROM kv WHERE bucket=?", (bucket,)).fetchall()
+        return dict(rows)
+    finally:
+        conn.close()
+
+
+async def test_sigkill_loses_no_pubacked_qos1(tmp_path):
+    """SIGKILL mid-QoS1-stream under storage_sync=always: restart on
+    the same file and every message that got a PUBACK is redelivered to
+    the offline persistent session; retained state survives too."""
+    db = str(tmp_path / "kill.db")
+    port = _free_port()
+    proc = _spawn_broker(tmp_path, db, port)
+    try:
+        await _wait_ready(port, proc)
+        sub = MQTTClient(client_id="kr-sub", clean_start=False)
+        await sub.connect("127.0.0.1", port)
+        await sub.subscribe(("kr/#", 1))
+        await sub.disconnect()
+
+        pub = MQTTClient(client_id="kr-pub")
+        await pub.connect("127.0.0.1", port)
+        await pub.publish("kr/ret", b"retained-state", qos=1, retain=True)
+
+        acked: list[int] = []
+
+        async def stream():
+            # PUBACK-paced: an entry lands in `acked` ONLY once the
+            # broker acknowledged — exactly the set that must survive
+            for i in range(5000):
+                try:
+                    await pub.publish("kr/q", f"m-{i}".encode(), qos=1,
+                                      timeout=3.0)
+                except Exception:
+                    return              # broker died mid-flight
+                acked.append(i)
+
+        streamer = asyncio.ensure_future(stream())
+        while len(acked) < 15 and not streamer.done():
+            await asyncio.sleep(0.005)
+        _kill(proc)                     # mid-stream, zero grace
+        await streamer
+        assert len(acked) >= 15
+    finally:
+        if proc.poll() is None:
+            _kill(proc)
+
+    proc = _spawn_broker(tmp_path, db, port)
+    try:
+        await _wait_ready(port, proc)
+        sub2 = MQTTClient(client_id="kr-sub", clean_start=False)
+        await sub2.connect("127.0.0.1", port)
+        # session + subscription restored (no re-SUBSCRIBE issued)
+        assert sub2.connack.session_present is True
+        got: set[bytes] = set()
+        while True:
+            try:
+                m = await sub2.next_message(timeout=2.0)
+            except asyncio.TimeoutError:
+                break
+            got.add(m.payload)
+        missing = {f"m-{i}".encode() for i in acked} - got
+        assert not missing, \
+            f"{len(missing)} PUBACKed QoS1 messages lost: {sorted(missing)[:5]}"
+        # retained message survived the kill
+        fresh = MQTTClient(client_id="kr-fresh")
+        await fresh.connect("127.0.0.1", port)
+        await fresh.subscribe(("kr/ret", 0))
+        m = await fresh.next_message(timeout=10)
+        assert m.payload == b"retained-state" and m.retain
+        await fresh.disconnect()
+        await sub2.disconnect()
+    finally:
+        if proc.poll() is None:
+            _kill(proc)
+
+
+test_sigkill_loses_no_pubacked_qos1._async_timeout = 120
+
+
+async def test_torn_record_quarantines_and_boot_epoch_increases(tmp_path):
+    """Three SIGKILL/restart cycles: the persisted boot_epoch strictly
+    increases every time, and a hand-torn record injected between boots
+    is quarantined (boot COMPLETES and serves) instead of aborting
+    restore."""
+    db = str(tmp_path / "epoch.db")
+    port = _free_port()
+    epochs: list[int] = []
+    for cycle in range(3):
+        proc = _spawn_broker(tmp_path, db, port)
+        try:
+            await _wait_ready(port, proc)
+            c = MQTTClient(client_id=f"ep-{cycle}", clean_start=False)
+            await c.connect("127.0.0.1", port)
+            if cycle == 0:
+                # state for later boots to restore through
+                await c.subscribe(("ep/#", 1))
+                await c.publish("ep/ret", b"keep", qos=1, retain=True)
+            await c.disconnect()
+        finally:
+            _kill(proc)
+        epochs.append(int(_read_kv(db, "meta")["boot_epoch"]))
+        if cycle == 0:
+            # hand-tear a record the next boot must quarantine
+            conn = sqlite3.connect(db)
+            conn.execute(
+                "INSERT INTO kv (bucket, key, value) VALUES (?, ?, ?)",
+                ("inflight", "ghost|9", '{"client_id": "ghost", "pa'))
+            conn.commit()
+            conn.close()
+    assert epochs[0] < epochs[1] < epochs[2], epochs
+    q = _read_kv(db, "quarantine")
+    assert "inflight|ghost|9" in q      # torn record set aside, counted
+
+
+test_torn_record_quarantines_and_boot_epoch_increases._async_timeout = 120
