@@ -67,6 +67,16 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def trace_stanza(tracer) -> dict:
+    """The ADR-015 ``trace`` stanza embedded in BENCH_*.json rows:
+    per-stage and per-QoS p50/p95/p99 from the pipeline tracer's
+    histograms, so the perf trajectory records tails, not just means."""
+    return {"sampled": tracer.sampled,
+            "slow_captured": tracer.slow_captured,
+            "stages": tracer.stage_quantiles(),
+            "e2e": tracer.e2e_quantiles()}
+
+
 def load_last_good() -> dict | None:
     try:
         with open(LAST_GOOD_PATH) as f:
@@ -1243,11 +1253,19 @@ def bench_degraded(n_subs: int = 100_000, batch: int = 8192,
     engine.subscribers_batch(batches[0])
     sup.subscribers_batch(batches[0])          # warm caches via the wrap
 
+    # ADR 015: per-batch match latency lands in a standalone tracer's
+    # match_device histogram, so this config's stanza reports the tail
+    # of the device/trie call the broker's match stage would see
+    from maxmq_tpu.trace import PipelineTracer
+    tracer = PipelineTracer(sample_n=1)
+
     def measure() -> float:
         t0 = time.perf_counter()
         n = 0
         for topics in batches:
+            b0 = time.perf_counter()
             n += len(sup.subscribers_batch(topics))
+            tracer.observe("match_device", time.perf_counter() - b0)
         return round(n / (time.perf_counter() - t0), 1)
 
     d: dict = {"config": "degraded_mode", "n_subs": n_subs,
@@ -1279,6 +1297,7 @@ def bench_degraded(n_subs: int = 100_000, batch: int = 8192,
     d["degraded_frac_of_healthy"] = round(
         d["degraded_topics_per_sec"] / max(d["healthy_topics_per_sec"],
                                            1e-9), 3)
+    d["trace"] = trace_stanza(tracer)
     log(f"[degraded] healthy={d['healthy_topics_per_sec']} "
         f"trie-only={d['degraded_topics_per_sec']} "
         f"recovered={d['recovered_topics_per_sec']} topics/s")
@@ -1409,6 +1428,15 @@ def bench_overload(n_clients: int = 8, msgs: int = 300) -> dict:
         d["recovered_msgs_per_sec"], d["recovered_delivered_frac"] = \
             await measure(msgs)
 
+        # ADR 015: a short fully-sampled round AFTER the measured
+        # phases (tracing stays off during them, so the headline
+        # numbers remain comparable to prior rounds) populates the
+        # per-stage histograms behind the trace stanza
+        b.tracer.sample_n = 1
+        await measure(min(msgs, 100))
+        b.tracer.sample_n = 0
+        d["trace"] = trace_stanza(b.tracer)
+
         over = b.overload
         d.update(connects_refused=over.connects_refused,
                  storm_refused_observed=refused,
@@ -1522,6 +1550,16 @@ def bench_durable(msgs: int = 600, window: int = 64) -> dict:
              "ops_per_commit": round(
                  store.ops_written / max(store.commits, 1), 1),
              "barrier_waits": b.storage_barrier_waits}
+        # ADR 015: short fully-sampled tail round AFTER the headline
+        # phases AND the commit/barrier diagnostics snapshot above, so
+        # neither the throughput numbers nor ops_per_commit include the
+        # traced publishes — the stanza shows where each policy's ack
+        # time goes (barrier vs fanout vs journal_commit)
+        b.tracer.sample_n = 1
+        for i in range(min(msgs, 50)):
+            await one(i)
+        b.tracer.sample_n = 0
+        d["trace"] = trace_stanza(b.tracer)
         await pub.disconnect()
         await b.close()
         return d
@@ -1721,6 +1759,14 @@ def bench_cluster_federation(msgs: int = 400) -> dict:
         d["join_convergence_s"] = round(await poll(
             lambda: bool(mgrs["C"].routes.nodes_for("bench/D/x")),
             30.0), 3)
+
+        # ADR 015: traced tail round on the publisher node (headline
+        # phases ran untraced) — the bridge span in node A's stanza is
+        # the forward-enqueue cost of each cross-node publish
+        brokers["A"].tracer.sample_n = 1
+        await measure(pub, subs["C"], "bench/C/t", min(msgs, 100))
+        brokers["A"].tracer.sample_n = 0
+        d["trace"] = trace_stanza(brokers["A"].tracer)
 
         d.update(
             forwards_sent=sum(m.forwards_sent for m in mgrs.values()),

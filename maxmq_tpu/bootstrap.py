@@ -63,6 +63,10 @@ def capabilities_from_config(conf: Config) -> Capabilities:
         stall_deadline_ms=conf.stall_deadline_ms,
         overload_high_water=float(conf.broker_overload_high_water),
         overload_low_water=float(conf.broker_overload_low_water),
+        # publish-path tracing (ADR 015)
+        trace_sample_n=conf.trace_sample_n,
+        trace_slow_ms=float(conf.trace_slow_ms),
+        trace_ring=conf.trace_ring,
     )
 
 
@@ -112,6 +116,10 @@ def build_matcher(conf: Config, broker: Broker):
     batcher = MicroBatcher(engine,
                            window_us=conf.matcher_batch_window_us,
                            max_batch=conf.matcher_max_batch)
+    # ADR 015: the batcher stamps dispatch/result marks on match
+    # futures when the broker's tracer is sampling, so per-publish
+    # traces split coalescing wait from device time
+    batcher.tracer = broker.tracer
     attach = batcher
     if conf.matcher_supervised:
         # ADR 011: per-batch deadline + trie hedge + circuit breaker
@@ -228,7 +236,8 @@ def build_metrics(conf: Config, broker: Broker,
     return MetricsServer(conf.metrics_address, registry,
                          path=conf.metrics_path,
                          profiling=conf.metrics_profiling,
-                         logger=logger.with_prefix("metrics"))
+                         logger=logger.with_prefix("metrics"),
+                         tracer=broker.tracer)
 
 
 def new_logger_from_config(conf: Config) -> Logger:

@@ -72,6 +72,12 @@ class OutboundQueue:
         self._getter: asyncio.Future | None = None
         self._overload = overload
         self.bytes = 0
+        # cumulative entry counters (ADR 015): a drain-span watcher
+        # registered at enqueue seq S is settled by the first flush
+        # whose removal count reaches S — not by whatever flush happens
+        # to complete next (which may predate S's delivery entirely)
+        self.enqueued = 0
+        self.removed = 0
 
     def qsize(self) -> int:
         return len(self._q)
@@ -81,6 +87,7 @@ class OutboundQueue:
             raise asyncio.QueueFull
         self._q.append((item, size))
         self.bytes += size
+        self.enqueued += 1
         if self._overload is not None:
             self._overload.note_put(size)
         g = self._getter
@@ -92,6 +99,7 @@ class OutboundQueue:
             raise asyncio.QueueEmpty
         item, size = self._q.popleft()
         self._account_out(size)
+        self.removed += 1
         return item
 
     async def get(self):
@@ -122,6 +130,7 @@ class OutboundQueue:
                 freed += size
                 dropped.append(item)
                 self._account_out(size)
+                self.removed += 1
             else:
                 kept.append((item, size))
         while kept:
@@ -196,6 +205,11 @@ class Client:
         self.dropped_msgs = 0
         self.dropped_bytes = 0
         self.drops_by_reason: dict[str, int] = {}
+        # ADR 015 drain watchers: (trace, enqueue_ns, enqueue_seq)
+        # triples the server registers for sampled deliveries; the
+        # writer loop settles each after the first flush that covers
+        # its seq (one branch per burst when empty)
+        self._drain_traces: list = []
 
     # ------------------------------------------------------------------
 
@@ -280,11 +294,20 @@ class Client:
         assert self.reader is not None
         buf = initial if initial is not None else bytearray()
         maxsize = self.server.capabilities.maximum_packet_size
+        tracer = self.server.tracer
         while not self.closed:
             for fh, body in parse_stream(buf, maxsize):
                 self.server.info.packets_received += 1
-                packet = Packet.decode(fh, body,
-                                       self.properties.protocol_version)
+                if tracer.sample_n and fh.type == PT.PUBLISH:
+                    # ADR 015: time the decode; process_publish folds
+                    # it into the trace when this publish is sampled
+                    t0 = tracer.clock()
+                    packet = Packet.decode(
+                        fh, body, self.properties.protocol_version)
+                    packet._decode_ns = tracer.clock() - t0
+                else:
+                    packet = Packet.decode(
+                        fh, body, self.properties.protocol_version)
                 await on_packet(self, packet)
                 if self.closed:
                     return
@@ -352,12 +375,18 @@ class Client:
                 else:
                     break                      # drained a None: stop
                 self.write_progress = time.monotonic()
+                # snapshot BEFORE awaiting: deliveries enqueued while
+                # drain() is in flight were not carried by this flush,
+                # so their ADR-015 watchers must wait for a later one
+                flushed = self.outbound.removed
                 # flow control: past the transport high-water mark this
                 # blocks until the consumer catches up, backpressuring
                 # into the byte-accounted queue where the stall detector
                 # and budgets can see it (ADR 012)
                 await self.writer.drain()
                 self.write_progress = time.monotonic()
+                if self._drain_traces:
+                    self._settle_drain_traces(flushed)
             await self._drain()
         except asyncio.CancelledError:
             pass
@@ -392,13 +421,35 @@ class Client:
                 # detector and stop_cause must see the dead writer
                 self.write_error = self.write_error or repr(exc)
 
+    def _settle_drain_traces(self, flushed: int) -> None:
+        """Close the ADR-015 drain watchers whose delivery the flush
+        that just completed actually carried — those registered at an
+        enqueue seq the writer has dequeued (seq <= ``flushed``).
+        Watchers for deliveries still sitting in the outbound queue
+        (burst byte-cap leftovers, enqueues racing an in-flight drain)
+        keep accruing real latency until their own flush."""
+        tracer = self.server.tracer
+        now = tracer.clock()
+        keep = []
+        for tr, t0, seq in self._drain_traces:
+            if seq <= flushed:
+                tracer.drain_span(tr, self.id, t0, now)
+            else:
+                keep.append((tr, t0, seq))
+        self._drain_traces = keep
+
     def note_drop(self, reason: str, n: int = 1, size: int = 0) -> None:
         """Per-client drop/stall accounting (ADR 012): what $SYS
-        top-offender reporting and the labelled metric read."""
+        top-offender reporting and the labelled metric read. Also feeds
+        the ADR-015 per-stage error counter, so write-path drops show
+        up next to the drain-stage latency they explain."""
         self.dropped_msgs += n
         self.dropped_bytes += size
         self.drops_by_reason[reason] = \
             self.drops_by_reason.get(reason, 0) + n
+        tracer = getattr(self.server, "tracer", None)
+        if tracer is not None:
+            tracer.note_error("drain", reason, n)
 
     def _refuse_publish(self, size: int) -> str | None:
         """Byte-budget admission for one queued PUBLISH delivery: free

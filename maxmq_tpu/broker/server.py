@@ -18,6 +18,7 @@ from dataclasses import dataclass, field
 
 from .. import faults
 from ..hooks.base import Hook, Hooks, RejectPacket
+from ..trace import MAX_DRAIN_SPANS, PipelineTracer
 from ..matching.topics import valid_filter, valid_topic_name
 from ..matching.trie import (SubscriberSet, TopicIndex,
                              VersionedTopicCache)
@@ -95,6 +96,11 @@ class Capabilities:
     overload_high_water: float = 0.8  # shed above budget * high_water
     overload_low_water: float = 0.5   # recover below budget * low_water
 
+    # -- publish-path tracing (ADR 015); sample_n = 0 disables ---------
+    trace_sample_n: int = 0           # trace every Nth publish
+    trace_slow_ms: float = 0.0        # flight-record only e2e >= this
+    trace_ring: int = 64              # flight-recorder entries kept
+
 
 @dataclass
 class BrokerOptions:
@@ -158,6 +164,14 @@ class Broker:
         self._journal = None
         self.boot_epoch = 0             # persisted monotonic boot counter
         self.storage_barrier_waits = 0  # acks that waited on a barrier
+        # publish-path tracer (ADR 015): always constructed — the
+        # stage-error counters are fed even with sampling off; span
+        # stamping is gated on tracer.sample_n at every site
+        self.tracer = PipelineTracer(
+            sample_n=self.capabilities.trace_sample_n,
+            slow_ms=self.capabilities.trace_slow_ms,
+            ring=self.capabilities.trace_ring)
+        self._sys_trace_topics: set[str] = set()  # retained while sampling
         self._running = False
         self.loop: asyncio.AbstractEventLoop | None = None
 
@@ -213,6 +227,10 @@ class Broker:
         self._storage_hook = next(
             (h for h in self.hooks if hasattr(h, "bump_boot_epoch")), None)
         self._journal = getattr(self._storage_hook, "journal", None)
+        if self._journal is not None:
+            # ADR 015: the writer thread feeds the journal_commit stage
+            # histogram + commit-failure stage errors through the tracer
+            self._journal.tracer = self.tracer
         await self._restore_from_storage()
         await self._compile_matcher_tables()
         if self.capabilities.connect_rate > 0:
@@ -624,6 +642,8 @@ class Broker:
 
     async def process_publish(self, client: Client, packet: Packet) -> None:
         """Parity: v2/server.go:674-754 (processPublish)."""
+        if self.tracer.sample_n:        # ADR 015: one branch when off
+            self._trace_begin(client, packet)
         packet.validate_publish()
         packet.protocol_version = client.properties.protocol_version
         packet.origin = client.id
@@ -655,6 +675,27 @@ class Broker:
             self.retain_message(client, packet)
         await self._route_publish(client, packet)
 
+    def _trace_begin(self, client: Client, packet: Packet) -> None:
+        """ADR 015: admit this publish into the sampling stride. The
+        read loop timed the decode (packet._decode_ns) when tracing was
+        on; the trace's start is backdated to the decode start so e2e
+        covers wire-bytes -> terminal stage."""
+        tracer = self.tracer
+        dec = packet.__dict__.pop("_decode_ns", 0)
+        now = tracer.clock()
+        tr = tracer.sample(packet.topic, packet.fixed.qos, client.id,
+                           start_ns=now - dec)
+        if tr is None:
+            return
+        if dec:
+            tr.span("decode", now - dec, now)
+        tr.t_admit = now
+        packet._trace = tr
+
+    def _packet_trace(self, packet: Packet):
+        return (packet.__dict__.get("_trace")
+                if self.tracer.sample_n else None)
+
     async def _route_publish(self, client: Client, packet: Packet) -> None:
         """Ack + fan out an accepted publish. Durability barrier
         (ADR 014, storage_sync=always): the QoS ack must cover the
@@ -662,11 +703,19 @@ class Broker:
         FAN-OUT (inflight records for QoS subscribers) as well as the
         retain rewrite — so under a barrier the ack moves after fan-out
         and releases on the journal's commit."""
+        tr = self._packet_trace(packet)
+        if tr is not None:
+            tr.span("admission", tr.t_admit, self.tracer.clock())
         durable = (packet.fixed.qos > 0 and not client.inline
                    and self._journal is not None
                    and self._journal.barrier_needed)
         if not durable:
-            self._ack_publish(client, packet, success=True)
+            if tr is None:
+                self._ack_publish(client, packet, success=True)
+            else:
+                t0 = self.tracer.clock()
+                self._ack_publish(client, packet, success=True)
+                tr.span("ack", t0, self.tracer.clock())
         elif packet.fixed.qos == 2:
             # the QoS2 dedup window opens NOW, not when the barrier
             # resolves: a client that times out and retransmits the
@@ -674,7 +723,12 @@ class Broker:
             # (_ack_publish re-adds on send — a set, idempotent)
             client.pubrec_inbound.add(packet.packet_id)
         if self.matcher is None:
-            subscribers = self._match_cached(packet.topic)
+            if tr is None:
+                subscribers = self._match_cached(packet.topic)
+            else:
+                t0 = self.tracer.clock()
+                subscribers = self._match_cached(packet.topic)
+                tr.span("match_device", t0, self.tracer.clock())
             if durable:
                 # shared with the pipeline consumer: fan-out failures
                 # are logged, and the ack STILL releases durably
@@ -682,6 +736,8 @@ class Broker:
             else:
                 self._fan_out(subscribers, packet)
                 self.hooks.notify("on_published", client, packet)
+                if tr is not None:
+                    self.tracer.finish(tr)
         else:
             # pipelined: dispatch the match NOW, fan out in arrival order
             # from the consumer task. The read loop returns immediately,
@@ -825,8 +881,11 @@ class Broker:
         earlier ack still waiting [MQTT-4.6.0-2]."""
         jr = self._journal
         fut = jr.barrier(self.loop) if jr is not None else None
+        tr = self._packet_trace(packet)
+        if tr is not None:
+            tr.t_barrier = self.tracer.clock()
         if fut is None and not client.pending_durable_acks:
-            self._ack_publish(client, packet, success=True)
+            self._ack_traced(client, packet, True, tr)
             return
         client.pending_durable_acks.append((fut, packet, True))
         if fut is None:
@@ -835,6 +894,23 @@ class Broker:
             self.storage_barrier_waits += 1
             fut.add_done_callback(
                 lambda _f: self._drain_durable_acks(client))
+
+    def _ack_traced(self, client: Client, packet: Packet, success: bool,
+                    tr) -> None:
+        """Release one (possibly traced) publish ack: the barrier span
+        closes when the ack is unparked, the ack span covers its wire
+        build/enqueue, and the trace finishes here — the publisher's
+        terminal stage."""
+        if tr is None:
+            self._ack_publish(client, packet, success=success)
+            return
+        tracer = self.tracer
+        now = tracer.clock()
+        if tr.t_barrier:
+            tr.span("barrier", tr.t_barrier, now)
+        self._ack_publish(client, packet, success=success)
+        tr.span("ack", now, tracer.clock())
+        tracer.finish(tr)
 
     def _ack_publish_ordered(self, client: Client, packet: Packet,
                              success: bool) -> None:
@@ -850,7 +926,8 @@ class Broker:
         q = client.pending_durable_acks
         while q and (q[0][0] is None or q[0][0].done()):
             _fut, packet, success = q.popleft()
-            self._ack_publish(client, packet, success=success)
+            self._ack_traced(client, packet, success,
+                             self._packet_trace(packet))
 
     def _send_ack(self, client: Client, ptype: int, packet: Packet,
                   reason: int) -> None:
@@ -904,8 +981,11 @@ class Broker:
             self._pub_queue = asyncio.Queue(maxsize=self.PUB_PIPELINE_BOUND)
             self._pub_consumer = self.loop.create_task(
                 self._pub_pipeline_loop(), name="publish-pipeline")
-        await self._pub_queue.put((self._dispatch_match(packet.topic),
-                                   client, packet, durable_ack))
+        fut = self._dispatch_match(packet.topic)
+        tr = self._packet_trace(packet)
+        if tr is not None:
+            tr.t_match = self.tracer.clock()
+        await self._pub_queue.put((fut, client, packet, durable_ack))
 
     def _dispatch_match(self, topic: str) -> asyncio.Future:
         enq = getattr(self.matcher, "enqueue", None)
@@ -935,14 +1015,45 @@ class Broker:
                     subscribers = self.topics.subscribers(packet.topic)
                 except Exception as exc:
                     self.matcher_degrades += 1
+                    self.tracer.note_error("match_device", "matcher_failed")
+                    tr = self._packet_trace(packet)
+                    if tr is not None:
+                        tr.degraded = "pipeline_trie"
                     if self.log is not None:
                         self.log.with_prefix("broker").error(
                             "matcher failed; trie fallback",
                             topic=packet.topic, error=repr(exc))
                     subscribers = self.topics.subscribers(packet.topic)
+                if self.tracer.sample_n:
+                    self._trace_match_spans(fut, packet)
                 self._pub_deliver(subscribers, client, packet, durable_ack)
             finally:
                 self._pub_queue.task_done()
+
+    def _trace_match_spans(self, fut, packet: Packet) -> None:
+        """ADR 015: decompose the matcher leg of one sampled publish.
+        The batcher stamps ``_t_dispatch``/``_t_done`` on the match
+        future (the supervisor forwards them), splitting coalescing
+        wait from device/trie time; whatever the consumer waited past
+        the result — in-order fan-out behind earlier publishes — is
+        the pipeline_wait segment."""
+        tr = packet.__dict__.get("_trace")
+        if tr is None or not tr.t_match:
+            return
+        tracer = self.tracer
+        now = tracer.clock()
+        td = getattr(fut, "_t_dispatch", 0)
+        tdone = getattr(fut, "_t_done", 0)
+        if td:
+            tr.span("match_queue", tr.t_match, td)
+            tr.span("match_device", td, tdone or now)
+        else:
+            tr.span("match_device", tr.t_match, tdone or now)
+        if tdone and now > tdone:
+            tr.span("pipeline_wait", tdone, now)
+        rung = getattr(self.matcher, "breaker_state_name", None)
+        if rung and rung != "closed":
+            tr.degraded = rung      # ADR-011 supervisor rung label
 
     def _pub_deliver(self, subscribers, client, packet: Packet,
                      durable_ack: bool) -> None:
@@ -956,6 +1067,7 @@ class Broker:
             # a raising hook must cost this publish, not the
             # consumer: a dead consumer would wedge every
             # matcher-mode publisher behind a full queue
+            self.tracer.note_error("fanout", "hook_error")
             if self.log is not None:
                 self.log.with_prefix("broker").error(
                     "publish fan-out failed", topic=packet.topic,
@@ -965,6 +1077,10 @@ class Broker:
             # barrier covers what DID get written) or the publisher
             # wedges behind a PUBACK that never comes
             self._ack_publish_durable(client, packet)
+        else:
+            tr = self._packet_trace(packet)
+            if tr is not None:
+                self.tracer.finish(tr)
 
     async def publish_to_subscribers(self, packet: Packet) -> None:
         """Parity: v2/server.go:766-868. Matching goes through the pluggable
@@ -989,10 +1105,22 @@ class Broker:
     def _fan_out(self, subscribers, packet: Packet) -> None:
         """Local fan-out + cluster forwarding (ADR 013). Every publish
         path funnels through here exactly once, so the route-table
-        consult happens once per publish regardless of matcher mode."""
+        consult happens once per publish regardless of matcher mode —
+        and the ADR-015 fanout/bridge spans are stamped once too."""
+        tr = self._packet_trace(packet)
+        if tr is None:
+            self._fan_out_local(subscribers, packet)
+            if self.cluster is not None:
+                self.cluster.maybe_forward(packet)
+            return
+        clock = self.tracer.clock
+        t0 = clock()
         self._fan_out_local(subscribers, packet)
+        t1 = clock()
+        tr.span("fanout", t0, t1)
         if self.cluster is not None:
             self.cluster.maybe_forward(packet)
+            tr.span("bridge", t1, clock())
 
     def _fan_out_local(self, subscribers, packet: Packet) -> None:
         """Sync fan-out half (no awaits): shared-group selection + per-
@@ -1125,6 +1253,19 @@ class Broker:
             if self.hooks.overrides("on_publish_dropped"):
                 self.hooks.notify("on_publish_dropped", client,
                                   self._delivery_form(packet, version))
+        elif self.tracer.sample_n:
+            self._trace_drain(client, packet)
+
+    def _trace_drain(self, client: Client, packet: Packet) -> None:
+        """ADR 015: register one subscriber's enqueue->flush watcher on
+        the ORIGINAL publish's trace (delivery copies don't alias it);
+        the client's writer task settles it after its next flush, so
+        the span crosses into the writer-task domain."""
+        tr = packet.__dict__.get("_trace")
+        if tr is not None and tr.n_drain < MAX_DRAIN_SPANS:
+            tr.n_drain += 1
+            client._drain_traces.append(
+                (tr, self.tracer.clock(), client.outbound.enqueued))
 
     def _publish_to_client(self, client_id: str, sub: Subscription,
                            packet: Packet, shared: bool) -> None:
@@ -1149,6 +1290,8 @@ class Broker:
             return  # queued in inflight for session resume
         if not client.send(out):
             self._count_refused_send(client, out)
+        elif self.tracer.sample_n:
+            self._trace_drain(client, packet)
 
     def _shed_qos0(self, client: Client, sub: Subscription,
                    packet: Packet) -> bool:
@@ -1760,6 +1903,18 @@ class Broker:
             entries.update(self._sys_cluster_entries())
         if self._storage_hook is not None:
             entries.update(self._sys_storage_entries())
+        if self.tracer.sample_n:
+            # ADR 015: the trace subtree appears only while sampling is
+            # on — an untraced broker's $SYS surface is unchanged
+            trace_entries = self.tracer.sys_entries()
+            entries.update(trace_entries)
+            self._sys_trace_topics = set(trace_entries)
+        elif self._sys_trace_topics:
+            # sampling just turned off: clear the subtree's retained
+            # entries (empty payload = retained clear) so stale values
+            # can't masquerade as live ones
+            entries.update((t, "") for t in self._sys_trace_topics)
+            self._sys_trace_topics = set()
         for topic, value in entries.items():
             packet = Packet(fixed=FixedHeader(type=PT.PUBLISH, retain=True),
                             topic=topic, payload=str(value).encode(),

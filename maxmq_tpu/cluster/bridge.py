@@ -242,19 +242,27 @@ class BridgeLink:
         wire = self._encode_publish(topic, payload, qos, False, pid)
         if (self.byte_budget
                 and self.outbound.bytes + len(wire) > self.byte_budget):
-            self.forwards_refused += 1
-            if qos > 0:
-                self._rollback_refused_ack(client, pid)
+            self._refuse_forward(client, pid, qos)
             return False
         try:
             self.outbound.put_nowait(wire, len(wire))
         except asyncio.QueueFull:
-            self.forwards_refused += 1
-            if qos > 0:
-                self._rollback_refused_ack(client, pid)
+            self._refuse_forward(client, pid, qos)
             return False
         self.forwards_sent += 1
         return True
+
+    def _refuse_forward(self, client: MQTTClient, pid: int,
+                        qos: int) -> None:
+        """One refused forward: count it, roll back a QoS1 ack entry,
+        and attribute it to the bridge stage on the ADR-015 error
+        counter so the loss shows up next to the bridge latency."""
+        self.forwards_refused += 1
+        tracer = getattr(self.manager.broker, "tracer", None)
+        if tracer is not None:
+            tracer.note_error("bridge", "refused")
+        if qos > 0:
+            self._rollback_refused_ack(client, pid)
 
     def _rollback_refused_ack(self, client: MQTTClient,
                               pid: int) -> None:

@@ -318,6 +318,9 @@ class ClusterManager:
             link = self.links.get(node)
             if link is None or not link.connected:
                 self.forwards_skipped_down += 1
+                tracer = getattr(self.broker, "tracer", None)
+                if tracer is not None:
+                    tracer.note_error("bridge", "link_down")
                 continue
             link.forward(envelope, packet.payload,
                          qos=min(packet.fixed.qos, self.link_qos))

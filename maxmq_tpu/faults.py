@@ -91,6 +91,14 @@ class FaultRegistry:
         # site -> FIFO of specs (so "raise twice then hang once" scripts)
         self._specs: dict[str, list[_Spec]] = {}
         self.fired: dict[str, int] = {}
+        # swappable monotonic-ns clock (ADR 015): the pipeline tracer
+        # reads every span timestamp through this indirection, so a
+        # test can install a scripted clock and get deterministic
+        # spans; restore with reset_clock()
+        self.clock_ns = time.monotonic_ns
+
+    def reset_clock(self) -> None:
+        self.clock_ns = time.monotonic_ns
 
     # -- arming --------------------------------------------------------
 

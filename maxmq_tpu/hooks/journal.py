@@ -164,6 +164,11 @@ class WriteBehindStore(Store):
         self._degraded_since = 0.0
         self._degraded_seconds = 0.0
 
+        # ADR 015: broker.serve() attaches its PipelineTracer here so
+        # the WRITER THREAD can feed the journal_commit stage histogram
+        # and attribute commit/put failures to the journal stage
+        self.tracer = None
+
         self._stopped = False
         self._final_probe_done = False
         self._thread = threading.Thread(
@@ -180,6 +185,8 @@ class WriteBehindStore(Store):
         except faults.InjectedFault:
             self.put_failures += 1
             self.dirty = True
+            if self.tracer is not None:
+                self.tracer.note_error("journal_commit", "put_failed")
             return
         self._enqueue(_OP_PUT, bucket, key, value)
 
@@ -422,6 +429,10 @@ class WriteBehindStore(Store):
             self._commit_failed(batch, exc)
             return
         dt = time.perf_counter() - t0
+        if self.tracer is not None:
+            # ADR 015: group-commit duration, observed from the writer
+            # thread (histogram-only: a commit covers many publishes)
+            self.tracer.observe("journal_commit", dt)
         with self._lock:
             self.committed_seq = max(self.committed_seq, batch[-1].seq)
             self.queued_bytes_now -= sum(op.size for op in batch)
@@ -442,6 +453,8 @@ class WriteBehindStore(Store):
             self._consecutive_failures = 0
 
     def _commit_failed(self, batch: list[_Op], exc: Exception) -> None:
+        if self.tracer is not None:
+            self.tracer.note_error("journal_commit", "commit_failed")
         with self._lock:
             # park the batch back at the FRONT, preserving op order; a
             # same-key write enqueued while the commit ran owns

@@ -25,6 +25,15 @@ def _cid(client) -> str:
     return getattr(client, "id", "") or "?"
 
 
+def _trace_fields(packet) -> dict:
+    """Correlation fields for publish-path events (ADR 015): when this
+    publish rode the sampled pipeline tracer, every log line about it
+    carries the same ``trace`` id the flight recorder / Chrome export
+    uses — grep one id across logs and /traces."""
+    tr = getattr(packet, "_trace", None)
+    return {"trace": tr.id} if tr is not None else {}
+
+
 class LoggingHook(Hook):
     """Logs every broker event at the same levels the reference uses:
     packet-level rx/tx at TRACE, protocol milestones at DEBUG/INFO,
@@ -97,16 +106,18 @@ class LoggingHook(Hook):
         self.log.debug("received PUBLISH", client=_cid(client),
                        topic=packet.topic, qos=packet.fixed.qos,
                        retain=packet.fixed.retain,
-                       bytes=len(packet.payload or b""))
+                       bytes=len(packet.payload or b""),
+                       **_trace_fields(packet))
         return packet
 
     def on_published(self, client, packet) -> None:
         self.log.debug("message published", client=_cid(client),
-                       topic=packet.topic)
+                       topic=packet.topic, **_trace_fields(packet))
 
     def on_publish_dropped(self, client, packet) -> None:
         self.log.warn("publish dropped (slow consumer)",
-                      client=_cid(client), topic=packet.topic)
+                      client=_cid(client), topic=packet.topic,
+                      **_trace_fields(packet))
 
     # -- retained -----------------------------------------------------------
     def on_retain_message(self, client, packet, stored: int) -> None:

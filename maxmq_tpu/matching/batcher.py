@@ -105,6 +105,11 @@ class MicroBatcher:
         self.bypasses = 0                 # topics served by the bypass
         self.errors = 0                   # batches whose engine call
                                           # raised (ADR 011 observability)
+        # ADR 015: when the broker's PipelineTracer is attached (see
+        # bootstrap.build_matcher) and sampling is on, match futures
+        # are stamped with dispatch/done clock marks so the tracer can
+        # split coalescing wait from device time; off = zero cost
+        self.tracer = None
 
     @property
     def device_rtt(self) -> float:
@@ -169,6 +174,20 @@ class MicroBatcher:
     def _fill_cache(self, version: int, batch, results) -> None:
         for (topic, _), result in zip(batch, results):
             self._cache.put(topic, version, result)
+
+    def _settle(self, version: int, batch, results) -> None:
+        """Cache + resolve one batch's futures, stamping the ADR-015
+        result-ready mark when tracing is on (the tracer's device span
+        ends at result-ready, not at the consumer's in-order await)."""
+        self._fill_cache(version, batch, results)
+        tracer = self.tracer
+        done_ns = (tracer.clock()
+                   if tracer is not None and tracer.sample_n else 0)
+        for (_, fut), result in zip(batch, results):
+            if not fut.done():
+                if done_ns:
+                    fut._t_done = done_ns
+                fut.set_result(result)
 
     async def subscribers_async(self, topic: str) -> "SubscriberSet":
         """Queue one match; resolves when its micro-batch returns."""
@@ -235,6 +254,11 @@ class MicroBatcher:
             self.batches += 1
             self.batched_topics += len(batch)
             self.largest_batch = max(self.largest_batch, len(batch))
+            tracer = self.tracer
+            if tracer is not None and tracer.sample_n:
+                now = tracer.clock()    # ADR 015: coalescing-wait ends
+                for _, fut in batch:
+                    fut._t_dispatch = now
             ver = self._subs_version()   # results valid as-of dispatch
             if self._should_bypass(len(batch)):
                 self._run_bypass(batch, topics, ver)
@@ -311,10 +335,7 @@ class MicroBatcher:
                                 time.perf_counter() - t0)
         self._since_probe += 1
         self.bypasses += len(topics)
-        self._fill_cache(ver, batch, results)
-        for (_, fut), result in zip(batch, results):
-            if not fut.done():
-                fut.set_result(result)
+        self._settle(ver, batch, results)
         if self._since_probe >= self.BYPASS_PROBE_EVERY:
             self._shadow_probe(topics)
 
@@ -395,10 +416,7 @@ class MicroBatcher:
                     fut.set_exception(exc)
             return
         self._note_rtt(time.perf_counter() - t0)
-        self._fill_cache(ver, batch, results)
-        for (_, fut), result in zip(batch, results):
-            if not fut.done():
-                fut.set_result(result)
+        self._settle(ver, batch, results)
 
     async def _dispatch_pipelined(self, loop, batch, topics, ver) -> None:
         """Dispatch now, collect in a bounded background task: up to
@@ -447,10 +465,7 @@ class MicroBatcher:
             await self._run_whole_batch(loop, batch, topics, ver)
             return
         self._note_rtt(time.perf_counter() - t0)
-        self._fill_cache(ver, batch, results)
-        for (_, fut), result in zip(batch, results):
-            if not fut.done():
-                fut.set_result(result)
+        self._settle(ver, batch, results)
 
     @staticmethod
     def _cancel_futures(batch) -> None:

@@ -381,6 +381,13 @@ class SupervisedMatcher:
                 self._settle_from_trie(out, topic, exc)
             else:
                 self._record_success(probe)
+                # forward the ADR-015 dispatch/done clock marks the
+                # batcher stamped on ITS future, so the tracer's
+                # queue/device split survives the supervisor wrapper
+                for attr in ("_t_dispatch", "_t_done"):
+                    v = getattr(f, attr, 0)
+                    if v:
+                        setattr(out, attr, v)
                 out.set_result(f.result())
 
         inner.add_done_callback(done)

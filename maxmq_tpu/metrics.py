@@ -16,6 +16,7 @@ snapshot when tracing is active).
 
 from __future__ import annotations
 
+import bisect
 import http.server
 import threading
 from typing import Callable
@@ -23,11 +24,65 @@ from typing import Callable
 from .utils.logger import Logger
 
 
+class Histogram:
+    """Fixed-bucket latency histogram (ADR 015): ``observe`` is a
+    bisect over a small tuple plus three int/float adds — cheap enough
+    for the publish hot path, and tear-free to the scrape thread under
+    the GIL (the SysInfo contract). Buckets are upper bounds in
+    ascending order; values past the last bound land in the implicit
+    ``+Inf`` overflow slot. Exposed by the Registry as the Prometheus
+    ``_bucket``/``_sum``/``_count`` triplet (cumulative counts)."""
+
+    __slots__ = ("buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets=None) -> None:
+        b = tuple(sorted(float(x) for x in
+                         (buckets or DEFAULT_LATENCY_BUCKETS)))
+        if not b:
+            raise ValueError("histogram needs at least one bucket")
+        self.buckets = b
+        self.counts = [0] * (len(b) + 1)   # per-bucket, last = +Inf
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        self.counts[bisect.bisect_left(self.buckets, v)] += 1
+        self.sum += v
+        self.count += 1
+
+    def quantile(self, q: float) -> float:
+        """Estimated q-quantile by linear interpolation inside the
+        owning bucket (the standard histogram_quantile estimate); the
+        overflow bucket clamps to the last finite bound."""
+        if self.count == 0:
+            return 0.0
+        target = q * self.count
+        acc = 0
+        lo = 0.0
+        for bound, n in zip(self.buckets, self.counts):
+            if n and acc + n >= target:
+                return lo + (bound - lo) * ((target - acc) / n)
+            acc += n
+            lo = bound
+        return self.buckets[-1]
+
+
+# 100us .. 10s: wide enough that both an in-process trie match (~20us
+# rides the first bucket) and a wedged fsync (seconds) land on the
+# resolved part of the curve
+DEFAULT_LATENCY_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0)
+
+
 class Metric:
     """A function-backed metric: value is read at scrape time. With
     ``multi`` the fn returns an iterable of (labels_dict, value) pairs —
     one metric family whose series set is computed per scrape (used for
-    the cardinality-bounded per-client overload offenders, ADR 012)."""
+    the cardinality-bounded per-client overload offenders, ADR 012).
+    Kind ``histogram`` is always multi-style: the fn returns
+    (labels_dict, Histogram) pairs (ADR 015)."""
 
     __slots__ = ("name", "kind", "help", "fn", "labels", "multi")
 
@@ -35,7 +90,7 @@ class Metric:
                  fn: Callable[[], float],
                  labels: dict[str, str] | None = None,
                  multi: bool = False) -> None:
-        assert kind in ("counter", "gauge")
+        assert kind in ("counter", "gauge", "histogram")
         self.name = name
         self.kind = kind
         self.help = help_
@@ -68,6 +123,14 @@ class Registry:
         with self._lock:
             self._metrics.append(Metric(name, kind, help_, fn, multi=True))
 
+    def histogram_func(self, name: str, help_: str, fn) -> None:
+        """A histogram family (ADR 015): ``fn`` returns an iterable of
+        (labels_dict, Histogram); each pair becomes one
+        ``_bucket``/``_sum``/``_count`` series set per scrape."""
+        with self._lock:
+            self._metrics.append(
+                Metric(name, "histogram", help_, fn, multi=True))
+
     def expose(self) -> str:
         with self._lock:
             metrics = list(self._metrics)
@@ -78,6 +141,14 @@ class Registry:
                 out.append(f"# HELP {m.name} {m.help}")
                 out.append(f"# TYPE {m.name} {m.kind}")
                 seen_header.add(m.name)
+            if m.kind == "histogram":
+                try:
+                    series = list(m.fn())
+                except Exception:
+                    continue
+                for labels, hist in series:
+                    _expose_histogram(out, m.name, labels, hist)
+                continue
             if m.multi:
                 try:
                     series = list(m.fn())
@@ -100,6 +171,28 @@ class Registry:
 
 def _fmt(v: float) -> str:
     return str(int(v)) if v == int(v) else repr(v)
+
+
+def _expose_histogram(out: list[str], name: str, labels: dict,
+                      hist: Histogram) -> None:
+    """One series set of the Prometheus histogram triplet: cumulative
+    ``_bucket{le=}`` counts ending at ``+Inf`` (== ``_count``), then
+    ``_sum`` and ``_count``. A snapshot of counts is taken first so a
+    concurrent observe() cannot make the cumulative run non-monotonic
+    mid-scrape."""
+    counts = list(hist.counts)
+    total = sum(counts)
+    lbl = dict(labels)
+    acc = 0
+    for bound, n in zip(hist.buckets, counts):
+        acc += n
+        lbl["le"] = _fmt(bound)
+        out.append(f"{name}_bucket{{{_lbl(lbl)}}} {acc}")
+    lbl["le"] = "+Inf"
+    out.append(f"{name}_bucket{{{_lbl(lbl)}}} {total}")
+    tail = f"{{{_lbl(labels)}}}" if labels else ""
+    out.append(f"{name}_sum{tail} {_fmt(hist.sum)}")
+    out.append(f"{name}_count{tail} {total}")
 
 
 def _lbl(labels: dict) -> str:
@@ -168,11 +261,13 @@ def _cpu_profile(seconds: float, interval: float = 0.005) -> str:
 
 
 class MetricsServer:
-    """Threaded HTTP server for /metrics and optional /debug/pprof/*."""
+    """Threaded HTTP server for /metrics, optional /debug/pprof/*, and
+    (when a tracer is attached, ADR 015) the flight-recorder endpoints
+    ``/traces`` (JSON) and ``/traces/chrome`` (Chrome trace_event)."""
 
     def __init__(self, address: str, registry: Registry,
                  path: str = "/metrics", profiling: bool = False,
-                 logger: Logger | None = None) -> None:
+                 logger: Logger | None = None, tracer=None) -> None:
         if not address or ":" not in address:
             raise ValueError(f"invalid metrics address {address!r}")
         host, _, port_s = address.rpartition(":")
@@ -182,6 +277,7 @@ class MetricsServer:
         self.path = path
         self.profiling = profiling
         self.logger = logger
+        self.tracer = tracer
         self._httpd: http.server.ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
@@ -191,13 +287,21 @@ class MetricsServer:
 
     def start(self) -> None:
         registry, path, profiling = self.registry, self.path, self.profiling
+        tracer = self.tracer
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
+                import json
                 target = self.path.split("?", 1)[0]
                 if target == path:
                     body = registry.expose().encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif tracer is not None and target == "/traces":
+                    body = json.dumps(tracer.report()).encode()
+                    ctype = "application/json"
+                elif tracer is not None and target == "/traces/chrome":
+                    body = json.dumps(tracer.chrome_events()).encode()
+                    ctype = "application/json"
                 elif profiling and target.startswith("/debug/pprof"):
                     body, ctype = self._pprof(target)
                 else:
@@ -285,6 +389,62 @@ def register_broker_metrics(registry: Registry, broker) -> None:
     _register_cluster_metrics(registry, broker)
     # crash-consistent storage pipeline (ADR 014)
     _register_storage_metrics(registry, broker)
+    # publish-path tracing (ADR 015)
+    _register_trace_metrics(registry, broker)
+
+
+# stage-error label cardinality bound: stages are a fixed set and
+# reasons a small enum, but the exposition page stays bounded even if a
+# future call site invents reasons dynamically
+STAGE_ERROR_SERIES = 32
+
+
+def _register_trace_metrics(registry: Registry, broker) -> None:
+    """ADR-015 pipeline-tracer observability: per-stage latency
+    histograms, per-QoS end-to-end histograms, the per-stage error
+    counter that puts fan-out/write-path drops next to their latency,
+    and the flight-recorder health gauges. Histogram families expose
+    every pipeline stage even before the first observation, so a
+    dashboard can template on the label set from boot."""
+    tracer = getattr(broker, "tracer", None)
+    if tracer is None:
+        return
+    registry.histogram_func(
+        "maxmq_broker_publish_stage_seconds",
+        "Per-stage latency of sampled publishes (ADR 015 span model; "
+        "see docs/observability.md for the stage glossary)",
+        lambda: [({"stage": s}, h)
+                 for s, h in sorted(tracer.stage_hist.items())])
+    registry.histogram_func(
+        "maxmq_broker_publish_e2e_seconds",
+        "End-to-end latency of sampled publishes (decode to terminal "
+        "stage) by inbound QoS",
+        lambda: [({"qos": str(q)}, h)
+                 for q, h in sorted(tracer.e2e_hist.items())])
+    registry.multi_func(
+        "maxmq_broker_stage_errors_total", "counter",
+        "Errors/drops attributed to a pipeline stage (write-path drops "
+        "land under stage=drain with their drops_by_reason reason); "
+        "cardinality bounded to STAGE_ERROR_SERIES series",
+        lambda: [({"stage": s, "reason": r}, n) for (s, r), n in
+                 sorted(tracer.stage_error_items())
+                 [:STAGE_ERROR_SERIES]])
+    registry.counter_func(
+        "maxmq_broker_trace_sampled_total",
+        "Publishes sampled into the pipeline tracer",
+        lambda: tracer.sampled)
+    registry.counter_func(
+        "maxmq_broker_trace_slow_total",
+        "Sampled publishes whose end-to-end latency exceeded "
+        "trace_slow_ms", lambda: tracer.slow_captured)
+    registry.gauge_func(
+        "maxmq_broker_trace_ring_depth",
+        "Flight-recorder entries currently held",
+        lambda: tracer.ring_depth)
+    registry.gauge_func(
+        "maxmq_broker_trace_sample_n",
+        "Publish sampling stride (0 = tracing off)",
+        lambda: tracer.sample_n)
 
 
 # per-peer link-series cardinality bound, mirroring the ADR-012

@@ -23,7 +23,7 @@ except ModuleNotFoundError:            # Python < 3.11
 @dataclass
 class Config:
     # -- logging (config.go: log block) -------------------------------------
-    log_format: str = "pretty"          # pretty | json
+    log_format: str = "pretty"          # json | text ("pretty" = text)
     log_level: str = "info"             # trace|debug|info|warn|error|fatal
     machine_id: int = 0                 # snowflake machine id, [0,1023]
 
@@ -84,6 +84,16 @@ class Config:
     cluster_max_hops: int = 3           # forwarded-publish hop ceiling
     cluster_link_byte_budget: int = 4 << 20  # per-link queued bytes; 0 off
     cluster_link_keepalive: float = 10.0     # bridge ping interval, seconds
+
+    # -- publish-path tracing (ADR 015) ---------------------------------------
+    # sample every Nth publish into the pipeline tracer (0 = off; off
+    # costs one branch per stage). Sampled publishes feed the per-stage
+    # latency histograms, the flight recorder (/traces, /traces/chrome
+    # on the metrics server) and $SYS/broker/trace/*.
+    trace_sample_n: int = 0
+    trace_slow_ms: float = 0.0          # flight-record only e2e >= this;
+                                        # 0 records every sampled publish
+    trace_ring: int = 64                # flight-recorder entries kept
 
     # -- persistence --------------------------------------------------------
     storage_backend: str = ""           # "" | memory | sqlite

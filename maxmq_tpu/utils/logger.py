@@ -61,6 +61,8 @@ class Logger:
     def __init__(self, out: TextIO | None = None, fmt: str = "pretty",
                  prefix: str = "", log_id_gen: Callable[[], int] | None = None,
                  color: bool | None = None) -> None:
+        if fmt == "text":
+            fmt = "pretty"      # config spelling: log_format = json|text
         if fmt not in ("pretty", "json"):
             raise ValueError(f"unknown log format {fmt!r}")
         self._out = out if out is not None else sys.stderr

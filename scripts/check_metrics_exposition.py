@@ -1,0 +1,268 @@
+#!/usr/bin/env python
+"""Prometheus text-format conformance check for the broker's /metrics.
+
+Scrapes a live broker's exposition page (``--url``) — or boots a fully
+wired broker + MetricsServer in-process (``--self-test``, the CI mode)
+— and validates what a real Prometheus scraper would choke on:
+
+* metric and label **names** match the Prometheus grammar;
+* label **values** are correctly quoted/escaped (one hostile
+  client-chosen id must corrupt one label, not the page — the ADR-012
+  escaping contract);
+* every sample's family has a ``# TYPE`` declared before it, with a
+  known type, and at most one HELP/TYPE pair per family;
+* **histograms** (ADR 015) are structurally sound: cumulative
+  ``_bucket`` counts are monotonically non-decreasing over ascending
+  ``le``, a ``+Inf`` bucket exists and equals ``_count``, and ``_sum``/
+  ``_count`` are present for every labelled series;
+* sample values parse as floats and no (name, labelset) appears twice.
+
+Exit status is the number of findings (0 = conformant), each printed
+as ``line N: problem``. tests/test_trace.py imports ``validate`` and
+runs it over the registry's exposition, so the checker itself is under
+test; the asyncio-debug CI lane runs ``--self-test`` against the full
+registered metric surface.
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import os
+import re
+import sys
+import urllib.request
+
+# runnable as `python scripts/check_metrics_exposition.py` from a repo
+# checkout (self-test imports the package)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)(?:\s+\d+)?$")
+LABEL_PAIR_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\["\\n])*)"(?:,|$)')
+
+KNOWN_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
+
+
+def parse_labels(raw: str) -> dict | None:
+    """Parse a label body; None = malformed (unescaped quote/backslash,
+    bad label name, trailing garbage)."""
+    if raw == "":
+        return {}
+    labels: dict[str, str] = {}
+    pos = 0
+    while pos < len(raw):
+        m = LABEL_PAIR_RE.match(raw, pos)
+        if m is None or m.start() != pos:
+            return None
+        labels[m.group(1)] = m.group(2)
+        pos = m.end()
+    return labels
+
+
+def _family(name: str) -> str:
+    """The TYPE-declared family a sample belongs to (histogram/summary
+    series append _bucket/_sum/_count to the family name)."""
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix):
+            return name[: -len(suffix)]
+    return name
+
+
+def _le_key(v: str) -> float:
+    return math.inf if v == "+Inf" else float(v)
+
+
+def validate(text: str) -> list[str]:
+    """All conformance findings for one exposition page, as
+    human-readable ``line N: ...`` strings (empty = conformant)."""
+    errors: list[str] = []
+    types: dict[str, str] = {}
+    helps: set[str] = set()
+    seen_series: set[tuple] = set()
+    # (family, labels-sans-le) -> list[(le, cumulative_count, lineno)]
+    buckets: dict[tuple, list] = {}
+    sums: set[tuple] = set()
+    counts: dict[tuple, float] = {}
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(None, 3)
+            if len(parts) < 3:
+                errors.append(f"line {lineno}: malformed HELP")
+                continue
+            if parts[2] in helps:
+                errors.append(
+                    f"line {lineno}: duplicate HELP for {parts[2]}")
+            helps.add(parts[2])
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4 or parts[3] not in KNOWN_TYPES:
+                errors.append(f"line {lineno}: malformed TYPE {line!r}")
+                continue
+            if parts[2] in types:
+                errors.append(
+                    f"line {lineno}: duplicate TYPE for {parts[2]}")
+            types[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue                     # free-form comment
+        m = SAMPLE_RE.match(line)
+        if m is None:
+            errors.append(f"line {lineno}: unparseable sample {line!r}")
+            continue
+        name, raw_labels, value = m.group(1), m.group(2), m.group(3)
+        if not METRIC_NAME_RE.match(name):
+            errors.append(f"line {lineno}: bad metric name {name!r}")
+        labels = parse_labels(raw_labels) if raw_labels is not None else {}
+        if labels is None:
+            errors.append(
+                f"line {lineno}: malformed/unescaped labels in {line!r}")
+            continue
+        for ln in labels:
+            if not LABEL_NAME_RE.match(ln):
+                errors.append(f"line {lineno}: bad label name {ln!r}")
+        try:
+            fval = float(value)
+        except ValueError:
+            errors.append(f"line {lineno}: non-numeric value {value!r}")
+            continue
+        family = _family(name)
+        ftype = types.get(family) or types.get(name)
+        if ftype is None:
+            errors.append(
+                f"line {lineno}: sample {name!r} has no TYPE declared")
+            continue
+        series_key = (name, tuple(sorted(labels.items())))
+        if series_key in seen_series:
+            errors.append(
+                f"line {lineno}: duplicate series {series_key}")
+        seen_series.add(series_key)
+        if ftype == "histogram":
+            base = tuple(sorted((k, v) for k, v in labels.items()
+                                if k != "le"))
+            if name.endswith("_bucket"):
+                if "le" not in labels:
+                    errors.append(
+                        f"line {lineno}: histogram bucket without le")
+                    continue
+                try:
+                    le = _le_key(labels["le"])
+                except ValueError:
+                    errors.append(
+                        f"line {lineno}: bad le value {labels['le']!r}")
+                    continue
+                buckets.setdefault((family, base), []).append(
+                    (le, fval, lineno))
+            elif name.endswith("_sum"):
+                sums.add((family, base))
+            elif name.endswith("_count"):
+                counts[(family, base)] = fval
+            else:
+                errors.append(
+                    f"line {lineno}: bare sample {name!r} in "
+                    f"histogram family {family!r}")
+
+    for (family, base), rows in buckets.items():
+        rows.sort(key=lambda r: r[0])
+        prev = -1.0
+        for le, cum, lineno in rows:
+            if cum < prev:
+                errors.append(
+                    f"line {lineno}: {family} bucket le={le} count "
+                    f"{cum} < previous bucket {prev} (non-monotonic)")
+            prev = cum
+        if not rows or rows[-1][0] != math.inf:
+            errors.append(f"{family}{dict(base)}: no +Inf bucket")
+        elif (family, base) in counts \
+                and rows[-1][1] != counts[(family, base)]:
+            errors.append(
+                f"{family}{dict(base)}: +Inf bucket {rows[-1][1]} != "
+                f"_count {counts[(family, base)]}")
+        if (family, base) not in sums:
+            errors.append(f"{family}{dict(base)}: missing _sum")
+        if (family, base) not in counts:
+            errors.append(f"{family}{dict(base)}: missing _count")
+    return errors
+
+
+def self_test() -> str:
+    """Boot a fully wired broker registry + MetricsServer on an
+    ephemeral port, generate enough state that every family (incl. the
+    ADR-015 histograms, the escaped offender labels, and a hostile
+    client id) has series, and return the scraped page."""
+    from maxmq_tpu.broker import Broker, BrokerOptions, Capabilities
+    from maxmq_tpu.hooks.journal import WriteBehindStore
+    from maxmq_tpu.hooks.storage import MemoryStore, StorageHook
+    from maxmq_tpu.metrics import (MetricsServer, Registry,
+                                   register_broker_metrics)
+
+    broker = Broker(BrokerOptions(capabilities=Capabilities(
+        sys_topic_interval=0, trace_sample_n=1)))
+    broker.add_hook(StorageHook(WriteBehindStore(MemoryStore())))
+    tracer = broker.tracer
+    for stage in ("fanout", "barrier", "journal_commit"):
+        tracer.observe(stage, 0.0012)
+        tracer.observe(stage, 0.4)
+    tr = tracer.sample("t/x", 1, 'evil"client\\id\n')
+    tr.span("admission", tr.start_ns, tr.start_ns + 1000)
+    tracer.finish(tr, tr.start_ns + 50_000)
+    tracer.note_error("drain", "queue_full")
+    # a hostile client id must survive the offender-label escaping
+    hostile = broker.new_inline_client('bad"id\\with\nnewline')
+    hostile.dropped_msgs = 3
+    hostile.drops_by_reason["byte_budget"] = 3
+    broker.clients.add(hostile)
+
+    registry = Registry()
+    register_broker_metrics(registry, broker)
+    server = MetricsServer("127.0.0.1:0", registry, tracer=tracer)
+    server.start()
+    try:
+        url = f"http://127.0.0.1:{server.bound_port}/metrics"
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            page = resp.read().decode()
+        # the trace endpoints must serve valid JSON while we're here
+        import json
+        for path in ("/traces", "/traces/chrome"):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.bound_port}{path}",
+                    timeout=5) as resp:
+                json.loads(resp.read().decode())
+    finally:
+        server.stop()
+    return page
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    mode = ap.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--url", help="scrape this /metrics URL")
+    mode.add_argument("--self-test", action="store_true",
+                      help="boot an in-process broker+metrics server "
+                           "and validate its page (CI mode)")
+    args = ap.parse_args(argv)
+    if args.url:
+        with urllib.request.urlopen(args.url, timeout=10) as resp:
+            page = resp.read().decode()
+    else:
+        page = self_test()
+    errors = validate(page)
+    for err in errors:
+        print(err, file=sys.stderr)
+    n_series = sum(1 for ln in page.splitlines()
+                   if ln and not ln.startswith("#"))
+    print(f"checked {n_series} series: "
+          f"{'OK' if not errors else f'{len(errors)} finding(s)'}")
+    return min(len(errors), 125)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,504 @@
+"""ADR-015 publish-path tracing suite: histogram bucket math + text
+exposition, deterministic sampling (incl. the zero-allocations-when-off
+contract), flight-recorder ring bounds and slow-threshold capture,
+Chrome trace_event export, span nesting across the event loop / writer
+thread / writer task / bridge boundaries on a real broker, the
+per-stage error counter, and the Prometheus conformance checker the CI
+lane runs (imported and exercised directly, so the tool is under test).
+"""
+
+import asyncio
+import importlib.util
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from test_broker_system import connect, running_broker
+
+from maxmq_tpu import faults
+from maxmq_tpu.broker import Broker, BrokerOptions, Capabilities, TCPListener
+from maxmq_tpu.hooks import AllowHook
+from maxmq_tpu.hooks.journal import WriteBehindStore
+from maxmq_tpu.hooks.storage import MemoryStore, StorageHook
+from maxmq_tpu.metrics import (Histogram, MetricsServer, Registry,
+                               register_broker_metrics)
+from maxmq_tpu.mqtt_client import MQTTClient
+from maxmq_tpu.trace import (CRITICAL_STAGES, MAX_DRAIN_SPANS,
+                             PipelineTracer, STAGES)
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+    faults.REGISTRY.reset_clock()
+
+
+async def poll(predicate, timeout: float = 5.0, what: str = ""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        await asyncio.sleep(0.02)
+    raise AssertionError(f"condition not reached in {timeout}s: {what}")
+
+
+def _checker():
+    """Import scripts/check_metrics_exposition.py as a module (scripts/
+    is not a package) so its validator is directly under test."""
+    path = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "scripts", "check_metrics_exposition.py")
+    spec = importlib.util.spec_from_file_location("_expo_check", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -- histogram units ---------------------------------------------------
+
+
+def test_histogram_bucket_math():
+    h = Histogram(buckets=(0.001, 0.01, 0.1))
+    for v in (0.0005, 0.001, 0.05, 5.0):
+        h.observe(v)
+    # per-bucket: le=0.001 takes 0.0005 AND the exact-bound 0.001
+    assert h.counts == [2, 0, 1, 1]
+    assert h.count == 4
+    assert h.sum == pytest.approx(5.0515)
+    # quantiles interpolate within the owning bucket; the overflow
+    # bucket clamps to the last finite bound
+    assert 0.0 < h.quantile(0.25) <= 0.001
+    assert 0.01 < h.quantile(0.74) <= 0.1
+    assert h.quantile(0.99) == 0.1
+
+
+def test_histogram_exposition_format():
+    reg = Registry()
+    h = Histogram(buckets=(0.001, 0.01))
+    for v in (0.0005, 0.005, 2.0):
+        h.observe(v)
+    reg.histogram_func("t_seconds", "help.",
+                       lambda: [({"stage": "x"}, h)])
+    text = reg.expose()
+    assert "# TYPE t_seconds histogram" in text
+    assert 't_seconds_bucket{stage="x",le="0.001"} 1' in text
+    assert 't_seconds_bucket{stage="x",le="0.01"} 2' in text
+    assert 't_seconds_bucket{stage="x",le="+Inf"} 3' in text
+    assert 't_seconds_count{stage="x"} 3' in text
+    assert 't_seconds_sum{stage="x"} 2.0055' in text
+
+
+# -- tracer units ------------------------------------------------------
+
+
+def _finished_trace(tracer, e2e_ns=1_000_000, topic="t/x", qos=0):
+    tr = tracer.sample(topic, qos, "c")
+    assert tr is not None
+    tr.span("admission", tr.start_ns, tr.start_ns + e2e_ns // 2)
+    tr.span("fanout", tr.start_ns + e2e_ns // 2, tr.start_ns + e2e_ns)
+    tracer.finish(tr, end_ns=tr.start_ns + e2e_ns)
+    return tr
+
+
+def test_sampling_stride_and_zero_alloc_counter():
+    tracer = PipelineTracer(sample_n=2)
+    got = [tracer.sample("t", 0, "c") for _ in range(10)]
+    assert sum(1 for tr in got if tr is not None) == 5
+    assert tracer.allocations == 5
+    off = PipelineTracer(sample_n=0)
+    assert all(off.sample("t", 0, "c") is None for _ in range(10))
+    assert off.allocations == 0 and off.sampled == 0
+
+
+def test_flight_recorder_ring_bounds():
+    tracer = PipelineTracer(sample_n=1, ring=4)
+    for _ in range(10):
+        _finished_trace(tracer)
+    assert tracer.ring_depth == 4
+    ids = [e["id"] for e in tracer.report()["entries"]]
+    assert ids == [7, 8, 9, 10]          # recency ring, oldest first
+
+
+def test_slow_threshold_capture_and_slowest_list():
+    tracer = PipelineTracer(sample_n=1, slow_ms=10.0, ring=8)
+    _finished_trace(tracer, e2e_ns=5_000_000)       # 5ms: under
+    assert tracer.ring_depth == 0 and tracer.slow_captured == 0
+    _finished_trace(tracer, e2e_ns=20_000_000)      # 20ms: captured
+    assert tracer.ring_depth == 1 and tracer.slow_captured == 1
+    entry = tracer.report()["entries"][0]
+    assert entry["slow"] is True
+    assert entry["e2e_ms"] == pytest.approx(20.0)
+    # the slowest-ever list survives ring churn and stays bounded
+    for ms in range(11, 30):
+        _finished_trace(tracer, e2e_ns=ms * 1_000_000)
+    slowest = tracer.report()["slowest"]
+    assert len(slowest) <= 8
+    assert slowest[-1]["e2e_ms"] == pytest.approx(29.0)
+    assert all(a["e2e_ms"] <= b["e2e_ms"]
+               for a, b in zip(slowest, slowest[1:]))
+
+
+def test_chrome_export_is_valid_trace_event_json():
+    tracer = PipelineTracer(sample_n=1)
+    _finished_trace(tracer, e2e_ns=3_000_000)
+    blob = json.dumps(tracer.chrome_events())
+    doc = json.loads(blob)
+    events = doc["traceEvents"]
+    assert events and all(e["ph"] == "X" for e in events)
+    names = {e["name"] for e in events}
+    assert "admission" in names and "fanout" in names
+    for e in events:
+        assert isinstance(e["ts"], int) and e["dur"] >= 1
+
+
+def test_fault_registry_clock_drives_spans():
+    """Deterministic-under-test contract: the tracer reads time through
+    faults.REGISTRY.clock_ns, so a scripted clock scripts the spans."""
+    t = [0]
+
+    def scripted():
+        t[0] += 1_000_000               # 1ms per observation
+        return t[0]
+
+    faults.REGISTRY.clock_ns = scripted
+    tracer = PipelineTracer(sample_n=1)
+    tr = tracer.sample("t", 0, "c")     # one clock read
+    t0 = tracer.clock()
+    tr.span("fanout", t0, tracer.clock())
+    tracer.finish(tr)
+    entry = tracer.report()["entries"][0]
+    span = next(s for s in entry["spans"] if s["stage"] == "fanout")
+    assert span["dur_us"] == 1000       # exactly one scripted tick
+    assert entry["e2e_ms"] == pytest.approx(3.0)  # 3 ticks start->end
+
+
+def test_stage_errors_counter_and_exposition():
+    tracer = PipelineTracer()           # sampling off: errors still count
+    tracer.note_error("drain", "queue_full", 3)
+    tracer.note_error("bridge", "refused")
+    assert tracer.stage_errors[("drain", "queue_full")] == 3
+
+    class _B:                            # minimal broker facade
+        pass
+
+    b = _B()
+    b.tracer = tracer
+    reg = Registry()
+    from maxmq_tpu.metrics import _register_trace_metrics
+    _register_trace_metrics(reg, b)
+    text = reg.expose()
+    assert ('maxmq_broker_stage_errors_total'
+            '{stage="drain",reason="queue_full"} 3') in text
+    assert ('maxmq_broker_stage_errors_total'
+            '{stage="bridge",reason="refused"} 1') in text
+    # every pipeline stage exposes its histogram triplet even untouched
+    for stage in STAGES:
+        assert (f'maxmq_broker_publish_stage_seconds_count'
+                f'{{stage="{stage}"}} 0') in text
+
+
+# -- e2e: spans on a real broker --------------------------------------
+
+
+async def test_trie_path_spans_and_drain():
+    async with running_broker(trace_sample_n=1) as broker:
+        sub = await connect(broker, "s1")
+        await sub.subscribe("t/#")
+        pub = await connect(broker, "p1")
+        await pub.publish("t/x", b"payload")
+        await sub.next_message(timeout=3)
+        await poll(lambda: broker.tracer.ring_depth > 0, what="trace")
+        entry = broker.tracer.report()["entries"][0]
+        stages = {s["stage"] for s in entry["spans"]}
+        assert {"decode", "admission", "match_device",
+                "fanout"} <= stages
+        assert entry["qos"] == 0 and entry["topic"] == "t/x"
+        assert entry["client"] == "p1"
+        # drain span lands after finish, from the writer task, and is
+        # appended to the live flight-recorder entry
+        await poll(lambda: entry["drains"], what="drain span")
+        assert entry["drains"][0]["client"] == "s1"
+        # zero stage errors on a healthy publish
+        assert broker.tracer.stage_errors == {}
+        await pub.disconnect()
+        await sub.disconnect()
+
+
+async def test_zero_allocations_when_off():
+    async with running_broker() as broker:      # default: tracing off
+        sub = await connect(broker, "s1")
+        await sub.subscribe("t/#")
+        pub = await connect(broker, "p1")
+        for i in range(10):
+            await pub.publish("t/x", b"m", qos=1)
+        await sub.next_message(timeout=3)
+        assert broker.tracer.allocations == 0
+        assert broker.tracer.sampled == 0
+        assert broker.tracer.ring_depth == 0
+        await pub.disconnect()
+        await sub.disconnect()
+
+
+async def test_durable_barrier_span_crosses_writer_thread():
+    """storage_sync=always: the barrier span opens on the loop and is
+    closed by an ack released from the storage writer thread; a slow
+    group commit (hang fault in the WRITER thread) must show up as
+    barrier time, and the critical-path spans must sum to ~e2e (the
+    acceptance bar: within 10%)."""
+    store = WriteBehindStore(MemoryStore(), policy="always")
+    b = Broker(BrokerOptions(capabilities=Capabilities(
+        sys_topic_interval=0, trace_sample_n=1, trace_slow_ms=20.0)))
+    b.add_hook(AllowHook())
+    b.add_hook(StorageHook(store))
+    lst = b.add_listener(TCPListener("t", "127.0.0.1:0"))
+    await b.serve()
+    b.test_port = lst._server.sockets[0].getsockname()[1]
+    try:
+        sub = await connect(b, "s1")
+        await sub.subscribe(("t/#", 1))
+        pub = await connect(b, "p1")
+        # fast publish first: under the 20ms slow threshold -> NOT
+        # flight-recorded (but histograms still fed)
+        await pub.publish("t/fast", b"m", qos=1, timeout=5)
+        await poll(lambda: b.tracer.sampled >= 1, what="sampled")
+        assert b.tracer.ring_depth == 0
+        # slow publish: the commit covering its barrier hangs 60ms in
+        # the writer thread
+        faults.arm(faults.STORAGE_COMMIT, "hang", count=1, delay_s=0.06)
+        t0 = time.perf_counter()
+        await pub.publish("t/slow", b"m", qos=1, timeout=10)
+        measured_ms = (time.perf_counter() - t0) * 1e3
+        await poll(lambda: b.tracer.ring_depth > 0, what="slow capture")
+        entry = b.tracer.report()["entries"][0]
+        assert entry["slow"] is True and entry["topic"] == "t/slow"
+        spans = {s["stage"]: s for s in entry["spans"]}
+        assert "barrier" in spans and "ack" in spans
+        assert spans["barrier"]["dur_us"] >= 50_000
+        # spans are the decomposition of the measured e2e: within 10%
+        assert entry["critical_sum_ms"] >= 0.9 * entry["e2e_ms"]
+        assert entry["e2e_ms"] <= measured_ms * 1.1
+        assert b.storage_barrier_waits >= 1
+        # journal_commit histogram fed from the writer thread
+        assert b.tracer.stage_hist["journal_commit"].count >= 1
+        await pub.disconnect()
+        await sub.disconnect()
+    finally:
+        await b.close()
+
+
+async def test_matcher_pipeline_split_spans_through_supervisor():
+    """Matcher mode: the batcher stamps dispatch/done marks, the
+    ADR-011 supervisor forwards them, and the trace splits the matcher
+    leg into match_queue + match_device (+ pipeline_wait)."""
+    from maxmq_tpu.matching.batcher import MicroBatcher
+    from maxmq_tpu.matching.supervisor import SupervisedMatcher
+
+    class _TrieEngine:
+        def __init__(self, index):
+            self.index = index
+
+        def subscribers_batch(self, topics):
+            return [self.index.subscribers(t) for t in topics]
+
+        def refresh(self, force=False):
+            return False
+
+    async with running_broker(trace_sample_n=1) as broker:
+        batcher = MicroBatcher(_TrieEngine(broker.topics),
+                               cpu_bypass=False, window_us=1000)
+        batcher.tracer = broker.tracer
+        broker.attach_matcher(SupervisedMatcher(
+            batcher, index=broker.topics, deadline_ms=2000))
+        try:
+            sub = await connect(broker, "s1")
+            await sub.subscribe("m/#")
+            pub = await connect(broker, "p1")
+            await pub.publish("m/x", b"payload")
+            await sub.next_message(timeout=3)
+            await poll(lambda: broker.tracer.ring_depth > 0,
+                       what="matcher trace")
+            entry = broker.tracer.report()["entries"][0]
+            stages = {s["stage"] for s in entry["spans"]}
+            assert "match_queue" in stages and "match_device" in stages
+            assert entry["degraded"] == ""      # healthy supervisor
+            await pub.disconnect()
+            await sub.disconnect()
+        finally:
+            await batcher.close()
+
+
+async def test_bridge_span_and_link_down_stage_error():
+    """Cluster attached: the bridge span wraps the route consult +
+    forward enqueue, and a forward whose target link is down lands on
+    the stage-error counter as (bridge, link_down)."""
+    from maxmq_tpu.cluster import ClusterManager, PeerSpec
+
+    async with running_broker(trace_sample_n=1) as broker:
+        mgr = ClusterManager(
+            broker, "A", [PeerSpec("B", "127.0.0.1", 1)])
+        broker.attach_cluster(mgr)      # attached post-serve: links idle
+        # B advertises a route so maybe_forward targets its dead link
+        mgr.routes.apply_snapshot("B", 1, 1, {"t/#"})
+        sub = await connect(broker, "s1")
+        await sub.subscribe("t/#")
+        pub = await connect(broker, "p1")
+        await pub.publish("t/x", b"payload")
+        await sub.next_message(timeout=3)
+        await poll(lambda: broker.tracer.ring_depth > 0, what="trace")
+        entry = broker.tracer.report()["entries"][0]
+        assert "bridge" in {s["stage"] for s in entry["spans"]}
+        assert broker.tracer.stage_errors.get(
+            ("bridge", "link_down"), 0) >= 1
+        assert mgr.forwards_skipped_down >= 1
+        await pub.disconnect()
+        await sub.disconnect()
+
+
+async def test_drain_stage_error_from_write_path_drop():
+    """The ADR-012 drops_by_reason ledger now surfaces per-stage: a
+    queue-refused delivery counts under stage=drain with its reason."""
+    async with running_broker(maximum_client_writes_pending=1) as broker:
+        sub = await connect(broker, "s1")
+        await sub.subscribe("t/#")
+        # stall the subscriber's writer so its 1-slot queue wedges
+        faults.arm(f"{faults.CLIENT_WRITE}#s1", "hang",
+                   count=-1, delay_s=30.0)
+        pub = await connect(broker, "p1")
+        for i in range(20):
+            await pub.publish("t/x", b"m" * 64)
+        await poll(lambda: any(s == "drain" for (s, _r)
+                               in broker.tracer.stage_errors),
+                   what="drain stage error")
+        reasons = {r for (s, r) in broker.tracer.stage_errors
+                   if s == "drain"}
+        assert "queue_full" in reasons
+        await pub.disconnect()
+
+
+async def test_sys_trace_subtree_and_metrics_endpoints():
+    async with running_broker(trace_sample_n=1) as broker:
+        sub = await connect(broker, "s1")
+        await sub.subscribe("t/#")
+        pub = await connect(broker, "p1")
+        await pub.publish("t/x", b"m", qos=1)
+        await sub.next_message(timeout=3)
+        await poll(lambda: broker.tracer.ring_depth > 0, what="trace")
+        broker.publish_sys_topics()
+        assert broker.topics.retained_get(
+            "$SYS/broker/trace/sampled") is not None
+        assert broker.topics.retained_get(
+            "$SYS/broker/trace/ring_depth") is not None
+        # sampling off -> the next tick CLEARS the retained subtree
+        # (stale values must not masquerade as live ones)
+        broker.tracer.sample_n = 0
+        broker.publish_sys_topics()
+        assert broker.topics.retained_get(
+            "$SYS/broker/trace/sampled") is None
+        assert broker.topics.retained_get(
+            "$SYS/broker/trace/ring_depth") is None
+        broker.tracer.sample_n = 1
+
+        reg = Registry()
+        register_broker_metrics(reg, broker)
+        srv = MetricsServer("127.0.0.1:0", reg, tracer=broker.tracer)
+        srv.start()
+        try:
+            def get(path):
+                url = f"http://127.0.0.1:{srv.bound_port}{path}"
+                with urllib.request.urlopen(url, timeout=5) as r:
+                    return r.read().decode()
+
+            loop = asyncio.get_running_loop()
+            traces = json.loads(
+                await loop.run_in_executor(None, get, "/traces"))
+            assert traces["sample_n"] == 1 and traces["entries"]
+            chrome = json.loads(
+                await loop.run_in_executor(None, get, "/traces/chrome"))
+            assert chrome["traceEvents"]
+            page = await loop.run_in_executor(None, get, "/metrics")
+            assert "maxmq_broker_publish_e2e_seconds_bucket" in page
+        finally:
+            srv.stop()
+        await pub.disconnect()
+        await sub.disconnect()
+
+
+# -- the conformance checker itself ------------------------------------
+
+
+def test_exposition_checker_passes_on_real_registry():
+    checker = _checker()
+    b = Broker(BrokerOptions(capabilities=Capabilities(
+        sys_topic_interval=0, trace_sample_n=1)))
+    b.add_hook(StorageHook(WriteBehindStore(MemoryStore())))
+    b.tracer.observe("fanout", 0.003)
+    tr = b.tracer.sample("t", 0, 'cli"ent\\x')
+    b.tracer.finish(tr, end_ns=tr.start_ns + 1000)
+    reg = Registry()
+    register_broker_metrics(reg, b)
+    errors = checker.validate(reg.expose())
+    assert errors == []
+    b.hooks.stop_all()
+
+
+def test_exposition_checker_catches_violations():
+    checker = _checker()
+    bad = "\n".join((
+        "# TYPE h_seconds histogram",
+        'h_seconds_bucket{le="0.1"} 5',
+        'h_seconds_bucket{le="1"} 3',        # non-monotonic
+        'h_seconds_bucket{le="+Inf"} 5',
+        "h_seconds_sum 1.0",
+        "h_seconds_count 9",                 # != +Inf bucket
+        "no_type_metric 1",                  # no TYPE declared
+        'lbl{bad name="x"} 1',               # malformed label
+        "dup 1",
+    ))
+    errors = checker.validate("# TYPE dup counter\n# TYPE lbl gauge\n"
+                              "# TYPE no_type_metric_ignored gauge\n"
+                              + bad + "\ndup 1\n")
+    text = "\n".join(errors)
+    assert "non-monotonic" in text
+    assert "_count" in text
+    assert "no TYPE declared" in text
+    assert "malformed" in text or "unparseable" in text
+    assert "duplicate series" in text
+
+
+async def test_drain_watchers_settle_only_when_their_flush_lands():
+    """A watcher registered while a flush is in flight must NOT be
+    settled by that flush (its packet is still queued) — settling is
+    gated on the writer having dequeued past the watcher's enqueue
+    seq, so slow-consumer drain latency is reported, not hidden."""
+    async with running_broker(trace_sample_n=1) as broker:
+        sub = await connect(broker, "s1")
+        await sub.subscribe("t/#")
+        client = broker.clients.get("s1")
+        tracer = broker.tracer
+        tr1 = tracer.sample("t/a", 0, "p")
+        tr2 = tracer.sample("t/b", 0, "p")
+        # watcher 1 at the current dequeue frontier, watcher 2 beyond
+        flushed_now = client.outbound.removed
+        client._drain_traces = [(tr1, tracer.clock(), flushed_now),
+                                (tr2, tracer.clock(), flushed_now + 5)]
+        client._settle_drain_traces(flushed_now)
+        assert [seq for _t, _n, seq in client._drain_traces] == \
+            [flushed_now + 5]                   # tr2 kept pending
+        assert len(tr1.drains) == 1 and tr2.drains == []
+        await sub.disconnect()
+
+
+def test_drain_span_cap():
+    tracer = PipelineTracer(sample_n=1)
+    tr = tracer.sample("t", 0, "c")
+    for i in range(20):
+        tracer.drain_span(tr, f"c{i}", 0, 1000)
+    # the SERVER-side registration caps at MAX_DRAIN_SPANS; the tracer
+    # records whatever was registered — the cap constant is the contract
+    assert MAX_DRAIN_SPANS < 20
+    assert tracer.stage_hist["drain"].count == 20
+    assert CRITICAL_STAGES.isdisjoint({"drain", "journal_commit"})
