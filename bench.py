@@ -1795,6 +1795,167 @@ def bench_cluster_federation(msgs: int = 400) -> dict:
     return d
 
 
+def bench_failover(parked: int = 50, share_msgs: int = 60) -> dict:
+    """ADR-016 session-federation measurement (MAXMQ_BENCH_CONFIGS=
+    failover): a 3-node line A-B-C with cluster_session_sync=always.
+    Reports (1) reconnect-to-CONNACK time for a cross-node session
+    takeover while the prior owner is ALIVE (state pull) and after the
+    owner node DIES (replica install), (2) the takeover message-loss
+    window — PUBACKed QoS1 messages parked for the session minus those
+    redelivered after failover (the zero-loss bar), and (3) cluster-
+    wide $share exactly-once balance across members on all 3 nodes,
+    with the ADR-015 takeover span in the trace stanza."""
+    import asyncio
+
+    from maxmq_tpu.broker import (Broker, BrokerOptions, Capabilities,
+                                  TCPListener)
+    from maxmq_tpu.cluster import ClusterManager, PeerSpec
+    from maxmq_tpu.hooks import AllowHook
+    from maxmq_tpu.mqtt_client import MQTTClient
+
+    line = {"A": ["B"], "B": ["A", "C"], "C": ["B"]}
+
+    async def make_node() -> Broker:
+        b = Broker(BrokerOptions(
+            capabilities=Capabilities(sys_topic_interval=0)))
+        b.add_hook(AllowHook())
+        lst = b.add_listener(TCPListener("t", "127.0.0.1:0"))
+        await b.serve()
+        b.test_port = lst._server.sockets[0].getsockname()[1]
+        return b
+
+    async def poll(cond, timeout_s: float) -> float:
+        t0 = time.perf_counter()
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if cond():
+                return time.perf_counter() - t0
+            await asyncio.sleep(0.01)
+        return -1.0
+
+    async def run() -> dict:
+        brokers = {n: await make_node() for n in line}
+        mgrs = {}
+        for name, peers in line.items():
+            mgr = ClusterManager(
+                brokers[name], name,
+                [PeerSpec(p, "127.0.0.1", brokers[p].test_port)
+                 for p in peers],
+                keepalive=2.0, backoff_initial_s=0.1,
+                session_sync="always", session_sync_timeout_ms=1000,
+                session_takeover_timeout_ms=1000)
+            brokers[name].attach_cluster(mgr)
+            await mgr.start()
+            mgrs[name] = mgr
+        await poll(lambda: all(m.links_up == len(line[n])
+                               for n, m in mgrs.items()), 30.0)
+        d: dict = {"config": "failover", "nodes": 3,
+                   "topology": "line A-B-C",
+                   "session_sync": "always"}
+
+        # -- cluster-wide $share exactly-once + balance ---------------
+        members = {}
+        for name in line:
+            c = MQTTClient(client_id=f"shm-{name}")
+            await c.connect("127.0.0.1", brokers[name].test_port)
+            await c.subscribe(("$share/g/fo/s", 0))
+            members[name] = c
+        key = ("g", "$share/g/fo/s")
+        await poll(lambda: all(
+            len(m.routes.shares.members_for(key)) == 3
+            for m in mgrs.values()), 30.0)
+        pub = MQTTClient(client_id="fo-pub")
+        await pub.connect("127.0.0.1", brokers["A"].test_port)
+        for i in range(share_msgs):
+            await pub.publish("fo/s", b"x" * 64)
+        per_node = {}
+        for name, c in members.items():
+            n = 0
+            while True:
+                try:
+                    await c.next_message(timeout=0.5)
+                    n += 1
+                except asyncio.TimeoutError:
+                    break
+            per_node[name] = n
+        total = sum(per_node.values())
+        d["share_published"] = share_msgs
+        d["share_delivered_total"] = total
+        d["share_exactly_once"] = total == share_msgs
+        d["share_deliveries_per_node"] = per_node
+        mean = total / len(per_node) if per_node else 0
+        d["share_balance_skew"] = round(
+            (max(per_node.values()) - min(per_node.values()))
+            / mean, 3) if mean else 0.0
+
+        # -- live takeover: reconnect-to-CONNACK with a state pull ----
+        sess = MQTTClient(client_id="fo-sess", version=5,
+                          clean_start=False, session_expiry=3600)
+        await sess.connect("127.0.0.1", brokers["A"].test_port)
+        await sess.subscribe(("fo/q/#", 1))
+        await poll(lambda: "fo-sess" in mgrs["B"].sessions.ledger, 10.0)
+        brokers["B"].tracer.sample_n = 1     # capture the takeover span
+        t0 = time.perf_counter()
+        sess_b = MQTTClient(client_id="fo-sess", version=5,
+                            clean_start=False, session_expiry=3600)
+        await sess_b.connect("127.0.0.1", brokers["B"].test_port)
+        d["takeover_live_connack_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 2)
+        d["takeover_live_session_present"] = bool(sess_b.session_present)
+        await sess_b.disconnect()            # parked window fills next
+
+        # -- dead-owner failover: loss window + reconnect time --------
+        # published TO the owner node: its PUBACK carries the journal +
+        # replication barrier (cross-node forwards ride the QoS0 link
+        # and make no such promise — ADR 013/016)
+        pub_b = MQTTClient(client_id="fo-pub-b")
+        await pub_b.connect("127.0.0.1", brokers["B"].test_port)
+        for i in range(parked):              # PUBACK-paced parked QoS1
+            await pub_b.publish("fo/q/m", f"p-{i}".encode(), qos=1)
+        await pub_b.close()
+        await brokers["B"].close()           # the owner node "dies"
+        await poll(lambda: mgrs["C"].links_up == 0, 15.0)
+        t0 = time.perf_counter()
+        sess_c = MQTTClient(client_id="fo-sess", version=5,
+                            clean_start=False, session_expiry=3600)
+        await sess_c.connect("127.0.0.1", brokers["C"].test_port)
+        d["failover_connack_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 2)
+        d["failover_session_present"] = bool(sess_c.session_present)
+        got = set()
+        while True:
+            try:
+                m = await sess_c.next_message(timeout=1.0)
+                got.add(m.payload)
+            except asyncio.TimeoutError:
+                break
+        lost = {f"p-{i}".encode() for i in range(parked)} - got
+        d["parked_pubacked"] = parked
+        d["takeover_loss_window"] = len(lost)
+        sC = mgrs["C"].sessions
+        d.update(takeovers=sC.takeovers,
+                 takeovers_stale=sC.takeovers_stale,
+                 sync_degraded=sC.sync_degraded,
+                 digest_mismatches=sC.digest_mismatches)
+        d["trace"] = trace_stanza(brokers["B"].tracer)
+        for c in list(members.values()) + [pub, sess, sess_c]:
+            try:
+                await c.close()
+            except Exception:
+                pass
+        for name in ("A", "C"):
+            await brokers[name].close()
+        return d
+
+    d = asyncio.run(run())
+    log(f"[failover] live-takeover={d['takeover_live_connack_ms']}ms "
+        f"failover={d['failover_connack_ms']}ms "
+        f"loss={d['takeover_loss_window']}/{d['parked_pubacked']} "
+        f"share-exactly-once={d['share_exactly_once']} "
+        f"per-node={d['share_deliveries_per_node']}")
+    return d
+
+
 def bench_cluster(subs: int = 100_000, batch: int = 8192,
                   msgs: int = 10_000) -> dict:
     log("[cluster] 8-dev CPU mesh subprocess ...")
@@ -2068,6 +2229,14 @@ def main() -> None:
         runs.append(("cluster_federation",
                      lambda: bench_cluster_federation(
                          msgs=max(32, int(400 * scale)))))
+    if "failover" in which:
+        # ADR-016 federated sessions: reconnect-to-CONNACK on takeover
+        # (live + dead-owner), PUBACKed-loss window across a node
+        # death, cluster-wide $share exactly-once balance
+        runs.append(("failover",
+                     lambda: bench_failover(
+                         parked=max(10, int(50 * scale)),
+                         share_msgs=max(12, int(60 * scale)))))
     if "5" in which:
         runs.append(("cluster", lambda: bench_cluster(subs=s(100_000))))
     if "e2e" in which:

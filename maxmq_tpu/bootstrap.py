@@ -164,6 +164,11 @@ def build_cluster(conf: Config, broker: Broker, logger: Logger | None = None):
         max_hops=conf.cluster_max_hops,
         link_byte_budget=conf.cluster_link_byte_budget,
         keepalive=float(conf.cluster_link_keepalive),
+        session_replication=conf.cluster_session_replication,
+        session_sync=conf.cluster_session_sync,
+        session_sync_timeout_ms=conf.cluster_session_sync_timeout_ms,
+        session_takeover_timeout_ms=(
+            conf.cluster_session_takeover_timeout_ms),
         logger=logger.with_prefix("cluster") if logger else None)
     broker.attach_cluster(manager)
     return manager

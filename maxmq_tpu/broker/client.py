@@ -374,19 +374,7 @@ class Client:
                         break
                 else:
                     break                      # drained a None: stop
-                self.write_progress = time.monotonic()
-                # snapshot BEFORE awaiting: deliveries enqueued while
-                # drain() is in flight were not carried by this flush,
-                # so their ADR-015 watchers must wait for a later one
-                flushed = self.outbound.removed
-                # flow control: past the transport high-water mark this
-                # blocks until the consumer catches up, backpressuring
-                # into the byte-accounted queue where the stall detector
-                # and budgets can see it (ADR 012)
-                await self.writer.drain()
-                self.write_progress = time.monotonic()
-                if self._drain_traces:
-                    self._settle_drain_traces(flushed)
+                await self._flush_burst()
             await self._drain()
         except asyncio.CancelledError:
             pass
@@ -394,6 +382,22 @@ class Client:
             # a dead writer must be visible to the stall detector and
             # stop_cause — not an apparently-healthy idle one
             self.write_error = self.write_error or repr(exc)
+
+    async def _flush_burst(self) -> None:
+        """One burst's transport flush. The removed-counter snapshot
+        happens BEFORE awaiting: deliveries enqueued while drain() is
+        in flight were not carried by this flush, so their ADR-015
+        watchers must wait for a later one. drain() is the flow
+        control: past the transport high-water mark it blocks until
+        the consumer catches up, backpressuring into the
+        byte-accounted queue where the stall detector and budgets can
+        see it (ADR 012)."""
+        self.write_progress = time.monotonic()
+        flushed = self.outbound.removed
+        await self.writer.drain()
+        self.write_progress = time.monotonic()
+        if self._drain_traces:
+            self._settle_drain_traces(flushed)
 
     def _write_packet(self, packet: Packet) -> None:
         packet = self.server.hooks.modify("on_packet_encode", packet, self)

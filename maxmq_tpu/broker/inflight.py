@@ -60,6 +60,17 @@ class Inflight:
         """Inflight packets ordered by creation time (for resend-on-resume)."""
         return sorted(self._messages.values(), key=lambda p: (p.created, p.packet_id))
 
+    def digest(self) -> tuple[int, int]:
+        """(count, xor-of-packet-ids): the order-free inflight-window
+        digest replicated with session updates (ADR 016). A takeover
+        compares the installed window against the owner's digest —
+        cheap enough to ride every update, strong enough to catch a
+        dropped or duplicated replication op."""
+        x = 0
+        for pid in self._messages:
+            x ^= pid
+        return len(self._messages), x
+
     def clone(self) -> "Inflight":
         other = Inflight(self.maximum_receive, self.maximum_send)
         other._messages = {k: v.copy() for k, v in self._messages.items()}

@@ -125,6 +125,10 @@ class Broker:
         self._housekeeper: asyncio.Task | None = None
         self._sys_task: asyncio.Task | None = None
         self._will_delays: dict[str, tuple[float, Packet]] = {}
+        # client-id -> Client parked in the ADR-016 takeover await of
+        # _attach_client (after _inherit_session, before clients.add):
+        # a concurrent CONNECT for the same id must fence it off there
+        self._mid_connect: dict[str, Client] = {}
         self._retained_expiry: list[tuple[float, str]] = []
         # topic -> latest due time: the heap uses lazy deletion, and a
         # retained topic REPUBLISHED often (1Hz sensor state) would
@@ -402,6 +406,34 @@ class Broker:
 
         self.hooks.notify("on_session_establish", client, packet)
         session_present = self._inherit_session(client)
+        sessions = self._cluster_sessions()
+        if sessions is not None:
+            # ADR 016: epoch-fenced cross-node takeover BEFORE CONNACK —
+            # a session owned by a peer is claimed, transferred (or
+            # rebuilt from the replicated ledger) and installed here, so
+            # the client sees session-present=1 on any node. Bounded:
+            # every remote leg degrades instead of wedging the CONNECT.
+            # The await opens a same-id race _inherit_session cannot
+            # see (this client is not in the registry yet): a parked
+            # predecessor is fenced off like a registered one, and if a
+            # successor supersedes US while parked, this CONNECT loses.
+            prev = self._mid_connect.get(client.id)
+            if prev is not None and prev is not client:
+                prev.taken_over = True
+                if not prev.closed:
+                    self.disconnect_client(prev, codes.ErrSessionTakenOver)
+                    self._spawn(
+                        prev.stop(ProtocolError(codes.ErrSessionTakenOver)),
+                        "takeover-stop")
+            self._mid_connect[client.id] = client
+            try:
+                session_present = await sessions.on_local_connect(
+                    client, session_present)
+            finally:
+                if self._mid_connect.get(client.id) is client:
+                    del self._mid_connect[client.id]
+            if client.taken_over:
+                raise ProtocolError(codes.ErrSessionTakenOver)
         self._will_delays.pop(client.id, None)  # reconnect cancels delayed will
         self.clients.add(client)
         client.connected_at = time.time()
@@ -512,6 +544,16 @@ class Broker:
                     self.cluster.note_unsubscribe(filt)
         client.subscriptions.clear()
         self.clients.delete(client.id)
+        sessions = self._cluster_sessions()
+        if sessions is not None:
+            # ADR 016: an expired/discarded session is purged
+            # cluster-wide, not resurrected from a peer's replica
+            sessions.note_purge(client.id)
+
+    def _cluster_sessions(self):
+        """The ADR-016 session-federation manager, when attached."""
+        return (getattr(self.cluster, "sessions", None)
+                if self.cluster is not None else None)
 
     def _send_connack(self, client: Client, code: codes.Code,
                       session_present: bool) -> None:
@@ -706,9 +748,7 @@ class Broker:
         tr = self._packet_trace(packet)
         if tr is not None:
             tr.span("admission", tr.t_admit, self.tracer.clock())
-        durable = (packet.fixed.qos > 0 and not client.inline
-                   and self._journal is not None
-                   and self._journal.barrier_needed)
+        durable = self._needs_durable_ack(client, packet)
         if not durable:
             if tr is None:
                 self._ack_publish(client, packet, success=True)
@@ -881,6 +921,19 @@ class Broker:
         earlier ack still waiting [MQTT-4.6.0-2]."""
         jr = self._journal
         fut = jr.barrier(self.loop) if jr is not None else None
+        if fut is not None:
+            # counted here, not at the combined-future wait below: the
+            # replication-only case must not inflate the ADR-014 storage
+            # metric (sessions keep their own sync_barrier_waits)
+            self.storage_barrier_waits += 1
+        sessions = self._cluster_sessions()
+        if sessions is not None and sessions.ack_coupled:
+            # ADR 016: under cluster_session_sync=always the ack also
+            # waits for peers to acknowledge the inflight replication
+            # covering this publish — that is what a kill-failover to a
+            # peer can redeliver. Both barriers are bounded/degradable.
+            fut = self._combine_barriers(fut,
+                                         sessions.sync_barrier(self.loop))
         tr = self._packet_trace(packet)
         if tr is not None:
             tr.t_barrier = self.tracer.clock()
@@ -891,9 +944,34 @@ class Broker:
         if fut is None:
             self._drain_durable_acks(client)
         else:
-            self.storage_barrier_waits += 1
             fut.add_done_callback(
                 lambda _f: self._drain_durable_acks(client))
+
+    def _needs_durable_ack(self, client: Client, packet: Packet) -> bool:
+        """True when this publish's QoS ack must release through a
+        barrier: the ADR-014 journal fsync (storage_sync=always) and/or
+        the ADR-016 peer-replication ack (cluster_session_sync=always)."""
+        if packet.fixed.qos == 0 or client.inline:
+            return False
+        if self._journal is not None and self._journal.barrier_needed:
+            return True
+        sessions = self._cluster_sessions()
+        return sessions is not None and sessions.ack_coupled
+
+    def _combine_barriers(self, a, b):
+        """AND of two optional barrier futures (journal durability +
+        session replication, ADR 014/016): resolves once both have."""
+        if a is None or b is None:
+            return a if b is None else b
+        both = self.loop.create_future()
+
+        def _one(_f) -> None:
+            if a.done() and b.done() and not both.done():
+                both.set_result(None)
+
+        a.add_done_callback(_one)
+        b.add_done_callback(_one)
+        return both
 
     def _ack_traced(self, client: Client, packet: Packet, success: bool,
                     tr) -> None:
@@ -1166,7 +1244,15 @@ class Broker:
         client; a client already receiving a plain delivery is skipped
         [MQTT-4.8.2-4]."""
         selected: dict[str, Subscription] = {}
+        sessions = self._cluster_sessions()
         for (group, filt), candidates in shared.items():
+            if sessions is not None and not sessions.owns_share(group,
+                                                                filt):
+                # ADR 016: cluster-wide $share — another node owns this
+                # (group, filter) pick; its forward copy delivers there,
+                # so the group receives the publish exactly once
+                # cluster-wide instead of once per node
+                continue
             pick = self.topics.select_shared(
                 group, filt, candidates,
                 alive=lambda cid: (c := self.clients.get(cid)) is not None
@@ -1288,6 +1374,12 @@ class Broker:
             return  # dropped, exhausted, or parked on send quota
         if client.closed:
             return  # queued in inflight for session resume
+        self._send_outbound(client, out, packet)
+
+    def _send_outbound(self, client: Client, out: Packet,
+                       packet: Packet) -> None:
+        """Enqueue one shaped delivery: a refusal rolls back (ADR 012),
+        an accepted one registers its ADR-015 drain watcher."""
         if not client.send(out):
             self._count_refused_send(client, out)
         elif self.tracer.sample_n:
@@ -1981,7 +2073,7 @@ class Broker:
         """The ADR-013 federation subtree: link/route health at a
         glance from any MQTT client subscribed to $SYS."""
         mgr = self.cluster
-        return {
+        entries = {
             "$SYS/broker/cluster/node_id": mgr.node_id,
             "$SYS/broker/cluster/links_up": mgr.links_up,
             "$SYS/broker/cluster/link_flaps": mgr.link_flaps,
@@ -1992,6 +2084,28 @@ class Broker:
                 mgr.forwards_delivered,
             "$SYS/broker/cluster/loops_dropped": mgr.loops_dropped,
         }
+        sess = getattr(mgr, "sessions", None)
+        if sess is not None:
+            # ADR 016: the session-federation subtree — takeover and
+            # replication health readable from any MQTT client
+            entries.update({
+                "$SYS/broker/cluster/sessions/ledger": sess.ledger_size,
+                "$SYS/broker/cluster/sessions/local":
+                    sess.local_sessions,
+                "$SYS/broker/cluster/sessions/takeovers":
+                    sess.takeovers,
+                "$SYS/broker/cluster/sessions/takeovers_degraded":
+                    sess.takeovers_degraded,
+                "$SYS/broker/cluster/sessions/lost":
+                    sess.sessions_lost,
+                "$SYS/broker/cluster/sessions/sync_degraded":
+                    sess.sync_degraded,
+                "$SYS/broker/cluster/sessions/sync_faults":
+                    sess.sync_faults,
+                "$SYS/broker/cluster/sessions/share_groups":
+                    sess.share_groups,
+            })
+        return entries
 
     # ------------------------------------------------------------------
     # Persistence restore (v2/server.go:1297-1434)

@@ -131,13 +131,18 @@ class BusHook(Hook):
     id = "bus"
 
     def __init__(self, worker_id: int, bus_path: str) -> None:
+        from ..cluster.routes import ShareLedger
         self.worker_id = worker_id
         self.bus_path = bus_path
         self.broker = None
         self._writer: asyncio.StreamWriter | None = None
         self._reader_task: asyncio.Task | None = None
-        # (group, filter) -> {worker_id: count}; local counts gossiped
-        self.members: dict[tuple[str, str], dict[int, int]] = {}
+        # $share group-membership ledger — the SAME class the cluster
+        # session federation feeds (ADR 016), so a filter shared across
+        # both a pool and a peer node resolves ownership through one
+        # set of rules (lowest live member id owns the pick). Member
+        # ids here are worker ids; gossip wire format is unchanged.
+        self.shares = ShareLedger(worker_id)
         self._local: dict[tuple[str, str], int] = {}
         # client id -> its live $share keys (incremental maintenance)
         self._contrib: dict[str, set[tuple[str, str]]] = {}
@@ -301,17 +306,11 @@ class BusHook(Hook):
         if self._writer is None:
             return
         # keep our own view coherent too (we never hear our own gossip)
-        for key in set(self._local) | {k for k, v in self.members.items()
-                                       if self.worker_id in v}:
-            self.members.setdefault(key, {})[self.worker_id] = \
-                self._local.get(key, 0)
+        self.shares.replace_member(self.worker_id, self._local)
         self._writer.write(_frame(FRAME_MEMBERSHIP, json.dumps({
             "w": self.worker_id,
             "members": [[g, f, n] for (g, f), n in self._local.items()],
         }).encode()))
-        for key in [k for k, per in self.members.items()
-                    if not any(per.values())]:
-            del self.members[key]
 
     async def _absorb_takeover(self, payload: bytes) -> None:
         """Another worker established a session for this client id: any
@@ -330,27 +329,13 @@ class BusHook(Hook):
     def _absorb_membership(self, payload: bytes) -> None:
         msg = json.loads(payload)
         w = int(msg["w"])
-        seen = set()
-        for g, f, n in msg["members"]:
-            self.members.setdefault((g, f), {})[w] = int(n)
-            seen.add((g, f))
-        dead = []
-        for key, per in self.members.items():
-            if key not in seen:
-                per.pop(w, None)
-            if not per or not any(per.values()):
-                dead.append(key)       # churned-away groups must not
-        for key in dead:               # accumulate forever
-            del self.members[key]
+        self.shares.replace_member(
+            w, {(g, f): int(n) for g, f, n in msg["members"]})
 
     def _owns(self, group: str, filt: str) -> bool:
-        per = self.members.get((group, filt))
-        workers = sorted(w for w, n in (per or {}).items() if n > 0)
-        if not workers:
-            # no gossip yet: the origin worker delivers (safe default —
-            # at worst a short double-delivery window at startup)
-            return True
-        return workers[0] == self.worker_id
+        # no gossip yet: the ledger answers True (origin delivers) —
+        # at worst a short double-delivery window at startup
+        return self.shares.owns((group, filt))
 
     # declares that on_select_subscribers only drops keys from the
     # outer ``shared`` dict, letting the broker skip the per-record

@@ -1,19 +1,23 @@
-"""Cluster federation layer (ADR 013): bridge links, aggregated route
-propagation, and cross-node publish forwarding over N broker
-processes."""
+"""Cluster federation layer (ADR 013 + 016): bridge links, aggregated
+route propagation, cross-node publish forwarding, and federated
+sessions (epoch-safe takeover, replicated inflight, cluster-wide
+``$share``) over N broker processes."""
 
 from .bridge import BRIDGE_ID_PREFIX, BridgeLink
 from .manager import ClusterManager, DedupWindow
 from .membership import (Membership, PeerSpec, PeerSpecError,
                          parse_peers, valid_node_id)
-from .routes import (RouteTable, RouteWireError, decode_delta,
-                     decode_snapshot, encode_delta, encode_snapshot,
-                     filter_subsumes, minimal_cover)
+from .routes import (IncrementalCover, RouteTable, RouteWireError,
+                     ShareLedger, decode_delta, decode_snapshot,
+                     encode_delta, encode_snapshot, filter_subsumes,
+                     minimal_cover)
+from .sessions import SessionEntry, SessionFederation
 
 __all__ = [
     "BRIDGE_ID_PREFIX", "BridgeLink", "ClusterManager", "DedupWindow",
     "Membership", "PeerSpec", "PeerSpecError", "parse_peers",
-    "valid_node_id", "RouteTable", "RouteWireError", "decode_delta",
-    "decode_snapshot", "encode_delta", "encode_snapshot",
-    "filter_subsumes", "minimal_cover",
+    "valid_node_id", "IncrementalCover", "RouteTable", "RouteWireError",
+    "ShareLedger", "decode_delta", "decode_snapshot", "encode_delta",
+    "encode_snapshot", "filter_subsumes", "minimal_cover",
+    "SessionEntry", "SessionFederation",
 ]

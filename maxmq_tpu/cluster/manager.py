@@ -75,7 +75,11 @@ class ClusterManager:
                  keepalive: float = 10.0,
                  backoff_initial_s: float = 0.5,
                  backoff_max_s: float = 30.0,
-                 epoch: int | None = None, logger=None) -> None:
+                 epoch: int | None = None, logger=None,
+                 session_replication: bool = True,
+                 session_sync: str = "batched",
+                 session_sync_timeout_ms: int = 750,
+                 session_takeover_timeout_ms: int = 750) -> None:
         if not valid_node_id(node_id):
             raise ValueError(f"bad cluster node id {node_id!r}")
         if any(p.node_id == node_id for p in peers):
@@ -103,6 +107,17 @@ class ClusterManager:
         self._refresh_pending = False
         self._retry_pending = False
         self._started = False
+        # federated sessions (ADR 016): replication + takeover +
+        # cluster-wide $share, registered as a broker hook so the
+        # QoS/subscription/disconnect events feed replication
+        self.sessions = None
+        if session_replication:
+            from .sessions import SessionFederation
+            self.sessions = SessionFederation(
+                self, sync=session_sync,
+                sync_timeout_ms=session_sync_timeout_ms,
+                takeover_timeout_ms=session_takeover_timeout_ms)
+            broker.add_hook(self.sessions)
 
         # counters (read tear-free by the metrics scrape thread)
         self.forwards_delivered = 0     # remote publishes fanned out here
@@ -139,11 +154,17 @@ class ClusterManager:
         for filt, _cid, _sub, _group in \
                 self.broker.topics.all_subscriptions():
             self._note_filter(filt, add=True, refresh=False)
+        if self.sessions is not None:
+            # after the epoch adoption above and the broker's own
+            # restore: the ledger rebuild must see the final boot epoch
+            self.sessions.start()
         for link in self.links.values():
             link.start()
 
     async def close(self) -> None:
         self._started = False
+        if self.sessions is not None:
+            self.sessions.close()
         for link in self.links.values():
             await link.close()
 
@@ -267,11 +288,15 @@ class ClusterManager:
 
     def on_link_up(self, link: BridgeLink) -> None:
         self._send_snapshot(link)
+        if self.sessions is not None:
+            self.sessions.on_link_up(link)
 
     def on_link_down(self, link: BridgeLink, reason: str) -> None:
         # routes are KEPT: a flapping link must not churn the mesh's
         # tables; a peer that actually restarted re-announces with a
         # fresh epoch, which flushes its old routes on arrival
+        if self.sessions is not None:
+            self.sessions.on_link_down(link)
         if self.log is not None:
             self.log.warn("cluster link down", peer=link.peer,
                           reason=reason)
@@ -339,6 +364,12 @@ class ClusterManager:
             self._handle_routes(sender, levels, packet)
         elif kind == "sync" and len(levels) == 3:
             self._handle_sync(levels[2])
+        elif (kind == "sess" and len(levels) >= 4
+                and self.sessions is not None):
+            if levels[2] != sender:
+                self.inbound_rejected += 1  # spoofed session message
+            else:
+                await self.sessions.handle_inbound(sender, levels, packet)
         else:
             self.inbound_rejected += 1
 

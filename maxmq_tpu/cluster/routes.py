@@ -82,15 +82,157 @@ def filter_subsumes(general: str, specific: str) -> bool:
 
 def minimal_cover(filters) -> set[str]:
     """The aggregated advertisement: drop every filter subsumed by a
-    DIFFERENT filter in the set. O(n^2) level walks over the distinct
-    filter shapes — advertisements aggregate per filter, never per
-    subscription, so n stays small even at 1M subscriptions."""
+    DIFFERENT filter in the set. O(n^2) level walks — kept as the
+    reference implementation the incremental :class:`IncrementalCover`
+    is equivalence-tested against; the hot path no longer calls it per
+    change (ADR 016 / the ROADMAP open item)."""
     fs = set(filters)
     out = set()
     for f in fs:
         if not any(g != f and filter_subsumes(g, f) for g in fs):
             out.add(f)
     return out
+
+
+class IncrementalCover:
+    """Refcounted filter set with an incrementally-maintained minimal
+    cover (the ROADMAP open item: the O(n^2) per-change recompute dies
+    before per-user filter shapes meet the session ledger).
+
+    * ``add(f)``    — one subsumption scan of the current cover: either
+      ``f`` hides behind an existing cover member (recorded with that
+      member as its *witness*), or ``f`` joins the cover and demotes
+      every member it subsumes (their hidden filters are re-witnessed
+      by ``f`` — subsumption is transitive, so witnesses stay valid).
+    * ``remove(f)`` — when a cover member's refcount hits zero, only
+      the filters it witnessed are re-examined: each re-hides behind a
+      surviving cover member or promotes (promotion reuses the add
+      path, so two re-exposed filters that subsume each other still
+      collapse).
+
+    Both operations are O(cover + re-exposed) instead of O(n^2) over
+    the whole set. Invariant (equivalence-tested in test_cluster.py):
+    ``self.cover == minimal_cover(self.refs.keys())`` after any
+    sequence of add/remove."""
+
+    __slots__ = ("refs", "cover", "_witness")
+
+    def __init__(self, filters=()) -> None:
+        self.refs: dict[str, int] = {}
+        self.cover: set[str] = set()
+        self._witness: dict[str, str] = {}   # hidden filter -> cover member
+        for f in filters:
+            self.add(f)
+
+    def add(self, filt: str) -> None:
+        n = self.refs.get(filt, 0)
+        self.refs[filt] = n + 1
+        if n:
+            return                          # already placed
+        for c in self.cover:
+            if c != filt and filter_subsumes(c, filt):
+                self._witness[filt] = c
+                return
+        self._promote(filt)
+
+    def _promote(self, filt: str) -> None:
+        """Install ``filt`` as a cover member, demoting every member it
+        subsumes (and re-witnessing their hidden filters to ``filt``)."""
+        demoted = [c for c in self.cover
+                   if c != filt and filter_subsumes(filt, c)]
+        for c in demoted:
+            self.cover.discard(c)
+            self._witness[c] = filt
+        if demoted:
+            for h, w in self._witness.items():
+                if w in demoted:
+                    self._witness[h] = filt
+        self.cover.add(filt)
+
+    def remove(self, filt: str) -> None:
+        n = self.refs.get(filt, 0)
+        if n > 1:
+            self.refs[filt] = n - 1
+            return
+        if n == 0:
+            return
+        del self.refs[filt]
+        if filt in self._witness:
+            del self._witness[filt]
+            return
+        self.cover.discard(filt)
+        exposed = [h for h, w in self._witness.items() if w == filt]
+        for h in exposed:
+            del self._witness[h]
+        for h in exposed:
+            for c in self.cover:
+                if c != h and filter_subsumes(c, h):
+                    self._witness[h] = c
+                    break
+            else:
+                self._promote(h)
+
+
+class ShareLedger:
+    """Cluster-wide ``$share`` group-membership ledger (ADR 016).
+
+    Maps ``(group, filter)`` to live-member counts per *member id* —
+    node ids for the federation, worker ids for the in-process delivery
+    pool (broker/workers.py routes its gossip through this same class,
+    so a filter shared across both a pool and a peer node resolves
+    ownership through one set of rules). Ownership is deterministic
+    with no coordination round: the lowest member id with a live count
+    owns the pick for every publish (the ADR-005 fairness trade,
+    documented there and in ADR 016). A key nobody (else) claims is
+    owned locally — at worst a short double-delivery window while
+    gossip converges, never a dropped message."""
+
+    __slots__ = ("self_id", "_members")
+
+    def __init__(self, self_id) -> None:
+        self.self_id = self_id
+        # (group, filter) -> member id -> live local-subscription count
+        self._members: dict[tuple[str, str], dict] = {}
+
+    def set_member(self, member, key: tuple[str, str], n: int) -> None:
+        per = self._members.get(key)
+        if n > 0:
+            if per is None:
+                per = self._members[key] = {}
+            per[member] = n
+        elif per is not None:
+            per.pop(member, None)
+            if not per:
+                del self._members[key]
+
+    def set_local(self, key: tuple[str, str], n: int) -> None:
+        self.set_member(self.self_id, key, n)
+
+    def replace_member(self, member, counts: dict) -> None:
+        """Full per-member replacement: keys absent from ``counts`` are
+        cleared (a restarted member's stale claims must not linger)."""
+        for key in [k for k, per in self._members.items()
+                    if member in per and k not in counts]:
+            self.set_member(member, key, 0)
+        for key, n in counts.items():
+            self.set_member(member, key, int(n))
+
+    def drop_member(self, member) -> None:
+        self.replace_member(member, {})
+
+    def members_for(self, key: tuple[str, str]) -> list:
+        per = self._members.get(key)
+        return sorted(m for m, n in (per or {}).items() if n > 0)
+
+    def owns(self, key: tuple[str, str]) -> bool:
+        members = self.members_for(key)
+        if not members:
+            return True     # nobody claims it: local delivery is safe
+        return members[0] == self.self_id
+
+    @property
+    def group_count(self) -> int:
+        return len(self._members)
 
 
 # ----------------------------------------------------------------------
@@ -172,6 +314,14 @@ class RouteTable:
         self.nodes: dict[str, NodeRoutes] = {}
         self._index = TopicIndex()          # remote filters, cid=node
         self._cache = VersionedTopicCache(maxsize=2048)
+        # per-peer incrementally-maintained advertisement covers
+        # (ADR 016): each holds local filters + every OTHER peer's
+        # filters (split horizon), updated in O(cover) per change
+        # instead of the old O(n^2) minimal_cover recompute per link
+        self._covers: dict[str, IncrementalCover] = {}
+        # cluster-wide $share group-membership ledger (ADR 016): fed by
+        # cluster/sessions.py, consulted by the broker's shared fan-out
+        self.shares = ShareLedger(node_id)
 
     # -- local side ----------------------------------------------------
 
@@ -180,27 +330,49 @@ class RouteTable:
         True when the filter is new (advertisements may change)."""
         n = self.local.get(filt, 0)
         self.local[filt] = n + 1
+        if n == 0:
+            for cov in self._covers.values():
+                cov.add(filt)
         return n == 0
 
     def note_local_unsubscribe(self, filt: str) -> bool:
         n = self.local.get(filt, 0)
         if n <= 1:
             existed = self.local.pop(filt, None) is not None
+            if existed:
+                for cov in self._covers.values():
+                    cov.remove(filt)
             return existed
         self.local[filt] = n - 1
         return False
+
+    def _cover_update(self, node: str, add, remove) -> None:
+        """Apply one remote node's effective filter changes to every
+        per-peer cover except the node's own (split horizon)."""
+        for peer, cov in self._covers.items():
+            if peer == node:
+                continue
+            for f in add:
+                cov.add(f)
+            for f in remove:
+                cov.remove(f)
 
     def advertisement_for(self, peer: str) -> set[str]:
         """The aggregated filter set this node advertises to ``peer``:
         local filters plus everything learned from OTHER peers (routes
         are transitive — a line topology forwards across the middle
         node), minus anything learned only from ``peer`` itself (split
-        horizon: never advertise a peer's own routes back at it)."""
-        pool = set(self.local)
-        for node, nr in self.nodes.items():
-            if node != peer:
-                pool |= nr.filters
-        return minimal_cover(pool)
+        horizon: never advertise a peer's own routes back at it).
+        Maintained incrementally per peer (ADR 016); the one full
+        build happens lazily at first ask for that peer."""
+        cov = self._covers.get(peer)
+        if cov is None:
+            cov = self._covers[peer] = IncrementalCover(self.local)
+            for node, nr in self.nodes.items():
+                if node != peer:
+                    for f in nr.filters:
+                        cov.add(f)
+        return set(cov.cover)
 
     # -- remote side ---------------------------------------------------
 
@@ -215,14 +387,17 @@ class RouteTable:
             return False
         fresh = set(filters)
         if nr is not None:
-            for f in nr.filters - fresh:
+            removed = nr.filters - fresh
+            for f in removed:
                 self._index.unsubscribe(node, f)
             add = fresh - nr.filters
         else:
+            removed = set()
             add = fresh
         for f in add:
             self._index.subscribe(node, Subscription(filter=f))
         self.nodes[node] = NodeRoutes(epoch, seq, fresh)
+        self._cover_update(node, add, removed)
         return True
 
     def apply_delta(self, node: str, epoch: int, seq: int,
@@ -233,15 +408,19 @@ class RouteTable:
         nr = self.nodes.get(node)
         if nr is None or epoch != nr.epoch or seq != nr.seq + 1:
             return False
+        removed, added = [], []
         for f in remove:
             if f in nr.filters:
                 nr.filters.discard(f)
                 self._index.unsubscribe(node, f)
+                removed.append(f)
         for f in add:
             if f not in nr.filters:
                 nr.filters.add(f)
                 self._index.subscribe(node, Subscription(filter=f))
+                added.append(f)
         nr.seq = seq
+        self._cover_update(node, added, removed)
         return True
 
     def flush_node(self, node: str) -> int:
@@ -252,6 +431,7 @@ class RouteTable:
             return 0
         for f in nr.filters:
             self._index.unsubscribe(node, f)
+        self._cover_update(node, (), nr.filters)
         return len(nr.filters)
 
     def nodes_for(self, topic: str) -> frozenset[str]:

@@ -67,6 +67,10 @@ CLIENT_WRITE = "client.write"          # broker client writer loop (ADR 012)
 LISTENER_ACCEPT = "listener.accept"    # broker connection accept (ADR 012)
 CLUSTER_LINK = "cluster.link"          # bridge link connect/pump (ADR 013)
 CLUSTER_ROUTE_APPLY = "cluster.route_apply"  # route snapshot/delta apply
+CLUSTER_SESSION_SYNC = "cluster.session_sync"  # session replication send/
+                                       # apply (ADR 016; keyed per peer)
+CLUSTER_TAKEOVER = "cluster.takeover"  # CONNECT takeover/state handoff
+                                       # (ADR 016; keyed per prior owner)
 STORAGE_PUT = "storage.put"            # journal enqueue boundary (ADR 014)
 STORAGE_COMMIT = "storage.commit"      # journal writer-thread group commit
 STORAGE_RESTORE = "storage.restore"    # per-record boot restore parse

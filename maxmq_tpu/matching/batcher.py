@@ -251,14 +251,7 @@ class MicroBatcher:
             if self._pending:
                 self._wakeup.set()  # leftovers form the next batch
             topics = [t for t, _ in batch]
-            self.batches += 1
-            self.batched_topics += len(batch)
-            self.largest_batch = max(self.largest_batch, len(batch))
-            tracer = self.tracer
-            if tracer is not None and tracer.sample_n:
-                now = tracer.clock()    # ADR 015: coalescing-wait ends
-                for _, fut in batch:
-                    fut._t_dispatch = now
+            self._note_batch(batch)
             ver = self._subs_version()   # results valid as-of dispatch
             if self._should_bypass(len(batch)):
                 self._run_bypass(batch, topics, ver)
@@ -266,6 +259,18 @@ class MicroBatcher:
                 await self._dispatch_pipelined(loop, batch, topics, ver)
             else:
                 await self._run_whole_batch(loop, batch, topics, ver)
+
+    def _note_batch(self, batch) -> None:
+        """Batch-size counters + the ADR-015 dispatch marks (the
+        coalescing-wait span ends for every future in the batch)."""
+        self.batches += 1
+        self.batched_topics += len(batch)
+        self.largest_batch = max(self.largest_batch, len(batch))
+        tracer = self.tracer
+        if tracer is not None and tracer.sample_n:
+            now = tracer.clock()
+            for _, fut in batch:
+                fut._t_dispatch = now
 
     async def _maybe_window(self) -> None:
         """Adaptive coalescing window: waiting only pays when the device

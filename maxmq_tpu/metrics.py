@@ -260,6 +260,24 @@ def _cpu_profile(seconds: float, interval: float = 0.005) -> str:
     return "\n".join(out) + "\n"
 
 
+def _route_get(handler, registry, tracer, path: str, profiling: bool,
+               target: str):
+    """Resolve one metrics-server GET target to (body, content-type),
+    or None for a 404 — the endpoint table for MetricsServer.Handler."""
+    import json
+    if target == path:
+        return (registry.expose().encode(),
+                "text/plain; version=0.0.4; charset=utf-8")
+    if tracer is not None and target == "/traces":
+        return json.dumps(tracer.report()).encode(), "application/json"
+    if tracer is not None and target == "/traces/chrome":
+        return (json.dumps(tracer.chrome_events()).encode(),
+                "application/json")
+    if profiling and target.startswith("/debug/pprof"):
+        return handler._pprof(target)
+    return None
+
+
 class MetricsServer:
     """Threaded HTTP server for /metrics, optional /debug/pprof/*, and
     (when a tracer is attached, ADR 015) the flight-recorder endpoints
@@ -291,22 +309,13 @@ class MetricsServer:
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
-                import json
                 target = self.path.split("?", 1)[0]
-                if target == path:
-                    body = registry.expose().encode()
-                    ctype = "text/plain; version=0.0.4; charset=utf-8"
-                elif tracer is not None and target == "/traces":
-                    body = json.dumps(tracer.report()).encode()
-                    ctype = "application/json"
-                elif tracer is not None and target == "/traces/chrome":
-                    body = json.dumps(tracer.chrome_events()).encode()
-                    ctype = "application/json"
-                elif profiling and target.startswith("/debug/pprof"):
-                    body, ctype = self._pprof(target)
-                else:
+                hit = _route_get(self, registry, tracer, path, profiling,
+                                 target)
+                if hit is None:
                     self.send_error(404)
                     return
+                body, ctype = hit
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
@@ -513,6 +522,63 @@ def _register_cluster_metrics(registry: Registry, broker) -> None:
         "maxmq_cluster_link_forwards_total", "counter",
         "Per-peer forwards enqueued; same cardinality bound",
         lambda: _peer_series(lambda lk: lk.forwards_sent))
+    _register_session_metrics(registry, mgr)
+
+
+def _register_session_metrics(registry: Registry, mgr) -> None:
+    """ADR-016 federated-session observability: ledger size, takeover
+    outcomes (incl. every degradation rung), replication-barrier
+    health, and the cluster-wide $share group count."""
+    sess = getattr(mgr, "sessions", None)
+    if sess is None:
+        return
+    for name, attr, help_ in (
+            ("ledger", "ledger_size",
+             "Sessions tracked in the cluster ledger (local + remote)"),
+            ("local", "local_sessions",
+             "Sessions this node currently owns"),
+            ("share_groups", "share_groups",
+             "Cluster-wide $share (group, filter) pairs with live "
+             "members")):
+        registry.gauge_func(f"maxmq_cluster_session_{name}", help_,
+                            lambda a=attr: getattr(sess, a))
+    for name, help_ in (
+            ("takeovers", "Remote sessions taken over locally at "
+             "CONNECT (epoch-fenced)"),
+            ("takeovers_degraded", "Takeovers degraded to fresh-"
+             "session-with-counted-loss (fault/partition)"),
+            ("takeovers_stale", "Takeovers that timed out pulling "
+             "fresh state and installed the replicated ledger copy"),
+            ("sessions_lost", "Local sessions claimed away by a "
+             "higher fencing token (client got SessionTakenOver)"),
+            ("state_transfers", "Full session-state handoffs received "
+             "during takeover"),
+            ("claims_rejected", "Stale claims fenced off by a higher "
+             "local token"),
+            ("purges", "Cluster-wide session purges applied"),
+            ("relays", "Session messages relayed onward (transitive "
+             "replication)"),
+            ("sync_flushes", "Replication flushes put on the wire"),
+            ("sync_ops", "Inflight-record replication ops sent"),
+            ("sync_acks", "Replication messages acknowledged by peers"),
+            ("sync_degraded", "Replication barriers released without "
+             "full peer durability (lag/partition/timeout)"),
+            ("sync_timeouts", "Replication barriers released by the "
+             "sync timeout"),
+            ("sync_faults", "Injected cluster.session_sync faults "
+             "tripped"),
+            ("sync_send_failures", "Session messages a link refused "
+             "to enqueue"),
+            ("sync_resyncs", "Per-link resyncs healing a refused "
+             "replication send on a live link"),
+            ("sync_barrier_waits", "Publisher acks that waited on a "
+             "replication barrier"),
+            ("digest_mismatches", "Takeovers whose installed inflight "
+             "window disagreed with the owner's digest"),
+            ("restore_errors", "Ledger journal rows that failed to "
+             "parse at restore")):
+        registry.counter_func(f"maxmq_cluster_session_{name}_total",
+                              help_, lambda n=name: getattr(sess, n))
 
 
 def _register_storage_metrics(registry: Registry, broker) -> None:

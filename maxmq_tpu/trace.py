@@ -26,6 +26,9 @@ Stage model (see docs/adr/015-publish-tracing.md for the contract):
 ``drain``          per-subscriber outbound enqueue -> writer flush
                    (completes after the publisher's e2e; capped at
                    MAX_DRAIN_SPANS subscribers per trace)
+``takeover``       cross-node session takeover leg at CONNECT (ADR
+                   016; histogram-only like journal_commit — it is a
+                   connection-path span, not a publish-path one)
 
 Cost contract: with ``sample_n == 0`` every instrumented site reduces
 to one attribute check/branch and **zero allocations** (asserted by
@@ -49,9 +52,9 @@ from .metrics import Histogram
 # one publish)
 STAGES = ("decode", "admission", "match_queue", "match_device",
           "pipeline_wait", "fanout", "bridge", "journal_commit",
-          "barrier", "ack", "drain")
+          "barrier", "ack", "drain", "takeover")
 CRITICAL_STAGES = frozenset(
-    s for s in STAGES if s not in ("drain", "journal_commit"))
+    s for s in STAGES if s not in ("drain", "journal_commit", "takeover"))
 
 MAX_DRAIN_SPANS = 8     # per-trace cap on recorded subscriber drains
 SLOWEST_KEEP = 8        # slowest-ever publishes kept beside the ring

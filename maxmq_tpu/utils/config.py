@@ -85,6 +85,18 @@ class Config:
     cluster_link_byte_budget: int = 4 << 20  # per-link queued bytes; 0 off
     cluster_link_keepalive: float = 10.0     # bridge ping interval, seconds
 
+    # -- federated sessions (ADR 016) ----------------------------------------
+    # replicate session metadata + inflight windows to bridge peers so
+    # a client reconnecting to ANY node resumes with session-present=1
+    cluster_session_replication: bool = True
+    # inflight replication policy: always = publisher QoS acks wait
+    # (bounded) for peer replication acks — a SIGKILLed node's peer can
+    # redeliver every PUBACKed message; batched = replicate async (a
+    # crash can lose the in-flight window); off = metadata only
+    cluster_session_sync: str = "batched"
+    cluster_session_sync_timeout_ms: int = 750      # barrier degrade bound
+    cluster_session_takeover_timeout_ms: int = 750  # state-pull wait bound
+
     # -- publish-path tracing (ADR 015) ---------------------------------------
     # sample every Nth publish into the pipeline tracer (0 = off; off
     # costs one branch per stage). Sampled publishes feed the per-stage

@@ -1,0 +1,790 @@
+"""Federated sessions e2e suite (ADR 016): session replication across
+bridge peers, epoch-fenced takeover (dual-CONNECT split brain resolves
+to exactly one live session, loser disconnected with SessionTakenOver),
+parked-inflight transfer with zero PUBACKed loss, cluster-wide $share
+exactly-once across a 3-node line, degradation under the
+cluster.session_sync / cluster.takeover fault sites (CONNECT never
+wedges), plus the incremental minimal-cover and ShareLedger units and
+the SIGKILL node-kill failover harness (subprocess brokers in the
+test_storage_recovery.py style)."""
+
+import asyncio
+import json
+import os
+import random
+import signal
+import socket
+import sqlite3
+import subprocess
+import sys
+import time
+from contextlib import asynccontextmanager
+
+import pytest
+
+from maxmq_tpu import faults
+from maxmq_tpu.broker import Broker, BrokerOptions, Capabilities, TCPListener
+from maxmq_tpu.broker.workers import BusHook
+from maxmq_tpu.cluster import (ClusterManager, IncrementalCover, PeerSpec,
+                               SessionEntry, ShareLedger, minimal_cover)
+from maxmq_tpu.hooks import AllowHook
+from maxmq_tpu.matching.trie import SubscriberSet
+from maxmq_tpu.mqtt_client import MQTTClient
+from maxmq_tpu.protocol import codes
+from maxmq_tpu.protocol.packets import Subscription
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+async def make_node(**caps) -> Broker:
+    caps.setdefault("sys_topic_interval", 0)
+    b = Broker(BrokerOptions(capabilities=Capabilities(**caps)))
+    b.add_hook(AllowHook())
+    listener = b.add_listener(TCPListener("t", "127.0.0.1:0"))
+    await b.serve()
+    b.test_port = listener._server.sockets[0].getsockname()[1]
+    return b
+
+
+@asynccontextmanager
+async def cluster(topology: dict[str, list[str]], **kw):
+    """One broker + session-federated manager per topology entry."""
+    kw.setdefault("keepalive", 0.5)
+    kw.setdefault("backoff_initial_s", 0.05)
+    kw.setdefault("backoff_max_s", 0.5)
+    kw.setdefault("session_sync", "always")
+    kw.setdefault("session_sync_timeout_ms", 500)
+    kw.setdefault("session_takeover_timeout_ms", 500)
+    brokers: dict[str, Broker] = {}
+    managers: dict[str, ClusterManager] = {}
+    for name in topology:
+        brokers[name] = await make_node()
+    for name, peers in topology.items():
+        specs = [PeerSpec(p, "127.0.0.1", brokers[p].test_port)
+                 for p in peers]
+        mgr = ClusterManager(brokers[name], name, specs, **kw)
+        brokers[name].attach_cluster(mgr)
+        managers[name] = mgr
+        await mgr.start()
+    try:
+        yield brokers, managers
+    finally:
+        for b in brokers.values():
+            await b.close()
+
+
+async def wait_for(predicate, timeout: float = 10.0, what: str = ""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        await asyncio.sleep(0.02)
+    raise AssertionError(f"condition not reached in {timeout}s: {what}")
+
+
+async def connect(broker: Broker, client_id: str, **kw) -> MQTTClient:
+    c = MQTTClient(client_id=client_id, **kw)
+    await c.connect("127.0.0.1", broker.test_port)
+    return c
+
+
+# ----------------------------------------------------------------------
+# Units: incremental cover, share ledger, fencing tokens, entry codec
+# ----------------------------------------------------------------------
+
+
+def _random_filter(rng) -> str:
+    levels = []
+    for _ in range(rng.randint(1, 4)):
+        levels.append(rng.choice(["a", "b", "c", "+", "x"]))
+    if rng.random() < 0.3:
+        levels.append("#")
+    return "/".join(levels)
+
+
+def test_incremental_cover_equivalence_randomized():
+    """The incremental cover must equal minimal_cover() after any
+    add/remove sequence — 200 random ops across duplicate, subsuming,
+    and disjoint filter shapes."""
+    rng = random.Random(16)
+    cov = IncrementalCover()
+    live: list[str] = []
+    for _ in range(200):
+        if live and rng.random() < 0.45:
+            f = live.pop(rng.randrange(len(live)))
+            cov.remove(f)
+        else:
+            f = _random_filter(rng)
+            live.append(f)
+            cov.add(f)
+        assert cov.cover == minimal_cover(live), \
+            (sorted(live), sorted(cov.cover))
+    for f in list(live):
+        cov.remove(f)
+    assert cov.cover == set() and cov.refs == {}
+
+
+def test_incremental_cover_re_expose_collapses():
+    """Removing a broad cover member re-exposes what it subsumed, and
+    re-exposed filters that subsume each other still collapse."""
+    cov = IncrementalCover(["#", "a/#", "a/b", "c"])
+    assert cov.cover == {"#"}
+    cov.remove("#")
+    assert cov.cover == {"a/#", "c"}        # a/b re-hid behind a/#
+    cov.remove("a/#")
+    assert cov.cover == {"a/b", "c"}
+
+
+def test_share_ledger_ownership_rules():
+    led = ShareLedger("B")
+    key = ("g", "$share/g/s/t")
+    assert led.owns(key)                    # nobody claims: local wins
+    led.set_local(key, 1)
+    assert led.owns(key)
+    led.set_member("A", key, 2)
+    assert not led.owns(key)                # lowest member id owns
+    led.set_member("A", key, 0)
+    assert led.owns(key)
+    led.replace_member("C", {key: 1, ("g2", "$share/g2/x"): 1})
+    assert led.owns(key)                    # B < C
+    led.set_local(key, 0)
+    assert not led.owns(key)                # only C holds members now
+    led.drop_member("C")
+    assert led.owns(key) and led.group_count == 0
+
+
+def test_fencing_token_ordering_and_entry_roundtrip():
+    a = SessionEntry("c", "A", session_epoch=3, boot_epoch=100)
+    b = SessionEntry("c", "B", session_epoch=4, boot_epoch=50)
+    assert b.token > a.token                # session_epoch dominates
+    c = SessionEntry("c", "C", session_epoch=4, boot_epoch=60)
+    assert c.token > b.token                # boot_epoch breaks the tie
+    d = SessionEntry("c", "D", session_epoch=4, boot_epoch=60)
+    assert d.token > c.token                # node id breaks exact ties
+    e = SessionEntry("cl", "A", 7, 9, expiry=30, expiry_set=True,
+                     protocol_version=5, connected=True,
+                     subs=[["t/#", 1, 0, 0, 0, 0]],
+                     shares=[["g", "$share/g/t/#"]], digest=(2, 5))
+    back = SessionEntry.from_meta_json(e.meta_json())
+    assert (back.cid, back.owner, back.token) == ("cl", "A", e.token)
+    assert back.subs == e.subs and back.shares == e.shares
+    assert back.digest == (2, 5) and back.expiry == 30 and back.expiry_set
+
+
+# ----------------------------------------------------------------------
+# Replication + takeover (in-process 2-node)
+# ----------------------------------------------------------------------
+
+
+async def test_session_replicates_and_journals():
+    """Session metadata (subs, $share, epoch) reaches the peer's ledger
+    and its write-behind journal shortly after SUBSCRIBE."""
+    async with cluster({"A": ["B"], "B": ["A"]}) as (brokers, mgrs):
+        from maxmq_tpu.hooks.storage import MemoryStore, StorageHook
+        hook = StorageHook(MemoryStore())
+        brokers["B"].add_hook(hook)
+        brokers["B"]._storage_hook = hook
+        c = await connect(brokers["A"], "repl", version=5,
+                          clean_start=False, session_expiry=300)
+        await c.subscribe(("t/#", 1), ("$share/g/s/t", 0))
+        sB = mgrs["B"].sessions
+        await wait_for(lambda: "repl" in sB.ledger
+                       and len(sB.ledger["repl"].subs) == 2,
+                       what="entry replicated to B")
+        entry = sB.ledger["repl"]
+        assert entry.owner == "A" and entry.connected
+        assert ["g", "$share/g/s/t"] in entry.shares
+        # journaled through the storage hook (ADR 014 path)
+        raw = hook.store.get("cluster_sessions", "repl")
+        assert raw is not None
+        assert json.loads(raw)["owner"] == "A"
+        # the cluster-wide share ledger learned A's membership
+        assert not sB.owns_share("g", "$share/g/s/t")   # A < B
+        await c.disconnect()
+        await wait_for(lambda: not sB.ledger["repl"].connected,
+                       what="disconnect replicated")
+
+
+async def test_dual_connect_split_brain_resolves_to_one_session():
+    """Dual CONNECT for one client id: the later claim's higher fencing
+    token wins, the losing node's client is disconnected with v5
+    SessionTakenOver, state (subs + inflight digest) transfers, and
+    session epochs strictly increase across repeated takeovers."""
+    async with cluster({"A": ["B"], "B": ["A"]}) as (brokers, mgrs):
+        A, B = brokers["A"], brokers["B"]
+        sA, sB = mgrs["A"].sessions, mgrs["B"].sessions
+        c1 = await connect(A, "dual", version=5, clean_start=False,
+                           session_expiry=3600)
+        await c1.subscribe(("t/#", 1))
+        await wait_for(lambda: "dual" in sB.ledger, what="replicated")
+        epochs = [sB.ledger["dual"].session_epoch]
+
+        c2 = await connect(B, "dual", version=5, clean_start=False,
+                           session_expiry=3600)
+        assert c2.session_present is True
+        await wait_for(lambda: c1.disconnect_packet is not None,
+                       what="loser disconnected")
+        assert (c1.disconnect_packet.reason_code
+                == codes.ErrSessionTakenOver.value)
+        await wait_for(lambda: A.clients.get("dual") is None,
+                       what="A dropped its replica")
+        # exactly one live session: a publish at A routes to B's client
+        assert sA.ledger["dual"].owner == "B"
+        epochs.append(sB.ledger["dual"].session_epoch)
+        pub = await connect(A, "pub-a")
+        await pub.publish("t/x", b"after-takeover", qos=1)
+        msg = await c2.next_message(timeout=5)
+        assert msg.payload == b"after-takeover"
+        assert sB.takeovers == 1 and sA.sessions_lost == 1
+        assert sB.state_transfers == 1
+
+        # take it back: epochs keep strictly increasing
+        c3 = await connect(A, "dual", version=5, clean_start=False,
+                           session_expiry=3600)
+        assert c3.session_present is True
+        await wait_for(lambda: sB.ledger["dual"].owner == "A",
+                       what="ownership returned to A")
+        epochs.append(sA.ledger["dual"].session_epoch)
+        assert epochs[0] < epochs[1] < epochs[2], epochs
+        for c in (c2, c3, pub):
+            await c.close()
+
+
+async def test_offline_inflight_transfers_on_takeover():
+    """QoS1 messages parked for an OFFLINE persistent session on A are
+    redelivered after the client reconnects to B — the parked window
+    moves with the session (state pull from the live prior owner)."""
+    async with cluster({"A": ["B"], "B": ["A"]}) as (brokers, mgrs):
+        A, B = brokers["A"], brokers["B"]
+        sub = await connect(A, "park", version=5, clean_start=False,
+                            session_expiry=3600)
+        await sub.subscribe(("park/#", 1))
+        await sub.disconnect()
+        pub = await connect(A, "park-pub")
+        sent = set()
+        for i in range(20):
+            payload = f"p-{i}".encode()
+            await pub.publish("park/q", payload, qos=1)
+            sent.add(payload)
+        sub2 = await connect(B, "park", version=5, clean_start=False,
+                             session_expiry=3600)
+        assert sub2.session_present is True
+        got = set()
+        while len(got) < len(sent):
+            m = await sub2.next_message(timeout=5)
+            got.add(m.payload)
+        assert got == sent
+        assert mgrs["B"].sessions.digest_mismatches == 0
+        await sub2.close()
+        await pub.close()
+
+
+async def test_clean_start_purges_replicated_state():
+    """A clean-start CONNECT at a peer purges the replicated session
+    instead of resuming it: session-present=0 everywhere after."""
+    async with cluster({"A": ["B"], "B": ["A"]}) as (brokers, mgrs):
+        c1 = await connect(brokers["A"], "cs", version=5,
+                           clean_start=False, session_expiry=3600)
+        await c1.subscribe(("t/#", 1))
+        sB = mgrs["B"].sessions
+        await wait_for(lambda: "cs" in sB.ledger
+                       and sB.ledger["cs"].subs, what="replicated")
+        await c1.disconnect()
+        c2 = await connect(brokers["B"], "cs", version=5,
+                           clean_start=True)
+        assert c2.session_present in (False, None)
+        await wait_for(lambda: not sB.ledger["cs"].subs
+                       and not mgrs["A"].sessions.ledger["cs"].subs,
+                       what="replicated subs purged cluster-wide")
+        await c2.close()
+
+
+# ----------------------------------------------------------------------
+# Cluster-wide $share (3-node line)
+# ----------------------------------------------------------------------
+
+
+async def test_cluster_wide_share_exactly_once_on_line():
+    """A $share group with one member on each node of a 3-node line
+    receives every matching publish exactly once CLUSTER-WIDE — the
+    ledger's lowest-live-member-node rule, with membership replicated
+    transitively across the middle node."""
+    line = {"A": ["B"], "B": ["A", "C"], "C": ["B"]}
+    async with cluster(line, session_sync="batched") as (brokers, mgrs):
+        members = {}
+        for name in ("A", "B", "C"):
+            m = await connect(brokers[name], f"sh-{name}")
+            await m.subscribe(("$share/g/s/t", 0))
+            members[name] = m
+        key = ("g", "$share/g/s/t")
+        for name in ("A", "B", "C"):
+            await wait_for(
+                lambda n=name: len(mgrs[n].routes.shares.members_for(key))
+                == 3, what=f"{name} sees all 3 member nodes")
+        # publish at each node; exactly one member must receive each
+        pubs = {n: await connect(brokers[n], f"shpub-{n}")
+                for n in ("A", "B", "C")}
+        n_msgs = 0
+        for origin in ("A", "B", "C"):
+            for i in range(4):
+                await pubs[origin].publish("s/t", f"{origin}-{i}".encode())
+                n_msgs += 1
+        got: list[tuple[str, bytes]] = []
+
+        async def drain(name, cli):
+            while True:
+                try:
+                    m = await cli.next_message(timeout=1.0)
+                except asyncio.TimeoutError:
+                    return
+                got.append((name, m.payload))
+
+        await asyncio.gather(*(drain(n, c) for n, c in members.items()))
+        payloads = [p for _, p in got]
+        assert len(payloads) == n_msgs, \
+            f"expected {n_msgs} exactly-once deliveries, saw {len(payloads)}"
+        assert len(set(payloads)) == n_msgs      # no duplicates either
+        # ownership is deterministic: every delivery landed on ONE node
+        assert len({n for n, _ in got}) == 1
+        for c in list(members.values()) + list(pubs.values()):
+            await c.close()
+
+
+async def test_share_pool_and_cluster_ledgers_compose():
+    """The in-process worker pool and the cluster federation route
+    $share ownership through the SAME ledger interface — a filter
+    shared across both a pool and a peer node delivers at most once:
+    the pool hook drops non-owned groups from the select set, the
+    cluster guard skips groups a peer node owns."""
+    async with cluster({"A": ["B"], "B": ["A"]},
+                       session_sync="batched") as (brokers, mgrs):
+        A = brokers["A"]
+        hook = BusHook(worker_id=1, bus_path="/tmp/unused")
+        hook.broker = A
+        A.hooks.add(hook)
+        member = await connect(A, "pc-member")
+        await member.subscribe(("$share/g/s/t", 0))
+        key = ("g", "$share/g/s/t")
+        pub = await connect(A, "pc-pub")
+
+        # pool gossip: worker 0 (lower id) also has members -> worker 1
+        # does not own the pick; no local delivery even though the
+        # cluster side would deliver here
+        hook.shares.replace_member(0, {key: 1})
+        await pub.publish("s/t", b"pool-owned-elsewhere")
+        with pytest.raises(asyncio.TimeoutError):
+            await member.next_message(timeout=0.4)
+
+        # pool owns, but a lower-id CLUSTER node has live members ->
+        # the cluster guard skips the group
+        hook.shares.replace_member(0, {})
+        mgrs["A"].routes.shares.set_member("0-node", key, 1)
+        await pub.publish("s/t", b"cluster-owned-elsewhere")
+        with pytest.raises(asyncio.TimeoutError):
+            await member.next_message(timeout=0.4)
+
+        # both ledgers agree this instance owns -> exactly one delivery
+        mgrs["A"].routes.shares.set_member("0-node", key, 0)
+        await pub.publish("s/t", b"owned-here")
+        m = await member.next_message(timeout=5)
+        assert m.payload == b"owned-here"
+        await member.close()
+        await pub.close()
+
+
+def test_bus_hook_select_routes_through_ledger():
+    """BusHook.on_select_subscribers consults the ShareLedger (the
+    satellite regression: pool membership no longer lives in a private
+    dict with its own ownership rules)."""
+    hook = BusHook(worker_id=2, bus_path="/tmp/unused")
+    key = ("g", "$share/g/a/b")
+    sset = SubscriberSet()
+    sset.add_shared("g", "$share/g/a/b", "c1",
+                    Subscription(filter="$share/g/a/b"))
+    hook.shares.replace_member(0, {key: 1})
+    out = hook.on_select_subscribers(sset.select_copy(), None)
+    assert key not in out.shared            # worker 0 owns
+    hook.shares.replace_member(0, {})
+    out = hook.on_select_subscribers(sset.select_copy(), None)
+    assert key in out.shared                # unclaimed: we deliver
+
+
+# ----------------------------------------------------------------------
+# Degradation: fault sites, lag, and the never-wedge contract
+# ----------------------------------------------------------------------
+
+
+async def test_session_sync_fault_degrades_connect_never_wedges():
+    """With cluster.session_sync dropping every replication send from
+    A, B's ledger never learns the session — the client's reconnect at
+    B degrades to a FRESH session (counted) and the CONNACK still
+    arrives promptly. QoS acks at A degrade through the bounded
+    replication barrier instead of wedging the publisher."""
+    async with cluster({"A": ["B"], "B": ["A"]},
+                       session_sync_timeout_ms=200) as (brokers, mgrs):
+        sA, sB = mgrs["A"].sessions, mgrs["B"].sessions
+        await wait_for(lambda: mgrs["A"].links_up == 1, what="link up")
+        faults.arm("cluster.session_sync#B", "drop", count=-1)
+        c1 = await connect(brokers["A"], "deg", version=5,
+                           clean_start=False, session_expiry=3600)
+        await c1.subscribe(("d/#", 1))
+        # ack-coupled publish completes within the degrade bound
+        pub = await connect(brokers["A"], "deg-pub")
+        t0 = time.monotonic()
+        await pub.publish("d/x", b"m", qos=1, timeout=5.0)
+        assert time.monotonic() - t0 < 2.0
+        await wait_for(lambda: sA.sync_faults > 0, what="fault counted")
+        assert "deg" not in sB.ledger
+        await c1.disconnect()
+        t0 = time.monotonic()
+        c2 = await connect(brokers["B"], "deg", version=5,
+                           clean_start=False, session_expiry=3600)
+        assert time.monotonic() - t0 < 3.0      # CONNECT never wedges
+        assert c2.session_present in (False, None)  # fresh + counted loss
+        assert sA.sync_degraded + sA.sync_timeouts > 0
+        await c2.close()
+        await pub.close()
+
+
+async def test_takeover_fault_degrades_to_fresh_session():
+    """cluster.takeover drop mode: the handoff path is unusable — the
+    reconnect still completes, degraded to a fresh session, counted in
+    takeovers_degraded (visible in $SYS/metrics)."""
+    async with cluster({"A": ["B"], "B": ["A"]}) as (brokers, mgrs):
+        c1 = await connect(brokers["A"], "tof", version=5,
+                           clean_start=False, session_expiry=3600)
+        await c1.subscribe(("t/#", 1))
+        sB = mgrs["B"].sessions
+        await wait_for(lambda: "tof" in sB.ledger, what="replicated")
+        faults.arm("cluster.takeover#A", "drop", count=1)
+        c2 = await connect(brokers["B"], "tof", version=5,
+                           clean_start=False, session_expiry=3600)
+        assert sB.takeovers_degraded == 1
+        assert sB.takeovers == 0    # degraded, not ALSO successful
+        # the fresh session still owns the id cluster-wide afterwards
+        await wait_for(lambda: sB.ledger["tof"].owner == "B",
+                       what="claim still broadcast")
+        await c2.close()
+
+
+async def test_barrier_ignores_unacked_broadcasts():
+    """An unacked broadcast (claim/purge/state) bumps the global seq
+    but must NOT become a barrier target: a healthy publisher's next
+    QoS1 ack would otherwise stall the full sync timeout waiting for a
+    seq no peer will ever ack (regression)."""
+    async with cluster({"A": ["B"], "B": ["A"]}) as (brokers, mgrs):
+        sA = mgrs["A"].sessions
+        c = await connect(brokers["A"], "barr", version=5,
+                          clean_start=False, session_expiry=300)
+        await c.subscribe(("b/#", 1))
+        await wait_for(
+            lambda: sA._peer_acked.get("B", 0)
+            >= sA._peer_ack_target.get("B", 0) > 0,
+            what="replication acked")
+        # trailing seq now belongs to a never-acked broadcast
+        sA._broadcast("claim", {"cid": "ghost", "se": 1, "be": 0,
+                                "purge": 0, "pull": 0})
+        assert sA._next_seq > sA._peer_acked.get("B", 0)
+        fut = sA.sync_barrier(asyncio.get_running_loop())
+        assert fut is None      # nothing ack-requested is outstanding
+        await c.close()
+
+
+async def test_refused_send_heals_with_live_link_resync():
+    """A replication send refused while the link stays UP schedules a
+    per-link resync, so the peer's replica converges instead of keeping
+    a permanent gap masked by later high-watermark acks (regression)."""
+    async with cluster({"A": ["B"], "B": ["A"]}) as (brokers, mgrs):
+        sA, sB = mgrs["A"].sessions, mgrs["B"].sessions
+        c = await connect(brokers["A"], "gap", version=5,
+                          clean_start=False, session_expiry=300)
+        await c.subscribe(("g/1", 1))
+        await wait_for(lambda: "gap" in sB.ledger
+                       and sB.ledger["gap"].subs, what="replicated")
+        link = mgrs["A"].links["B"]
+        real = link.send_session
+        refused = {"n": 0}
+
+        def flaky(topic, payload, on_ack=None):
+            if refused["n"] == 0:
+                refused["n"] += 1
+                return False        # one refused enqueue, link still up
+            return real(topic, payload, on_ack=on_ack)
+
+        link.send_session = flaky
+        await c.subscribe(("g/2", 1))   # this update's send is refused
+        await wait_for(lambda: sA.sync_resyncs >= 1, what="resync ran")
+        await wait_for(
+            lambda: any(r[0] == "g/2" for r in sB.ledger["gap"].subs),
+            what="gap healed by the live-link resync")
+        assert sA.sync_send_failures >= 1
+        await c.close()
+
+
+async def test_malformed_replicated_row_degrades_not_fails_connect():
+    """A malformed subscription row in the handoff state (buggy/older
+    peer) is skipped and counted — the takeover still installs the good
+    rows and the CONNECT completes with session-present=1, never an
+    exception out of the handshake (regression)."""
+    async with cluster({"A": ["B"], "B": ["A"]}) as (brokers, mgrs):
+        sB = mgrs["B"].sessions
+        c1 = await connect(brokers["A"], "mal", version=5,
+                           clean_start=False, session_expiry=3600)
+        await c1.subscribe(("ok/#", 1), ("oops/#", 1))
+        await wait_for(lambda: "mal" in sB.ledger
+                       and len(sB.ledger["mal"].subs) == 2,
+                       what="replicated")
+        await c1.disconnect()
+        # corrupt what A will ship on the pull leg: identifier becomes
+        # a non-numeric string, so the install's int() would raise
+        offline = brokers["A"].clients.get("mal")
+        offline.subscriptions["oops/#"].identifier = "x"
+        c2 = await connect(brokers["B"], "mal", version=5,
+                           clean_start=False, session_expiry=3600)
+        assert c2.session_present is True
+        live = brokers["B"].clients.get("mal")
+        assert "ok/#" in live.subscriptions     # good row installed
+        assert "oops/#" not in live.subscriptions
+        assert sB.restore_errors >= 1
+        await c2.close()
+
+
+async def test_purged_session_recreates_above_tombstone_epoch():
+    """A session re-created after its purge claims ABOVE the purged
+    epoch (tombstone), so a peer that missed the fire-and-forget purge
+    broadcast cannot fence the new incarnation with its stale replica
+    (regression)."""
+    async with cluster({"A": ["B"], "B": ["A"]}) as (brokers, mgrs):
+        sA, sB = mgrs["A"].sessions, mgrs["B"].sessions
+        c1 = await connect(brokers["A"], "tmb", version=5,
+                           clean_start=False, session_expiry=3600)
+        await c1.subscribe(("t/#", 1))
+        for _ in range(3):      # pump the epoch well above 1
+            await c1.disconnect()
+            c1 = await connect(brokers["A"], "tmb", version=5,
+                               clean_start=False, session_expiry=3600)
+        high = sA.ledger["tmb"].session_epoch
+        assert high >= 4
+        await wait_for(lambda: "tmb" in sB.ledger
+                       and sB.ledger["tmb"].session_epoch == high,
+                       what="high epoch replicated")
+        # B misses the purge: every replication send from A drops
+        faults.arm("cluster.session_sync#B", "drop", count=-1)
+        c2 = await connect(brokers["A"], "tmb", version=5,
+                           clean_start=True)   # purges the session
+        faults.clear()
+        # the re-created session continues above the tombstone...
+        assert sA.ledger["tmb"].session_epoch > high
+        await c2.subscribe(("t/new", 1))
+        # ...so B's stale replica is superseded, not fencing it
+        await wait_for(
+            lambda: sB.ledger["tmb"].session_epoch
+            == sA.ledger["tmb"].session_epoch,
+            what="stale replica superseded despite missed purge")
+        await c2.close()
+
+
+async def test_sessions_sys_tree_and_metrics_registered():
+    async with cluster({"A": ["B"], "B": ["A"]}) as (brokers, mgrs):
+        c = await connect(brokers["A"], "sysc", version=5,
+                          clean_start=False, session_expiry=60)
+        await c.subscribe(("x/#", 0))
+        brokers["A"].publish_sys_topics()
+        ret = brokers["A"].topics.retained_get(
+            "$SYS/broker/cluster/sessions/local")
+        assert ret is not None and int(ret.payload) >= 1
+        from maxmq_tpu.metrics import Registry, register_broker_metrics
+        reg = Registry()
+        register_broker_metrics(reg, brokers["A"])
+        page = reg.expose()
+        assert "maxmq_cluster_session_ledger" in page
+        assert "maxmq_cluster_session_takeovers_total" in page
+        assert "maxmq_cluster_session_sync_degraded_total" in page
+        await c.close()
+
+
+# ----------------------------------------------------------------------
+# Node-kill failover harness (subprocess brokers, SIGKILL, no grace)
+# ----------------------------------------------------------------------
+
+BROKER_SCRIPT = """
+import asyncio, os
+from maxmq_tpu.bootstrap import new_logger_from_config, run_server
+from maxmq_tpu.utils.config import load_config
+conf = load_config(path=None, env=os.environ)
+asyncio.run(run_server(conf, new_logger_from_config(conf)))
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_node(tmp_path, node: str, db: str, port: int,
+                peers: str) -> subprocess.Popen:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(
+        MAXMQ_MQTT_TCP_ADDRESS=f"127.0.0.1:{port}",
+        MAXMQ_STORAGE_BACKEND="sqlite",
+        MAXMQ_STORAGE_PATH=db,
+        MAXMQ_STORAGE_SYNC="always",
+        MAXMQ_CLUSTER_NODE_ID=node,
+        MAXMQ_CLUSTER_PEERS=peers,
+        MAXMQ_CLUSTER_SESSION_SYNC="always",
+        MAXMQ_CLUSTER_LINK_KEEPALIVE="0.5",
+        MAXMQ_METRICS_ENABLED="false",
+        MAXMQ_MATCHER="trie",
+        MAXMQ_MQTT_SYS_TOPIC_INTERVAL="0",
+        MAXMQ_LOG_LEVEL="error",
+        JAX_PLATFORMS="cpu",
+    )
+    env.pop("MAXMQ_FAULTS", None)
+    return subprocess.Popen([sys.executable, "-c", BROKER_SCRIPT],
+                            env=env, cwd=str(tmp_path))
+
+
+async def _wait_ready(port: int, proc: subprocess.Popen,
+                      timeout: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        assert proc.poll() is None, \
+            f"broker subprocess died at boot (rc={proc.returncode})"
+        try:
+            _r, w = await asyncio.open_connection("127.0.0.1", port)
+            w.close()
+            return
+        except OSError:
+            await asyncio.sleep(0.05)
+    raise AssertionError("broker subprocess never started accepting")
+
+
+async def _wait_linked(port: int, peer: str, timeout: float = 20.0) -> None:
+    """Wait until the node at ``port`` holds ``peer``'s retained route
+    snapshot — proof the bridge from ``peer`` delivered (link is up)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        probe = MQTTClient(client_id=f"probe-{peer}-{port}")
+        try:
+            await probe.connect("127.0.0.1", port)
+            await probe.subscribe((f"$cluster/routes/{peer}", 0))
+            try:
+                await probe.next_message(timeout=1.0)
+                return
+            except asyncio.TimeoutError:
+                pass
+        except OSError:
+            pass
+        finally:
+            await probe.close()
+        await asyncio.sleep(0.1)
+    raise AssertionError(f"peer {peer} never linked to :{port}")
+
+
+def _kill(proc: subprocess.Popen) -> None:
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=10)
+
+
+def _read_kv(db_path: str, bucket: str) -> dict:
+    conn = sqlite3.connect(db_path)
+    try:
+        rows = conn.execute(
+            "SELECT key, value FROM kv WHERE bucket=?", (bucket,)).fetchall()
+        return dict(rows)
+    finally:
+        conn.close()
+
+
+async def test_node_kill_failover_zero_pubacked_loss(tmp_path):
+    """SIGKILL node A mid-QoS1-stream (storage_sync=always +
+    cluster_session_sync=always): the client reconnects to node B with
+    session-present=1, the subscription survives, every PUBACKed
+    message is redelivered (zero loss), and B's replicated ledger shows
+    the takeover with a strictly-increased session epoch."""
+    dbA = str(tmp_path / "a.db")
+    dbB = str(tmp_path / "b.db")
+    pA, pB = _free_port(), _free_port()
+    procA = _spawn_node(tmp_path, "A", dbA, pA, f"B@127.0.0.1:{pB}")
+    procB = _spawn_node(tmp_path, "B", dbB, pB, f"A@127.0.0.1:{pA}")
+    acked: list[int] = []
+    try:
+        await _wait_ready(pA, procA)
+        await _wait_ready(pB, procB)
+        # both directions of the bridge must be live before the stream:
+        # the replication barrier only covers CONNECTED peers
+        await _wait_linked(pB, "A")
+        await _wait_linked(pA, "B")
+
+        sub = MQTTClient(client_id="fo-sub", clean_start=False)
+        await sub.connect("127.0.0.1", pA)
+        await sub.subscribe(("fo/#", 1))
+        await sub.disconnect()
+
+        pub = MQTTClient(client_id="fo-pub")
+        await pub.connect("127.0.0.1", pA)
+
+        async def stream():
+            for i in range(5000):
+                try:
+                    await pub.publish("fo/q", f"m-{i}".encode(), qos=1,
+                                      timeout=5.0)
+                except Exception:
+                    return              # broker died mid-flight
+                acked.append(i)
+
+        streamer = asyncio.ensure_future(stream())
+        while len(acked) < 15 and not streamer.done():
+            await asyncio.sleep(0.005)
+        _kill(procA)                    # mid-stream, zero grace
+        await streamer
+        assert len(acked) >= 15
+    finally:
+        if procA.poll() is None:
+            _kill(procA)
+
+    try:
+        sub2 = MQTTClient(client_id="fo-sub", clean_start=False)
+        await sub2.connect("127.0.0.1", pB)
+        # the replicated session resumed on B: session-present=1
+        assert sub2.connack.session_present is True
+        got: set[bytes] = set()
+        while True:
+            try:
+                m = await sub2.next_message(timeout=3.0)
+            except asyncio.TimeoutError:
+                break
+            got.add(m.payload)
+        missing = {f"m-{i}".encode() for i in acked} - got
+        assert not missing, \
+            f"{len(missing)} PUBACKed messages lost: {sorted(missing)[:5]}"
+        # subscription survived: a fresh publish through B delivers
+        # without any re-SUBSCRIBE
+        pub2 = MQTTClient(client_id="fo-pub2")
+        await pub2.connect("127.0.0.1", pB)
+        await pub2.publish("fo/alive", b"post-failover", qos=1)
+        m = await sub2.next_message(timeout=5.0)
+        assert m.payload == b"post-failover"
+        await pub2.disconnect()
+        await sub2.disconnect()
+    finally:
+        if procB.poll() is None:
+            _kill(procB)
+    # B journaled the takeover: it owns the session at a higher epoch
+    sess = _read_kv(dbB, "cluster_sessions")
+    assert "fo-sub" in sess
+    rec = json.loads(sess["fo-sub"])
+    assert rec["owner"] == "B" and rec["se"] >= 2
+
+
+test_node_kill_failover_zero_pubacked_loss._async_timeout = 180

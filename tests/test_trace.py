@@ -25,7 +25,6 @@ from maxmq_tpu.hooks.journal import WriteBehindStore
 from maxmq_tpu.hooks.storage import MemoryStore, StorageHook
 from maxmq_tpu.metrics import (Histogram, MetricsServer, Registry,
                                register_broker_metrics)
-from maxmq_tpu.mqtt_client import MQTTClient
 from maxmq_tpu.trace import (CRITICAL_STAGES, MAX_DRAIN_SPANS,
                              PipelineTracer, STAGES)
 
