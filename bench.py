@@ -70,11 +70,19 @@ def log(msg: str) -> None:
 def trace_stanza(tracer) -> dict:
     """The ADR-015 ``trace`` stanza embedded in BENCH_*.json rows:
     per-stage and per-QoS p50/p95/p99 from the pipeline tracer's
-    histograms, so the perf trajectory records tails, not just means."""
-    return {"sampled": tracer.sampled,
-            "slow_captured": tracer.slow_captured,
-            "stages": tracer.stage_quantiles(),
-            "e2e": tracer.e2e_quantiles()}
+    histograms, so the perf trajectory records tails, not just means.
+    When cross-node span reports came back (ADR 017), the stanza also
+    carries the origin-measured per-hop e2e quantiles."""
+    d = {"sampled": tracer.sampled,
+         "slow_captured": tracer.slow_captured,
+         "stages": tracer.stage_quantiles(),
+         "e2e": tracer.e2e_quantiles()}
+    cross = tracer.cross_quantiles()
+    if cross or tracer.remote_attached:
+        d["cross_node"] = cross
+        d["remote_reports"] = tracer.remote_attached
+        d["remote_orphans"] = tracer.remote_orphans
+    return d
 
 
 def load_last_good() -> dict | None:
@@ -1760,12 +1768,21 @@ def bench_cluster_federation(msgs: int = 400) -> dict:
             lambda: bool(mgrs["C"].routes.nodes_for("bench/D/x")),
             30.0), 3)
 
-        # ADR 015: traced tail round on the publisher node (headline
-        # phases ran untraced) — the bridge span in node A's stanza is
-        # the forward-enqueue cost of each cross-node publish
+        # ADR 015/017: traced tail rounds on the publisher node
+        # (headline phases ran untraced) — the bridge span in node A's
+        # stanza is the forward-enqueue cost of each cross-node
+        # publish, and the receiving nodes' returned span reports feed
+        # the origin-measured per-hop cross-node e2e quantiles
+        # (trace_stanza's cross_node row: hops1 = A->B, hops2 = A->C)
         brokers["A"].tracer.sample_n = 1
+        await measure(pub, subs["B"], "bench/B/t", min(msgs, 100))
         await measure(pub, subs["C"], "bench/C/t", min(msgs, 100))
         brokers["A"].tracer.sample_n = 0
+        # span returns are fire-and-forget over a lossy-by-design
+        # channel: wait for ~90% of the expected ~3 reports per 2-hop
+        # publish (B-subscriber, B-relay, C), bounded either way
+        await poll(lambda: brokers["A"].tracer.remote_attached
+                   >= int(2.7 * min(msgs, 100)), 5.0)
         d["trace"] = trace_stanza(brokers["A"].tracer)
 
         d.update(
@@ -1887,6 +1904,25 @@ def bench_failover(parked: int = 50, share_msgs: int = 60) -> dict:
         d["share_balance_skew"] = round(
             (max(per_node.values()) - min(per_node.values()))
             / mean, 3) if mean else 0.0
+
+        # -- cross-node traced round (ADR 017): publisher at A,
+        # subscriber at C (2 hops) — the returned span reports give
+        # origin-measured per-hop e2e with per-hop attribution in the
+        # trace stanza even on the failover topology
+        sub_x = MQTTClient(client_id="fo-x")
+        await sub_x.connect("127.0.0.1", brokers["C"].test_port)
+        await sub_x.subscribe("fo/x/#")
+        await poll(lambda: bool(mgrs["A"].routes.nodes_for("fo/x/t")),
+                   10.0)
+        brokers["A"].tracer.sample_n = 1
+        for i in range(30):
+            await pub.publish("fo/x/t", b"x" * 64)
+            await sub_x.next_message(timeout=5)
+        brokers["A"].tracer.sample_n = 0
+        await poll(lambda: brokers["A"].tracer.remote_attached >= 27,
+                   5.0)    # ~90% of one report per node per publish
+        d["cross_trace"] = trace_stanza(brokers["A"].tracer)
+        await sub_x.disconnect()
 
         # -- live takeover: reconnect-to-CONNACK with a state pull ----
         sess = MQTTClient(client_id="fo-sess", version=5,

@@ -169,6 +169,10 @@ def build_cluster(conf: Config, broker: Broker, logger: Logger | None = None):
         session_sync_timeout_ms=conf.cluster_session_sync_timeout_ms,
         session_takeover_timeout_ms=(
             conf.cluster_session_takeover_timeout_ms),
+        trace_propagation=conf.cluster_trace_propagation,
+        trace_return=conf.cluster_trace_return,
+        telemetry_interval_s=float(conf.cluster_telemetry_interval_s),
+        telemetry_full_every=conf.cluster_telemetry_full_every,
         logger=logger.with_prefix("cluster") if logger else None)
     broker.attach_cluster(manager)
     return manager
@@ -238,11 +242,18 @@ def build_metrics(conf: Config, broker: Broker,
         return None
     registry = Registry()
     register_broker_metrics(registry, broker)
+    # ADR 017: with a cluster attached, ANY node serves the federated
+    # /cluster/metrics page from its telemetry plane
+    telemetry = getattr(broker.cluster, "telemetry", None) \
+        if broker.cluster is not None else None
     return MetricsServer(conf.metrics_address, registry,
                          path=conf.metrics_path,
                          profiling=conf.metrics_profiling,
                          logger=logger.with_prefix("metrics"),
-                         tracer=broker.tracer)
+                         tracer=broker.tracer,
+                         cluster_metrics=(telemetry.cluster_exposition
+                                          if telemetry is not None
+                                          else None))
 
 
 def new_logger_from_config(conf: Config) -> Logger:

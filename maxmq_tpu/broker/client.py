@@ -210,6 +210,9 @@ class Client:
         # writer loop settles each after the first flush that covers
         # its seq (one branch per burst when empty)
         self._drain_traces: list = []
+        # ADR 017 QoS2 release-leg stopwatches: pid -> PUBREC-sent ns
+        # for SAMPLED inbound QoS2 publishes; popped at PUBREL
+        self._qos2_release_t0: dict[int, int] = {}
 
     # ------------------------------------------------------------------
 

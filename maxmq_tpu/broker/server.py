@@ -735,8 +735,12 @@ class Broker:
         packet._trace = tr
 
     def _packet_trace(self, packet: Packet):
+        # the gate opens for local sampling OR while an ADOPTED
+        # cross-node trace is live (ADR 017) — a receiving node stamps
+        # child spans even when its own sampling stride is off
+        t = self.tracer
         return (packet.__dict__.get("_trace")
-                if self.tracer.sample_n else None)
+                if t.sample_n or t.adopted_open else None)
 
     async def _route_publish(self, client: Client, packet: Packet) -> None:
         """Ack + fan out an accepted publish. Durability barrier
@@ -902,6 +906,17 @@ class Broker:
         elif qos == 2:
             if success:
                 client.pubrec_inbound.add(packet.packet_id)
+                tracer = self.tracer
+                if ((tracer.sample_n or tracer.adopted_open)
+                        and packet.__dict__.get("_trace") is not None):
+                    # ADR 017 (closing the ADR-015 NOT-traced item):
+                    # arm the release-leg stopwatch — PUBREC out ->
+                    # PUBREL in, observed histogram-only (it waits on
+                    # the publisher's network round trip). Bounded by
+                    # the sampling stride; the dict dies with the
+                    # client and _process_pubrel pops it either way.
+                    client._qos2_release_t0[packet.packet_id] = \
+                        tracer.clock()
             else:
                 client.inflight.return_receive_quota()
             self._send_ack(client, PT.PUBREC, packet, reason)
@@ -1102,7 +1117,7 @@ class Broker:
                             "matcher failed; trie fallback",
                             topic=packet.topic, error=repr(exc))
                     subscribers = self.topics.subscribers(packet.topic)
-                if self.tracer.sample_n:
+                if self.tracer.sample_n or self.tracer.adopted_open:
                     self._trace_match_spans(fut, packet)
                 self._pub_deliver(subscribers, client, packet, durable_ack)
             finally:
@@ -1339,7 +1354,7 @@ class Broker:
             if self.hooks.overrides("on_publish_dropped"):
                 self.hooks.notify("on_publish_dropped", client,
                                   self._delivery_form(packet, version))
-        elif self.tracer.sample_n:
+        elif self.tracer.sample_n or self.tracer.adopted_open:
             self._trace_drain(client, packet)
 
     def _trace_drain(self, client: Client, packet: Packet) -> None:
@@ -1382,7 +1397,7 @@ class Broker:
         an accepted one registers its ADR-015 drain watcher."""
         if not client.send(out):
             self._count_refused_send(client, out)
-        elif self.tracer.sample_n:
+        elif self.tracer.sample_n or self.tracer.adopted_open:
             self._trace_drain(client, packet)
 
     def _shed_qos0(self, client: Client, sub: Subscription,
@@ -1430,6 +1445,12 @@ class Broker:
         retain-as-published, and the v5 property set (subscription ids,
         outbound topic alias)."""
         out = packet.copy()
+        tr = self._packet_trace(packet)
+        if tr is not None:
+            # ADR 017: a lightweight (origin, id) tag — NOT the trace
+            # itself (delivery copies must not alias the span list) —
+            # so downstream hooks (session replication) can correlate
+            out._trace_ref = (tr.origin or self.tracer.node_id, tr.id)
         out.protocol_version = client.properties.protocol_version
         out.fixed.qos = min(packet.fixed.qos, sub.qos,
                             self.capabilities.maximum_qos)
@@ -1535,6 +1556,12 @@ class Broker:
         client.send(rel)
 
     def _process_pubrel(self, client: Client, packet: Packet) -> None:
+        t0 = client._qos2_release_t0.pop(packet.packet_id, None)
+        if t0 is not None:
+            # QoS2 release leg (ADR 017): PUBREC sent -> PUBREL
+            # received, for sampled publishes only
+            self.tracer.observe(
+                "release", max(self.tracer.clock() - t0, 0) / 1e9)
         if packet.packet_id not in client.pubrec_inbound:
             # unknown id -> PUBCOMP (not-found on v5) [MQTT-4.3.3-7];
             # checked before the reason, as the reference does
@@ -2084,6 +2111,11 @@ class Broker:
                 mgr.forwards_delivered,
             "$SYS/broker/cluster/loops_dropped": mgr.loops_dropped,
         }
+        # ADR 017: per-peer health — link state, staleness, queue
+        # pressure, replication lag and the clock-skew estimate, the
+        # operator view failover/sharding work is judged against.
+        # Bounded to the metrics layer's per-peer series cap.
+        entries.update(self._sys_cluster_health_entries(mgr))
         sess = getattr(mgr, "sessions", None)
         if sess is not None:
             # ADR 016: the session-federation subtree — takeover and
@@ -2105,6 +2137,37 @@ class Broker:
                 "$SYS/broker/cluster/sessions/share_groups":
                     sess.share_groups,
             })
+        return entries
+
+    def _sys_cluster_health_entries(self, mgr) -> dict:
+        """``$SYS/broker/cluster/health/<peer>/*`` (ADR 017)."""
+        from ..metrics import CLUSTER_PEER_SERIES
+        entries: dict = {}
+        sess = getattr(mgr, "sessions", None)
+        now = time.monotonic()
+        peers = sorted(mgr.membership.peers.items())[:CLUSTER_PEER_SERIES]
+        for peer, st in peers:
+            base = f"$SYS/broker/cluster/health/{peer}"
+            entries[f"{base}/state"] = int(st.connected)
+            entries[f"{base}/last_seen_s"] = (
+                round(max(now - st.last_seen, 0.0), 1)
+                if st.last_seen else -1)
+            entries[f"{base}/flaps"] = st.flaps
+            entries[f"{base}/skew_ms"] = round(st.skew_ns / 1e6, 3)
+            entries[f"{base}/rtt_ms"] = round(st.rtt_ns / 1e6, 3)
+            link = mgr.links.get(peer)
+            if link is not None:
+                entries[f"{base}/queue_bytes"] = link.outbound.bytes
+                # route replication lag: filters the peer should hold
+                # but our link has not (successfully) advertised yet
+                desired = mgr.routes.advertisement_for(peer)
+                entries[f"{base}/route_lag"] = (
+                    len(desired) if link.needs_snapshot
+                    else len(desired ^ link.advertised))
+            if sess is not None:
+                entries[f"{base}/sess_lag"] = max(
+                    sess._peer_ack_target.get(peer, 0)
+                    - sess._peer_acked.get(peer, 0), 0)
         return entries
 
     # ------------------------------------------------------------------

@@ -219,18 +219,36 @@ class BusHook(Hook):
     def _forward(self, packet: Packet) -> None:
         if self._writer is None or packet.topic.startswith("$"):
             return                       # $SYS stays per-worker (ADR 005)
-        wire = self._encode_for_bus(packet)
+        wire = self._encode_for_bus(packet, self._bus_trace(packet))
         self._writer.write(_frame(
             FRAME_PUBLISH, bytes([self.worker_id]) + wire))
 
+    def _bus_trace(self, packet: Packet) -> str:
+        """ADR 017: a sampled publish's trace identity crosses the
+        pool bus as an ``mq-trace`` user property — identity only, no
+        clock frame (worker monotonic clocks have per-process epochs),
+        so receiving workers open correlated child traces from their
+        own arrival time. Empty (and allocation-free) when untraced."""
+        tracer = getattr(self.broker, "tracer", None)
+        if tracer is None or not (tracer.sample_n
+                                  or tracer.adopted_open):
+            return ""
+        tr = packet.__dict__.get("_trace")
+        if tr is None:
+            return ""
+        return f"{tr.origin or tracer.node_id or 'w%d' % self.worker_id}:{tr.id}"
+
     @staticmethod
-    def _encode_for_bus(packet: Packet) -> bytes:
+    def _encode_for_bus(packet: Packet, trace_ref: str = "") -> bytes:
         out = packet.copy()
         out.protocol_version = 5
         # a qos>0 wire needs a nonzero pid; the receiving workers
         # allocate real per-client pids at delivery, this one is unused
         out.packet_id = 1 if packet.fixed.qos else 0
         out.fixed.dup = False
+        if trace_ref:
+            out.properties.user_properties.append(("mq-trace",
+                                                   trace_ref))
         return out.encode()
 
     async def _inject_publish(self, payload: bytes) -> None:
@@ -241,9 +259,45 @@ class BusHook(Hook):
             # delivery QoS still derives from min(sub.qos, msg qos)
             packet.origin = BUS_CLIENT_ID
             packet.created = time.time()
-            if packet.fixed.retain:
-                self.broker.retain_message(self._bus_client, packet)
-            await self.broker.publish_to_subscribers(packet)
+            tr = self._adopt_bus_trace(packet)
+            try:
+                if packet.fixed.retain:
+                    self.broker.retain_message(self._bus_client, packet)
+                await self.broker.publish_to_subscribers(packet)
+            except BaseException:
+                # a raising fan-out/enqueue must still settle the
+                # adopted trace or tracer.adopted_open leaks the
+                # stamping gates open (finish is idempotent)
+                if tr is not None:
+                    self.broker.tracer.finish(tr)
+                raise
+            if tr is not None and (self.broker.matcher is None
+                                   or self.broker._pub_consumer is None):
+                self.broker.tracer.finish(tr)
+
+    def _adopt_bus_trace(self, packet: Packet):
+        """Open a correlated child trace for a bus injection carrying
+        ``mq-trace`` (ADR 017). Identity-only adoption: start is local
+        arrival, so the e2e reads bus-arrival -> local-terminal."""
+        up = packet.properties.user_properties
+        if not up:
+            return None
+        ref = next((v for k, v in up if k == "mq-trace"), None)
+        if ref is None:
+            return None
+        tracer = getattr(self.broker, "tracer", None)
+        if tracer is None:
+            return None
+        try:
+            origin, _sep, tid = ref.rpartition(":")
+            now = tracer.clock()
+            tr = tracer.adopt(origin or "bus", int(tid), packet.topic,
+                              packet.fixed.qos, 1, now)
+        except ValueError:
+            return None
+        tr.span("bridge_in", now, tracer.clock())
+        packet._trace = tr
+        return tr
 
     # -- $share ownership gossip --------------------------------------
     #

@@ -1,7 +1,9 @@
-"""Cluster federation layer (ADR 013 + 016): bridge links, aggregated
-route propagation, cross-node publish forwarding, and federated
+"""Cluster federation layer (ADR 013 + 016 + 017): bridge links,
+aggregated route propagation, cross-node publish forwarding, federated
 sessions (epoch-safe takeover, replicated inflight, cluster-wide
-``$share``) over N broker processes."""
+``$share``), and the cluster observability plane (cross-node trace
+propagation, telemetry gossip, clock-skew estimation) over N broker
+processes."""
 
 from .bridge import BRIDGE_ID_PREFIX, BridgeLink
 from .manager import ClusterManager, DedupWindow
@@ -12,6 +14,7 @@ from .routes import (IncrementalCover, RouteTable, RouteWireError,
                      encode_delta, encode_snapshot, filter_subsumes,
                      minimal_cover)
 from .sessions import SessionEntry, SessionFederation
+from .telemetry import WIRE_CAPS, ClusterTelemetry
 
 __all__ = [
     "BRIDGE_ID_PREFIX", "BridgeLink", "ClusterManager", "DedupWindow",
@@ -19,5 +22,6 @@ __all__ = [
     "valid_node_id", "IncrementalCover", "RouteTable", "RouteWireError",
     "ShareLedger", "decode_delta", "decode_snapshot", "encode_delta",
     "encode_snapshot", "filter_subsumes", "minimal_cover",
-    "SessionEntry", "SessionFederation",
+    "SessionEntry", "SessionFederation", "ClusterTelemetry",
+    "WIRE_CAPS",
 ]

@@ -214,6 +214,9 @@ class BridgeLink:
             await self._fire_link_fault()
             await client.ping(timeout=self.connect_timeout)
             self.manager.membership.note_alive(self.peer)
+            # ADR 017: the proved-alive link refreshes its clock-skew
+            # estimate at the keepalive cadence
+            self.manager.on_link_alive(self)
 
     # ------------------------------------------------------------------
     # Enqueue side (called synchronously from the fan-out path)

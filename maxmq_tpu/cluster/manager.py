@@ -20,10 +20,18 @@ namespace; ordinary clients cannot publish ``$`` topics):
 * ``$cluster/fwd/<origin>/<epoch>/<msgid>/<hops>/<flags>/<topic...>``
   forwarded publish: origin node id, origin's boot epoch, per-origin
   monotonic message id, hops traversed, flags = original QoS digit
-  (+ ``r`` for retained), then the original topic verbatim. The epoch
-  scopes the dedup window: a restarted origin restarts its message
-  ids, and without the epoch every peer would silently drop its first
-  window of forwards as replayed duplicates.
+  (+ ``r`` for retained, + ``t`` when an ADR-017 trace segment
+  ``<trace_id>.<t0_ns>`` is inserted before the topic — sent only to
+  peers that announced the ``fwd-trace`` capability, so an old binary
+  never sees the extra segment), then the original topic verbatim.
+  The epoch scopes the dedup window: a restarted origin restarts its
+  message ids, and without the epoch every peer would silently drop
+  its first window of forwards as replayed duplicates.
+* ``$cluster/hello/<node>`` wire-capability announcement (ADR 017),
+  sent at link-up; ``$cluster/telemetry/<node>``, ``$cluster/clock/
+  <node>[/reply]`` and ``$cluster/trace/<origin>`` are the federated-
+  metrics gossip, clock-skew probes and trace span-return legs — all
+  handled by :class:`~.telemetry.ClusterTelemetry`.
 """
 
 from __future__ import annotations
@@ -79,7 +87,11 @@ class ClusterManager:
                  session_replication: bool = True,
                  session_sync: str = "batched",
                  session_sync_timeout_ms: int = 750,
-                 session_takeover_timeout_ms: int = 750) -> None:
+                 session_takeover_timeout_ms: int = 750,
+                 trace_propagation: bool = True,
+                 trace_return: bool = True,
+                 telemetry_interval_s: float = 5.0,
+                 telemetry_full_every: int = 10) -> None:
         if not valid_node_id(node_id):
             raise ValueError(f"bad cluster node id {node_id!r}")
         if any(p.node_id == node_id for p in peers):
@@ -118,6 +130,15 @@ class ClusterManager:
                 sync_timeout_ms=session_sync_timeout_ms,
                 takeover_timeout_ms=session_takeover_timeout_ms)
             broker.add_hook(self.sessions)
+        # cluster observability plane (ADR 017): telemetry gossip,
+        # clock-skew probes, and the trace span-return leg. Always
+        # constructed — skew/trace handling have no periodic cost;
+        # telemetry_interval_s = 0 disables only the gossip task.
+        self.trace_propagation = trace_propagation
+        from .telemetry import ClusterTelemetry
+        self.telemetry = ClusterTelemetry(
+            self, interval_s=telemetry_interval_s,
+            full_every=telemetry_full_every, trace_return=trace_return)
 
         # counters (read tear-free by the metrics scrape thread)
         self.forwards_delivered = 0     # remote publishes fanned out here
@@ -158,6 +179,7 @@ class ClusterManager:
             # after the epoch adoption above and the broker's own
             # restore: the ledger rebuild must see the final boot epoch
             self.sessions.start()
+        self.telemetry.start()
         for link in self.links.values():
             link.start()
 
@@ -165,6 +187,7 @@ class ClusterManager:
         self._started = False
         if self.sessions is not None:
             self.sessions.close()
+        self.telemetry.close()
         for link in self.links.values():
             await link.close()
 
@@ -287,9 +310,27 @@ class ClusterManager:
         loop.call_later(0.1, fire)
 
     def on_link_up(self, link: BridgeLink) -> None:
+        self._send_hello(link)
         self._send_snapshot(link)
         if self.sessions is not None:
             self.sessions.on_link_up(link)
+        self.telemetry.on_link_up(link)
+
+    def on_link_alive(self, link: BridgeLink) -> None:
+        """Keepalive ping round-tripped (bridge.py): refresh the
+        ADR-017 clock-skew estimate at the keepalive cadence."""
+        self.telemetry.on_link_alive(link)
+
+    def _send_hello(self, link: BridgeLink) -> None:
+        """Announce wire capabilities (ADR 017 version negotiation).
+        An old peer counts the unknown kind as inbound_rejected and
+        carries on; a peer that never heard OUR hello sends us plain
+        pre-017 envelopes, which we parse fine."""
+        import json
+        from .telemetry import WIRE_CAPS
+        link.send_control(f"$cluster/hello/{self.node_id}",
+                          json.dumps({"v": 1,
+                                      "caps": list(WIRE_CAPS)}).encode())
 
     def on_link_down(self, link: BridgeLink, reason: str) -> None:
         # routes are KEPT: a flapping link must not churn the mesh's
@@ -337,8 +378,9 @@ class ClusterManager:
             return
         flags = f"{min(packet.fixed.qos, self.link_qos)}" + \
             ("r" if packet.fixed.retain else "")
-        envelope = (f"$cluster/fwd/{origin}/{epoch}/{msgid}/{hops + 1}/"
-                    f"{flags}/{topic}")
+        base = f"$cluster/fwd/{origin}/{epoch}/{msgid}/{hops + 1}/"
+        envelope = base + flags + "/" + topic
+        traced_env = self._traced_envelope(packet, base, flags, topic)
         for node in targets:
             link = self.links.get(node)
             if link is None or not link.connected:
@@ -347,8 +389,38 @@ class ClusterManager:
                 if tracer is not None:
                     tracer.note_error("bridge", "link_down")
                 continue
-            link.forward(envelope, packet.payload,
+            link.forward(self._env_for(node, envelope, traced_env),
+                         packet.payload,
                          qos=min(packet.fixed.qos, self.link_qos))
+
+    def _env_for(self, node: str, envelope: str,
+                 traced_env: str | None) -> str:
+        """Capability gate: only peers that announced ``fwd-trace``
+        get the traced envelope (old binaries keep the pre-017 wire)."""
+        if traced_env is not None and self._peer_has_cap(node,
+                                                         "fwd-trace"):
+            return traced_env
+        return envelope
+
+    def _traced_envelope(self, packet: Packet, base: str, flags: str,
+                         topic: str) -> str | None:
+        """ADR 017: when this publish rides a sampled trace (local or
+        adopted), capability-negotiated peers get a flag bit + trace
+        segment — id + t0 in OUR clock frame, re-translated per hop —
+        so the whole line shares one correlation id. Zero cost
+        untraced."""
+        tracer = self.broker.tracer
+        if not (self.trace_propagation
+                and (tracer.sample_n or tracer.adopted_open)):
+            return None
+        tr = packet.__dict__.get("_trace")
+        if tr is None:
+            return None
+        return base + flags + "t/" + f"{tr.id}.{tr.start_ns}/" + topic
+
+    def _peer_has_cap(self, node: str, cap: str) -> bool:
+        st = self.membership.get(node)
+        return st is not None and cap in st.caps
 
     # ------------------------------------------------------------------
     # Inbound $cluster/* dispatch (from broker.process_publish)
@@ -371,6 +443,44 @@ class ClusterManager:
             else:
                 await self.sessions.handle_inbound(sender, levels, packet)
         else:
+            self._handle_observability(kind, sender, levels, packet)
+
+    def _handle_observability(self, kind: str, sender: str,
+                              levels: list[str], packet: Packet) -> None:
+        """The ADR-017 plane's control kinds (hello/clock/telemetry/
+        trace) — dispatched to ClusterTelemetry; anything else (or an
+        unknown future kind) counts as rejected, exactly the behavior
+        an old binary shows our new kinds."""
+        if kind == "hello" and len(levels) == 3:
+            self._handle_hello(sender, levels, packet)
+        elif kind == "clock" and len(levels) >= 3:
+            if levels[2] != sender:
+                self.inbound_rejected += 1  # spoofed probe identity
+            else:
+                self.telemetry.handle_clock(sender, levels, packet)
+        elif kind == "telemetry" and len(levels) == 3:
+            self.telemetry.handle_snapshot(sender, levels, packet)
+        elif kind == "trace" and len(levels) == 3:
+            self.telemetry.handle_trace(sender, levels, packet)
+        else:
+            self.inbound_rejected += 1
+
+    def _handle_hello(self, sender: str, levels: list[str],
+                      packet: Packet) -> None:
+        """ADR-017 capability announcement: record what wire the peer
+        can parse (pre-017 peers never send one and get pre-017
+        envelopes forever)."""
+        import json
+        if levels[2] != sender:
+            self.inbound_rejected += 1      # spoofed identity
+            return
+        st = self.membership.get(sender)
+        if st is None:
+            return
+        try:
+            caps = json.loads(packet.payload).get("caps") or []
+            st.caps = frozenset(str(c) for c in caps)
+        except Exception:
             self.inbound_rejected += 1
 
     async def _handle_fwd(self, client, sender: str, levels: list[str],
@@ -383,7 +493,15 @@ class ClusterManager:
         except (ValueError, IndexError):
             self.inbound_rejected += 1
             return
-        topic = "/".join(levels[7:])
+        trace_ctx = None
+        ti = 7
+        if "t" in flags:
+            trace_ctx = self._parse_fwd_trace(levels)
+            if trace_ctx is None:
+                self.inbound_rejected += 1
+                return
+            ti = 8
+        topic = "/".join(levels[ti:])
         if topic.startswith("$") or not valid_topic_name(topic):
             # a bridge peer must never smuggle $-state overwrites or
             # wildcard "topics" into the local fan-out/retain store
@@ -392,16 +510,7 @@ class ClusterManager:
         if origin == self.node_id:
             self.loops_dropped += 1     # our own publish came back
             return
-        window = self._seen.get(origin)
-        if window is None or epoch > window.epoch:
-            # fresh origin incarnation: its message ids restarted, so
-            # the old window no longer means "already delivered"
-            window = self._seen[origin] = DedupWindow(epoch=epoch)
-        elif epoch < window.epoch:
-            self.loops_dropped += 1     # stale incarnation replay
-            return
-        if not window.admit(msgid):
-            self.loops_dropped += 1     # redundant path in the mesh
+        if not self._admit_fwd(origin, epoch, msgid):
             return
         out = Packet(fixed=FixedHeader(type=PT.PUBLISH, qos=qos,
                                        retain=retain),
@@ -415,10 +524,85 @@ class ClusterManager:
         if retain:
             self.broker.retain_message(client, out)
         self.forwards_delivered += 1
-        # re-enters the normal local fan-out (order-preserving publish
-        # pipeline when a matcher is attached) AND maybe_forward for
-        # the onward hop
-        await self.broker.publish_to_subscribers(out)
+        tr = self._adopt_trace(sender, origin, trace_ctx, out, hops)
+        try:
+            # re-enters the normal local fan-out (order-preserving
+            # publish pipeline when a matcher is attached) AND
+            # maybe_forward for the onward hop
+            await self.broker.publish_to_subscribers(out)
+        except BaseException:
+            # a raising fan-out/enqueue must still settle the adopted
+            # trace or tracer.adopted_open leaks and the stamping
+            # gates stay open forever (finish is idempotent, so this
+            # is safe even if the pipeline consumer got the packet)
+            if tr is not None:
+                self.broker.tracer.finish(tr)
+            raise
+        self._finish_adopted(tr)
+
+    def _admit_fwd(self, origin: str, epoch: int, msgid: int) -> bool:
+        """Epoch-scoped per-origin dedup (ADR 013): a fresh incarnation
+        replaces the window wholesale (its message ids restarted, so
+        the old window no longer means "already delivered"); stale
+        incarnations and redundant mesh paths are dropped + counted."""
+        window = self._seen.get(origin)
+        if window is None or epoch > window.epoch:
+            window = self._seen[origin] = DedupWindow(epoch=epoch)
+        elif epoch < window.epoch:
+            self.loops_dropped += 1     # stale incarnation replay
+            return False
+        if not window.admit(msgid):
+            self.loops_dropped += 1     # redundant path in the mesh
+            return False
+        return True
+
+    def _parse_fwd_trace(self, levels: list[str]) -> tuple | None:
+        """ADR-017 trace segment "<trace_id>.<t0_ns>" before the
+        topic; the flag bit is capability-negotiated, so it only
+        arrives from peers that meant it — malformed is rejected (None
+        here), never misread as topic levels."""
+        try:
+            tid_s, t0_s = levels[7].split(".", 1)
+            return (int(tid_s), int(t0_s), self.broker.tracer.clock())
+        except (ValueError, IndexError):
+            return None
+
+    def _adopt_trace(self, sender: str, origin: str, ctx: tuple | None,
+                     out: Packet, hops: int):
+        """Open the receiving-node child span chain of a cross-node
+        trace (ADR 017): origin's id, start backdated to the origin t0
+        translated through the per-peer skew estimate, rooted at a
+        ``bridge_in`` span. Also stamps the ``mq-trace`` user property
+        so v5 subscriber deliveries (and their log records) carry
+        ``<origin>:<id>`` — the cross-node grep key. A None ctx (the
+        untraced common case) is a no-op."""
+        if ctx is None:
+            return None
+        tracer = self.broker.tracer
+        tid, t0, t_in = ctx
+        t0_local = t0 - self.telemetry.skew_ns(sender)
+        tr = tracer.adopt(origin, tid, out.topic, out.fixed.qos, hops,
+                          min(t0_local, t_in))
+        tr.span("bridge_in", t_in, tracer.clock())
+        out._trace = tr
+        out.properties.user_properties.append(
+            ("mq-trace", f"{origin}:{tid}"))
+        if self.log is not None:
+            # the RECEIVING node's delivered-publish record: one grep
+            # of trace=<origin>:<id> correlates every node's logs
+            self.log.debug("forward delivered", topic=out.topic,
+                           origin=origin, hops=hops,
+                           trace=f"{origin}:{tid}")
+        return tr
+
+    def _finish_adopted(self, tr) -> None:
+        """Synchronous fan-out path: the adopted trace is terminal
+        once publish_to_subscribers returned; in pipeline mode the
+        consumer's _pub_deliver finishes it after the ordered fan-out
+        actually ran (finish is idempotent either way)."""
+        if tr is not None and (self.broker.matcher is None
+                               or self.broker._pub_consumer is None):
+            self.broker.tracer.finish(tr)
 
     def _handle_routes(self, sender: str, levels: list[str],
                        packet: Packet) -> None:

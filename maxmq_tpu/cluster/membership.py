@@ -73,6 +73,15 @@ class PeerState:
     flaps: int = 0                  # up->down transitions
     connect_attempts: int = 0
     last_error: str = ""
+    # ADR 017: wire capabilities the peer announced on $cluster/hello
+    # (version negotiation — a peer that never said "fwd-trace" gets
+    # pre-017 envelopes, so an old binary never sees the new segment)
+    caps: frozenset = frozenset()
+    # ADR 017: EWMA clock-skew estimate from the keepalive-driven
+    # clock probes — peer_monotonic_ns minus ours at the RTT midpoint
+    skew_ns: float = 0.0
+    rtt_ns: float = 0.0
+    skew_samples: int = 0
     extras: dict = field(default_factory=dict)
 
 

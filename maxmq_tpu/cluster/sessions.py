@@ -235,6 +235,8 @@ class SessionFederation(Hook):
         self.digest_mismatches = 0      # installed inflight != digest
         self.restore_errors = 0         # journal rows that failed parse
         self.inbound_rejected = 0
+        self.trace_ops_applied = 0      # ADR 017: replicated inflight
+                                        # ops that carried trace identity
 
     # ------------------------------------------------------------------
     # Lifecycle (driven by ClusterManager.start/close)
@@ -351,11 +353,20 @@ class SessionFederation(Hook):
                                new_epoch: int,
                                session_present: bool) -> bool:
         """The remote-takeover leg with its ADR-015 span + the
-        fresh-session degrade on an injected fault."""
+        fresh-session degrade on an injected fault. When sampling is
+        on, the takeover rides a full trace whose id travels on the
+        claim (ADR 017): the prior owner's state-ship leg reports its
+        span back, so one correlated trace shows claim -> remote ship
+        -> install."""
         tracer = getattr(self.broker, "tracer", None)
         t0 = tracer.clock() if tracer is not None else 0
+        tr = None
+        if tracer is not None and tracer.sample_n:
+            tr = tracer.sample(f"$takeover/{client.id}", 0, client.id,
+                               start_ns=t0)
         try:
-            installed = await self._take_over(client, entry, new_epoch)
+            installed = await self._take_over(client, entry, new_epoch,
+                                              trace=tr)
             session_present = session_present or installed
             self.takeovers += 1
         except faults.InjectedFault:
@@ -371,11 +382,15 @@ class SessionFederation(Hook):
             if tracer is not None:
                 tracer.note_error("takeover", "error")
         if tracer is not None:
-            tracer.observe("takeover", (tracer.clock() - t0) / 1e9)
+            now = tracer.clock()
+            tracer.observe("takeover", (now - t0) / 1e9)
+            if tr is not None:
+                tr.span("takeover", t0, now)
+                tracer.finish(tr, now)
         return session_present
 
     async def _take_over(self, client, entry: SessionEntry,
-                         new_epoch: int) -> bool:
+                         new_epoch: int, trace=None) -> bool:
         """One remote takeover: claim (with pull), wait bounded for the
         prior owner's state handoff, install the freshest copy we hold.
         ``cluster.takeover`` fault site keyed by the prior owner."""
@@ -393,7 +408,7 @@ class SessionFederation(Hook):
         fut = self.broker.loop.create_future()
         self._pulls[cid] = fut
         try:
-            self._send_claim(cid, new_epoch, pull=True)
+            self._send_claim(cid, new_epoch, pull=True, trace=trace)
             if any(lk.connected for lk in self.manager.links.values()):
                 try:
                     state = await asyncio.wait_for(
@@ -539,9 +554,15 @@ class SessionFederation(Hook):
         if resends or self.sync == "off" or not self._tracked(client) \
                 or not self.manager.links:
             return
-        self._note_op([client.id, packet.packet_id, "set",
-                       MessageRecord.from_packet(packet,
-                                                 client.id).to_json()])
+        op = [client.id, packet.packet_id, "set",
+              MessageRecord.from_packet(packet, client.id).to_json()]
+        # ADR 017: a sampled publish's replication op carries its trace
+        # identity (stamped on the delivery copy by _build_outbound) so
+        # the REPLICA side can correlate; zero cost untraced
+        ref = packet.__dict__.get("_trace_ref")
+        if ref is not None:
+            op.append(list(ref))
+        self._note_op(op)
 
     def on_qos_complete(self, client, packet) -> None:
         self._note_del(client, packet)
@@ -655,10 +676,15 @@ class SessionFederation(Hook):
     # ------------------------------------------------------------------
 
     def _send_claim(self, cid: str, epoch: int, purge: bool = False,
-                    pull: bool = False) -> None:
-        self._broadcast("claim", {
-            "cid": cid, "se": epoch, "be": self.broker.boot_epoch,
-            "purge": int(purge), "pull": int(pull)})
+                    pull: bool = False, trace=None) -> None:
+        d = {"cid": cid, "se": epoch, "be": self.broker.boot_epoch,
+             "purge": int(purge), "pull": int(pull)}
+        if trace is not None:
+            # ADR 017: the takeover trace's identity travels with the
+            # claim so the prior owner's ship leg can report its span
+            # back to this (origin) node
+            d["tr"] = [self.node_id, trace.id]
+        self._broadcast("claim", d)
 
     def _envelope(self, d: dict, to: str | None = None) -> dict:
         """One ``$cluster/sess`` wire envelope (bumps the per-origin
@@ -1047,7 +1073,9 @@ class SessionFederation(Hook):
         if cur is not None and cur.owner == self.node_id:
             if token > cur.token:
                 self._lose_session(cid, to=origin, pull=pull,
-                                   purge=purge, token=token)
+                                   purge=purge, token=token,
+                                   on_shipped=self._ship_reporter(
+                                       d.get("tr")))
             else:
                 # stale claimant: correct it with our own state record
                 self.claims_rejected += 1
@@ -1077,11 +1105,13 @@ class SessionFederation(Hook):
             cur.digest if keep else (0, 0))
 
     def _lose_session(self, cid: str, to: str, pull: bool, purge: bool,
-                      token: tuple) -> None:
+                      token: tuple, on_shipped=lambda: None) -> None:
         """A higher fencing token seized a session we own: disconnect
         the live client with v5 SessionTakenOver, hand the state to the
         winner when asked, and drop every local trace — the session now
-        lives (and persists) at the claimant."""
+        lives (and persists) at the claimant. ``on_shipped`` fires once
+        the handoff is on the wire (the ADR-017 ship-leg span reporter,
+        a no-op for untraced claims)."""
         self.sessions_lost += 1
         broker = self.broker
         client = broker.clients.get(cid)
@@ -1113,6 +1143,7 @@ class SessionFederation(Hook):
                 self._suppress_purge.discard(cid)
         if state is not None:
             self._broadcast("state", state, to=to)
+        on_shipped()
         entry = self._reowned_entry(cid, self.ledger.get(cid), token, purge)
         keep = not purge
         if state is not None and not purge:
@@ -1133,6 +1164,28 @@ class SessionFederation(Hook):
                 hook.store.delete_prefix(INFLIGHT_BUCKET, cid + "|")
                 for pid, raw in entry.inflight.items():
                     hook.store.put(INFLIGHT_BUCKET, f"{cid}|{pid}", raw)
+
+    def _ship_reporter(self, trace):
+        """ADR 017: a closure reporting the ship-leg span back to the
+        claimant — how long the prior owner spent disconnecting +
+        packaging the handoff. A claim without trace identity gets a
+        no-op, so _lose_session stays branch-free about tracing."""
+        tracer = getattr(self.broker, "tracer", None)
+        if trace is None or tracer is None:
+            return lambda: None
+        t_ship0 = tracer.clock()
+
+        def fire() -> None:
+            try:
+                dur_us = max(tracer.clock() - t_ship0, 0) // 1000
+                self.manager.telemetry.send_report(
+                    str(trace[0]), int(trace[1]),
+                    [["sess_ship", 0, dur_us]], e2e_us=dur_us,
+                    kind="sess")
+            except (TypeError, ValueError, IndexError):
+                pass    # malformed trace identity: the handoff stands
+
+        return fire
 
     def _state_dict(self, client, token: tuple) -> dict:
         subs, shares = self._subs_shares(client)
@@ -1166,6 +1219,7 @@ class SessionFederation(Hook):
             if kind == "set":
                 raw = str(op[3])
                 entry.inflight[pid] = raw
+                self._note_trace_op(cid, pid, op)
                 if hook is not None:
                     hook.store.put(INFLIGHT_BUCKET, f"{cid}|{pid}", raw)
             else:
@@ -1173,6 +1227,23 @@ class SessionFederation(Hook):
                 if hook is not None:
                     hook.store.delete(INFLIGHT_BUCKET, f"{cid}|{pid}")
         self._apply_digests(origin, d.get("dig") or {}, hook, seq)
+
+    def _note_trace_op(self, cid: str, pid: int, op: list) -> None:
+        """ADR 017: when the op carried its publish's trace identity,
+        count + log it — one grep of trace=<origin>:<id> correlates
+        the replica write with the origin's pipeline trace across
+        nodes."""
+        if len(op) <= 4 or not op[4]:
+            return
+        ref = op[4]
+        self.trace_ops_applied += 1
+        log = self.manager.log
+        if log is not None:
+            try:
+                log.debug("inflight replica applied", cid=cid, pid=pid,
+                          trace=f"{ref[0]}:{ref[1]}")
+            except (IndexError, TypeError):
+                pass
 
     def _apply_digests(self, origin: str, digests: dict, hook,
                        seq: int = 0) -> None:

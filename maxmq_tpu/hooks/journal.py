@@ -433,6 +433,11 @@ class WriteBehindStore(Store):
             # ADR 015: group-commit duration, observed from the writer
             # thread (histogram-only: a commit covers many publishes)
             self.tracer.observe("journal_commit", dt)
+            # ADR 017 (closing ADR-015's per-op attribution item): the
+            # same commit attributed to each storage bucket it touched,
+            # so "which writes own the fsync time" is answerable
+            for bucket in {op.bucket for op in batch}:
+                self.tracer.observe_journal(bucket, dt)
         with self._lock:
             self.committed_seq = max(self.committed_seq, batch[-1].seq)
             self.queued_bytes_now -= sum(op.size for op in batch)

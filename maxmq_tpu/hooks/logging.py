@@ -26,12 +26,19 @@ def _cid(client) -> str:
 
 
 def _trace_fields(packet) -> dict:
-    """Correlation fields for publish-path events (ADR 015): when this
-    publish rode the sampled pipeline tracer, every log line about it
-    carries the same ``trace`` id the flight recorder / Chrome export
-    uses — grep one id across logs and /traces."""
+    """Correlation fields for publish-path events (ADR 015/017): when
+    this publish rode the sampled pipeline tracer, every log line about
+    it carries the same ``trace`` id the flight recorder / Chrome
+    export uses. On the RECEIVING node of a cross-node forward the
+    trace is an adopted one and logs as ``<origin>:<id>`` — one grep
+    correlates the publish across every node of a cluster run."""
     tr = getattr(packet, "_trace", None)
-    return {"trace": tr.id} if tr is not None else {}
+    if tr is not None:
+        return {"trace": f"{tr.origin}:{tr.id}" if tr.origin else tr.id}
+    ref = getattr(packet, "_trace_ref", None)
+    if ref is not None:
+        return {"trace": f"{ref[0]}:{ref[1]}"}
+    return {}
 
 
 class LoggingHook(Hook):

@@ -239,7 +239,8 @@ class MatcherService:
                 msg = json.loads(payload)
                 if ftype == OP_MATCH:
                     t = asyncio.ensure_future(
-                        self._match(msg["r"], msg["t"], writer))
+                        self._match(msg["r"], msg["t"], writer,
+                                    stamps=bool(msg.get("c"))))
                     tasks.add(t)
                     t.add_done_callback(tasks.discard)
                 else:
@@ -253,19 +254,31 @@ class MatcherService:
                 t.cancel()
             writer.close()
 
-    async def _match(self, req_id: int, topics: list[str], writer) -> None:
+    async def _match(self, req_id: int, topics: list[str], writer,
+                     stamps: bool = False) -> None:
         try:
+            # ADR 017: when the client is tracing ("c" on the request),
+            # stamp dispatch/done around the engine call so the broker
+            # can split its matcher leg into queue vs device time even
+            # across the socket RPC. Durations only — monotonic clocks
+            # have per-process epochs, so raw stamps never cross as-is
+            # (the client rebases them onto its own timeline).
+            td = faults.REGISTRY.clock_ns() if stamps else 0
             enq = getattr(self.matcher, "enqueue", None)
             if enq is not None:
                 results = await asyncio.gather(*(enq(t) for t in topics))
             else:
                 results = await asyncio.gather(
                     *(self.matcher.subscribers_async(t) for t in topics))
+            tn = faults.REGISTRY.clock_ns() if stamps else 0
             self.matches_served += len(topics)
             # req_id round-trips through json.dumps so any JSON-legal
             # id a client sent (float, string) keys its reply correctly
+            head = json.dumps(req_id)
+            if stamps:
+                head += ',"td":%d,"tn":%d' % (td, tn)
             payload = ('{"r":%s,"s":[%s]}' % (
-                json.dumps(req_id),
+                head,
                 ",".join(self._result_frag(s) for s in results))
             ).encode()
         except asyncio.CancelledError:
@@ -308,6 +321,12 @@ class ServiceMatcher:
         # the subscription version; disabled when unset
         self._cache = VersionedTopicCache()
         self.index = None
+        # ADR 017: the broker's PipelineTracer (set by
+        # attach_matcher_service); while it samples, match requests ask
+        # the service for dispatch/done stamps and the reply rebases
+        # them onto this process's timeline as fut._t_dispatch/_t_done
+        # (the ADR-015 queue/device split, now across the socket RPC)
+        self.tracer = None
         # stats (scraped by the metrics bridge)
         self.matches = 0
         self.fallbacks = 0
@@ -409,6 +428,15 @@ class ServiceMatcher:
                 fut.set_exception(RuntimeError(
                     f"matcher service error: {msg['e']}"))
             else:
+                if "td" in msg:
+                    # rebase the service's dispatch->done duration onto
+                    # our clock: device time is the frame-free duration,
+                    # both socket directions land in match_queue
+                    now = (self.tracer.clock() if self.tracer is not None
+                           else faults.REGISTRY.clock_ns())
+                    dur = max(int(msg.get("tn", 0)) - int(msg["td"]), 0)
+                    fut._t_done = now
+                    fut._t_dispatch = now - dur
                 result = decode_result(msg["s"][0])
                 if ver is not None:
                     self._cache.put(topic, ver, result)
@@ -461,7 +489,12 @@ class ServiceMatcher:
         req = self._next_req    # counted separately, as in batcher mode)
         self._next_req += 1
         self._pending[req] = (fut, topic, ver)
-        self._send(OP_MATCH, {"r": req, "t": [topic]})
+        msg = {"r": req, "t": [topic]}
+        tracer = self.tracer
+        if tracer is not None and (tracer.sample_n
+                                   or tracer.adopted_open):
+            msg["c"] = 1        # ask the service for ADR-017 stamps
+        self._send(OP_MATCH, msg)
         return fut
 
     # reconnect backoff: the loop keeps retrying while traffic is quiet
@@ -569,6 +602,7 @@ async def attach_matcher_service(broker, path: str,
     delegates, so ``forward_*``/stats work on either)."""
     matcher = ServiceMatcher(path)
     matcher.index = broker.topics       # enables the topic cache
+    matcher.tracer = broker.tracer      # ADR 017: RPC trace stamps
     await matcher.connect()
 
     def reseed(m: ServiceMatcher) -> None:

@@ -261,7 +261,7 @@ def _cpu_profile(seconds: float, interval: float = 0.005) -> str:
 
 
 def _route_get(handler, registry, tracer, path: str, profiling: bool,
-               target: str):
+               target: str, cluster_metrics=None):
     """Resolve one metrics-server GET target to (body, content-type),
     or None for a 404 — the endpoint table for MetricsServer.Handler."""
     import json
@@ -273,6 +273,11 @@ def _route_get(handler, registry, tracer, path: str, profiling: bool,
     if tracer is not None and target == "/traces/chrome":
         return (json.dumps(tracer.chrome_events()).encode(),
                 "application/json")
+    if cluster_metrics is not None and target == "/cluster/metrics":
+        # ADR 017: the federated view — every live peer's snapshot
+        # counters with node= labels, served from ANY node
+        return (cluster_metrics().encode(),
+                "text/plain; version=0.0.4; charset=utf-8")
     if profiling and target.startswith("/debug/pprof"):
         return handler._pprof(target)
     return None
@@ -285,7 +290,8 @@ class MetricsServer:
 
     def __init__(self, address: str, registry: Registry,
                  path: str = "/metrics", profiling: bool = False,
-                 logger: Logger | None = None, tracer=None) -> None:
+                 logger: Logger | None = None, tracer=None,
+                 cluster_metrics=None) -> None:
         if not address or ":" not in address:
             raise ValueError(f"invalid metrics address {address!r}")
         host, _, port_s = address.rpartition(":")
@@ -296,6 +302,9 @@ class MetricsServer:
         self.profiling = profiling
         self.logger = logger
         self.tracer = tracer
+        # zero-arg callable -> Prometheus text (ADR 017: the cluster
+        # telemetry plane's aggregated /cluster/metrics page)
+        self.cluster_metrics = cluster_metrics
         self._httpd: http.server.ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
@@ -306,12 +315,13 @@ class MetricsServer:
     def start(self) -> None:
         registry, path, profiling = self.registry, self.path, self.profiling
         tracer = self.tracer
+        cluster_metrics = self.cluster_metrics
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
                 target = self.path.split("?", 1)[0]
                 hit = _route_get(self, registry, tracer, path, profiling,
-                                 target)
+                                 target, cluster_metrics)
                 if hit is None:
                     self.send_error(404)
                     return
@@ -438,6 +448,32 @@ def _register_trace_metrics(registry: Registry, broker) -> None:
         lambda: [({"stage": s, "reason": r}, n) for (s, r), n in
                  sorted(tracer.stage_error_items())
                  [:STAGE_ERROR_SERIES]])
+    registry.histogram_func(
+        "maxmq_storage_journal_commit_seconds",
+        "Group-commit duration attributed to each storage bucket the "
+        "batch touched (ADR 017; a commit covering N buckets observes "
+        "once per bucket, bounded to trace.MAX_JOURNAL_BUCKETS "
+        "families)",
+        lambda: [({"bucket": b}, h) for b, h in tracer.journal_items()])
+    registry.histogram_func(
+        "maxmq_cluster_publish_e2e_seconds",
+        "Origin-measured cross-node end-to-end latency of sampled "
+        "publishes by forwarding hop count (ADR 017; fed by returned "
+        "span reports)",
+        lambda: [({"hops": str(h)}, hist) for h, hist in
+                 sorted(tracer.cross_hist.items())])
+    registry.counter_func(
+        "maxmq_broker_trace_adopted_total",
+        "Remote-origin traces adopted on this node (ADR 017)",
+        lambda: tracer.adopted)
+    registry.counter_func(
+        "maxmq_broker_trace_remote_attached_total",
+        "Returned cross-node span reports attached to local entries",
+        lambda: tracer.remote_attached)
+    registry.counter_func(
+        "maxmq_broker_trace_remote_orphans_total",
+        "Returned span reports whose trace had left the recorder",
+        lambda: tracer.remote_orphans)
     registry.counter_func(
         "maxmq_broker_trace_sampled_total",
         "Publishes sampled into the pipeline tracer",
@@ -522,7 +558,55 @@ def _register_cluster_metrics(registry: Registry, broker) -> None:
         "maxmq_cluster_link_forwards_total", "counter",
         "Per-peer forwards enqueued; same cardinality bound",
         lambda: _peer_series(lambda lk: lk.forwards_sent))
+
+    def _member_series(attr):
+        peers = sorted(mgr.membership.peers.items())[:CLUSTER_PEER_SERIES]
+        return [({"peer": peer}, attr(st)) for peer, st in peers]
+
+    registry.multi_func(
+        "maxmq_cluster_peer_clock_skew_ms", "gauge",
+        "Per-peer monotonic-clock skew estimate from keepalive-driven "
+        "probes (ADR 017: peer clock minus ours at the RTT midpoint, "
+        "EWMA); same cardinality bound",
+        lambda: _member_series(lambda st: st.skew_ns / 1e6))
+    registry.multi_func(
+        "maxmq_cluster_peer_rtt_ms", "gauge",
+        "Per-peer clock-probe round-trip estimate (EWMA); same "
+        "cardinality bound",
+        lambda: _member_series(lambda st: st.rtt_ns / 1e6))
+    _register_telemetry_metrics(registry, mgr)
     _register_session_metrics(registry, mgr)
+
+
+def _register_telemetry_metrics(registry: Registry, mgr) -> None:
+    """ADR-017 observability-plane health: gossip and span-return
+    traffic counters, and how many peers' snapshots this node holds."""
+    tel = getattr(mgr, "telemetry", None)
+    if tel is None:
+        return
+    registry.gauge_func(
+        "maxmq_cluster_telemetry_peers_held",
+        "Peer metric snapshots currently held (serves /cluster/metrics)",
+        lambda: len(tel.peers))
+    for name, help_ in (
+            ("snapshots_sent", "Telemetry snapshots/deltas broadcast"),
+            ("snapshots_applied", "Peer telemetry snapshots applied"),
+            ("snapshots_stale", "Out-of-order snapshots ignored"),
+            ("snapshot_relays", "Snapshots relayed onward (transitive "
+             "gossip)"),
+            ("probes_sent", "Clock-skew probes sent"),
+            ("probe_replies", "Clock-skew probes answered for peers"),
+            ("skew_updates", "Skew estimate updates applied"),
+            ("trace_reports_sent", "Cross-node span reports sent "
+             "toward an origin"),
+            ("trace_reports_received", "Span reports received as the "
+             "origin (post-dedup)"),
+            ("trace_reports_relayed", "Span reports relayed toward "
+             "their origin"),
+            ("inbound_rejected", "Malformed observability-plane wire "
+             "messages rejected")):
+        registry.counter_func(f"maxmq_cluster_telemetry_{name}_total",
+                              help_, lambda n=name: getattr(tel, n))
 
 
 def _register_session_metrics(registry: Registry, mgr) -> None:
@@ -576,7 +660,9 @@ def _register_session_metrics(registry: Registry, mgr) -> None:
             ("digest_mismatches", "Takeovers whose installed inflight "
              "window disagreed with the owner's digest"),
             ("restore_errors", "Ledger journal rows that failed to "
-             "parse at restore")):
+             "parse at restore"),
+            ("trace_ops_applied", "Replicated inflight ops applied "
+             "that carried ADR-017 trace identity")):
         registry.counter_func(f"maxmq_cluster_session_{name}_total",
                               help_, lambda n=name: getattr(sess, n))
 

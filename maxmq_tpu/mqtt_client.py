@@ -21,6 +21,11 @@ class Message:
     qos: int = 0
     retain: bool = False
     properties: Properties = field(default_factory=Properties)
+    # ADR 017: cross-node trace identity ("<origin>:<id>") carried on
+    # the delivery's ``mq-trace`` v5 user property when the publish
+    # rode a sampled trace — one grep key across every node's logs,
+    # /traces pages, and the bench subscribers
+    trace: str = ""
 
 
 class MQTTError(Exception):
@@ -190,7 +195,10 @@ class MQTTClient:
     async def _handle_publish(self, packet: Packet) -> None:
         msg = Message(topic=packet.topic, payload=packet.payload,
                       qos=packet.fixed.qos, retain=packet.fixed.retain,
-                      properties=packet.properties)
+                      properties=packet.properties,
+                      trace=next((v for k, v in
+                                  packet.properties.user_properties
+                                  if k == "mq-trace"), ""))
         if packet.fixed.qos == 1:
             ack = Packet(fixed=FixedHeader(type=PT.PUBACK),
                          protocol_version=self.version,

@@ -97,6 +97,18 @@ class Config:
     cluster_session_sync_timeout_ms: int = 750      # barrier degrade bound
     cluster_session_takeover_timeout_ms: int = 750  # state-pull wait bound
 
+    # -- cluster observability plane (ADR 017) --------------------------------
+    # carry trace context on forwarded publishes to capability-
+    # negotiated peers (one correlated trace across the cluster) and
+    # return the remote span breakdowns to the origin
+    cluster_trace_propagation: bool = True
+    cluster_trace_return: bool = True
+    # per-node metric-snapshot gossip feeding /cluster/metrics and
+    # $SYS/broker/cluster/health/*; 0 disables the periodic gossip
+    # (skew probes and trace returns stay on)
+    cluster_telemetry_interval_s: float = 5.0
+    cluster_telemetry_full_every: int = 10   # full snapshot every Nth send
+
     # -- publish-path tracing (ADR 015) ---------------------------------------
     # sample every Nth publish into the pipeline tracer (0 = off; off
     # costs one branch per stage). Sampled publishes feed the per-stage

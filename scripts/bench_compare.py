@@ -1,0 +1,253 @@
+#!/usr/bin/env python
+"""Bench-trajectory comparison / regression gate (ADR 017).
+
+The repo accumulates one ``BENCH_r<NN>.json`` per round (the driver's
+capture: ``{n, cmd, rc, tail, parsed}``) plus ``BENCH_TPU_LAST_GOOD``
+— and until this script, nothing read them, which is why the perf
+trajectory handed to each round was empty. This tool:
+
+1. loads the newest two rounds (and the last-good reference when
+   present), tolerating every historical shape: a structured
+   ``parsed`` object, a raw bench row list, or a truncated ``tail``
+   from which the largest complete JSON object is recovered via
+   ``raw_decode`` brace-scanning;
+2. flattens every ``{"config": ...}`` row into ``config/metric``
+   numeric leaves (nested dicts dot-joined, so the ADR-015 ``trace``
+   stanza's ``p99_ms`` tails participate);
+3. prints a per-config/per-metric delta table between the two rounds;
+4. exits non-zero when a **headline throughput** metric (``*per_sec*``,
+   higher-better) or a **p99 latency** metric (``*p99*``,
+   lower-better) regressed by more than ``--threshold`` (default 15%).
+
+CI runs it as a *report* step with ``--warn-only`` (exit 0 always);
+the blocking knob is removing that flag — see docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import re
+import sys
+
+DEFAULT_THRESHOLD = 0.15
+ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+# ----------------------------------------------------------------------
+# Loading: every historical BENCH file shape -> a JSON document
+# ----------------------------------------------------------------------
+
+
+def _recover_from_tail(tail: str) -> dict | list | None:
+    """The driver keeps only the LAST 2000 chars of bench stdout, so
+    the outermost JSON object is usually truncated at the front.
+    Scan each ``{``/``[`` and ``raw_decode`` (which tolerates trailing
+    garbage); keep the candidate with the most content."""
+    dec = json.JSONDecoder()
+    best, best_len = None, 0
+    starts = [m.start() for m in re.finditer(r"[{\[]", tail)][:64]
+    for i in starts:
+        try:
+            obj, end = dec.raw_decode(tail[i:])
+        except ValueError:
+            continue
+        if isinstance(obj, (dict, list)) and end > best_len:
+            best, best_len = obj, end
+    return best
+
+
+def load_round(path: str):
+    """One bench file -> (label, document-or-None)."""
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if isinstance(doc, dict):
+        if doc.get("parsed") is not None:
+            return doc["parsed"]
+        if isinstance(doc.get("result"), (dict, list)):
+            return doc["result"]           # BENCH_TPU_LAST_GOOD shape
+        if isinstance(doc.get("tail"), str):
+            return _recover_from_tail(doc["tail"])
+    return doc
+
+
+# ----------------------------------------------------------------------
+# Extraction: document -> {config: {metric: float}}
+# ----------------------------------------------------------------------
+
+
+def _flatten(d: dict, prefix: str, out: dict) -> None:
+    for k, v in d.items():
+        key = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, bool):
+            continue
+        if isinstance(v, (int, float)) and math.isfinite(v):
+            out[key] = float(v)
+        elif isinstance(v, dict):
+            _flatten(v, key, out)
+
+
+def extract_rows(doc) -> dict[str, dict[str, float]]:
+    """Walk any bench document collecting every ``{"config": ...}``
+    row (flattened to numeric leaves) plus a ``_headline`` row for the
+    driver's top-level {metric, value} summary."""
+    rows: dict[str, dict[str, float]] = {}
+
+    def walk(node) -> None:
+        if isinstance(node, list):
+            for item in node:
+                walk(item)
+            return
+        if not isinstance(node, dict):
+            return
+        cfg = node.get("config")
+        if isinstance(cfg, str):
+            flat: dict[str, float] = {}
+            _flatten(node, "", flat)
+            flat.pop("config", None)
+            rows.setdefault(cfg, {}).update(flat)
+        if isinstance(node.get("metric"), str) and isinstance(
+                node.get("value"), (int, float)):
+            rows.setdefault("_headline", {})[node["metric"]] = \
+                float(node["value"])
+        for v in node.values():
+            if isinstance(v, (dict, list)):
+                walk(v)
+
+    walk(doc)
+    return rows
+
+
+# ----------------------------------------------------------------------
+# Comparison
+# ----------------------------------------------------------------------
+
+
+def _direction(metric: str) -> int:
+    """+1 = higher is better, -1 = lower is better, 0 = informational."""
+    m = metric.lower()
+    if "per_sec" in m or "per_s" in m:
+        return 1
+    if m.endswith("_ms") or m.endswith("_s") or "latency" in m:
+        return -1
+    return 0
+
+
+def _gated(metric: str) -> bool:
+    """Only headline throughput and p99 tails gate the exit code."""
+    m = metric.lower()
+    return "per_sec" in m or "p99" in m
+
+
+def compare(old: dict, new: dict, threshold: float):
+    """-> (table_rows, regressions). A regression is a gated metric
+    moving >threshold in its bad direction."""
+    table, regressions = [], []
+    for cfg in sorted(set(old) & set(new)):
+        for metric in sorted(set(old[cfg]) & set(new[cfg])):
+            a, b = old[cfg][metric], new[cfg][metric]
+            d = _direction(metric)
+            if d == 0:
+                continue
+            if a == 0:
+                delta = 0.0 if b == 0 else math.inf
+            else:
+                delta = (b - a) / abs(a)
+            bad = (d > 0 and delta < -threshold) or \
+                  (d < 0 and delta > threshold)
+            flag = ""
+            if bad:
+                flag = "REGRESSION" if _gated(metric) else "worse"
+                if _gated(metric):
+                    regressions.append((cfg, metric, a, b, delta))
+            table.append((cfg, metric, a, b, delta, flag))
+    return table, regressions
+
+
+def find_rounds(root: str) -> list[str]:
+    files = glob.glob(os.path.join(root, "BENCH_r*.json"))
+    keyed = []
+    for f in files:
+        m = ROUND_RE.search(os.path.basename(f))
+        if m:
+            keyed.append((int(m.group(1)), f))
+    return [f for _n, f in sorted(keyed)]
+
+
+def _fmt_val(v: float) -> str:
+    return f"{v:,.3f}".rstrip("0").rstrip(".") or "0"
+
+
+def render(table, old_label: str, new_label: str) -> str:
+    lines = [f"bench delta: {old_label} -> {new_label}",
+             f"{'config':28} {'metric':44} {'old':>14} {'new':>14} "
+             f"{'delta':>9}  flag"]
+    for cfg, metric, a, b, delta, flag in table:
+        pct = ("inf" if math.isinf(delta) else f"{delta * 100:+.1f}%")
+        lines.append(f"{cfg[:28]:28} {metric[:44]:44} "
+                     f"{_fmt_val(a):>14} {_fmt_val(b):>14} "
+                     f"{pct:>9}  {flag}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("files", nargs="*",
+                    help="explicit bench JSONs (oldest first); default "
+                         "= the newest two BENCH_r*.json in --root")
+    ap.add_argument("--root", default=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), help="repo root to scan")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                    help="regression threshold as a fraction "
+                         "(default 0.15)")
+    ap.add_argument("--warn-only", action="store_true",
+                    help="always exit 0 (the CI report mode; remove "
+                         "this flag to make the gate blocking)")
+    args = ap.parse_args(argv)
+
+    paths = args.files or find_rounds(args.root)[-2:]
+    if len(paths) < 2:
+        print("bench-compare: fewer than two usable rounds; nothing "
+              "to compare", file=sys.stderr)
+        return 0
+    old_path, new_path = paths[-2], paths[-1]
+    rows = []
+    for p in (old_path, new_path):
+        doc = load_round(p)
+        rows.append(extract_rows(doc) if doc is not None else {})
+    old_rows, new_rows = rows
+    if not old_rows or not new_rows:
+        print(f"bench-compare: no extractable rows "
+              f"(old={len(old_rows)} cfgs, new={len(new_rows)} cfgs); "
+              f"skipping", file=sys.stderr)
+        return 0
+    table, regressions = compare(old_rows, new_rows, args.threshold)
+    print(render(table, os.path.basename(old_path),
+                 os.path.basename(new_path)))
+
+    good_path = os.path.join(args.root, "BENCH_TPU_LAST_GOOD.json")
+    if os.path.isfile(good_path):
+        good_doc = load_round(good_path)
+        good_rows = extract_rows(good_doc) if good_doc else {}
+        if good_rows:
+            ref_table, _ = compare(good_rows, new_rows, args.threshold)
+            print()
+            print(render(ref_table, "BENCH_TPU_LAST_GOOD.json",
+                         os.path.basename(new_path)))
+
+    if regressions:
+        print(f"\n{len(regressions)} regression(s) past "
+              f"{args.threshold * 100:.0f}%:", file=sys.stderr)
+        for cfg, metric, a, b, delta in regressions:
+            print(f"  {cfg}/{metric}: {_fmt_val(a)} -> {_fmt_val(b)} "
+                  f"({delta * 100:+.1f}%)", file=sys.stderr)
+        return 0 if args.warn_only else min(len(regressions), 125)
+    print("\nno gated regressions")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -196,9 +196,12 @@ def validate(text: str) -> list[str]:
 def self_test() -> str:
     """Boot a fully wired broker registry + MetricsServer on an
     ephemeral port, generate enough state that every family (incl. the
-    ADR-015 histograms, the escaped offender labels, and a hostile
-    client id) has series, and return the scraped page."""
+    ADR-015 histograms, the ADR-017 cluster/cross-node families, the
+    escaped offender labels, and a hostile client id) has series, and
+    return the scraped page — with the federated ``/cluster/metrics``
+    page validated on the side."""
     from maxmq_tpu.broker import Broker, BrokerOptions, Capabilities
+    from maxmq_tpu.cluster import ClusterManager
     from maxmq_tpu.hooks.journal import WriteBehindStore
     from maxmq_tpu.hooks.storage import MemoryStore, StorageHook
     from maxmq_tpu.metrics import (MetricsServer, Registry,
@@ -208,22 +211,48 @@ def self_test() -> str:
         sys_topic_interval=0, trace_sample_n=1)))
     broker.add_hook(StorageHook(WriteBehindStore(MemoryStore())))
     tracer = broker.tracer
-    for stage in ("fanout", "barrier", "journal_commit"):
+    for stage in ("fanout", "barrier", "journal_commit", "release",
+                  "bridge_in"):
         tracer.observe(stage, 0.0012)
         tracer.observe(stage, 0.4)
     tr = tracer.sample("t/x", 1, 'evil"client\\id\n')
     tr.span("admission", tr.start_ns, tr.start_ns + 1000)
     tracer.finish(tr, tr.start_ns + 50_000)
     tracer.note_error("drain", "queue_full")
+    # ADR 017: journal bucket attribution, an adopted remote trace,
+    # and a returned span report feeding the per-hop e2e family
+    tracer.observe_journal("inflight", 0.002)
+    tracer.observe_journal("retained", 0.004)
+    atr = tracer.adopt("nodeB", tr.id, "t/x", 0, 1, tr.start_ns)
+    atr.span("bridge_in", tr.start_ns, tr.start_ns + 500)
+    tracer.finish(atr, tr.start_ns + 9_000)
+    tracer.attach_remote({"i": tr.id, "n": "nodeB", "h": 2,
+                          "e2e_us": 1200,
+                          "spans": [["bridge_in", 1, 3]]})
     # a hostile client id must survive the offender-label escaping
     hostile = broker.new_inline_client('bad"id\\with\nnewline')
     hostile.dropped_msgs = 3
     hostile.drops_by_reason["byte_budget"] = 3
     broker.clients.add(hostile)
+    # ADR 017: a peerless cluster manager + a faked peer snapshot so
+    # the telemetry families and /cluster/metrics page have series
+    mgr = ClusterManager(broker, "selftest", [],
+                         telemetry_interval_s=0)
+    broker.attach_cluster(mgr)
+
+    class _Pkt:
+        payload = (b'{"o": "peerB", "s": 1, "full": 1, "d": '
+                   b'{"maxmq_mqtt_messages_received": '
+                   b'["counter", 42]}}')
+
+    mgr.telemetry.handle_snapshot(
+        "peerB", ["$cluster", "telemetry", "peerB"], _Pkt())
 
     registry = Registry()
     register_broker_metrics(registry, broker)
-    server = MetricsServer("127.0.0.1:0", registry, tracer=tracer)
+    server = MetricsServer(
+        "127.0.0.1:0", registry, tracer=tracer,
+        cluster_metrics=mgr.telemetry.cluster_exposition)
     server.start()
     try:
         url = f"http://127.0.0.1:{server.bound_port}/metrics"
@@ -236,6 +265,18 @@ def self_test() -> str:
                     f"http://127.0.0.1:{server.bound_port}{path}",
                     timeout=5) as resp:
                 json.loads(resp.read().decode())
+        # the federated page is its own exposition document: validate
+        # it separately (node= labels, ages, declared types)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.bound_port}"
+                f"/cluster/metrics", timeout=5) as resp:
+            cluster_page = resp.read().decode()
+        # fold cluster-page findings into the main page as unparseable
+        # lines so the exit code (and CI) sees them
+        for err in validate(cluster_page):
+            page += f"\nCLUSTER-PAGE-FINDING: {err}"
+        if 'node="peerB"' not in cluster_page:
+            page += "\nCLUSTER-PAGE-FINDING: missing peer series"
     finally:
         server.stop()
     return page

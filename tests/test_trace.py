@@ -147,10 +147,15 @@ def test_chrome_export_is_valid_trace_event_json():
     blob = json.dumps(tracer.chrome_events())
     doc = json.loads(blob)
     events = doc["traceEvents"]
-    assert events and all(e["ph"] == "X" for e in events)
-    names = {e["name"] for e in events}
+    # ADR 017: process_name metadata rows name the per-node tracks;
+    # every span row stays a complete ('X') event
+    spans = [e for e in events if e["ph"] != "M"]
+    assert spans and all(e["ph"] == "X" for e in spans)
+    assert any(e["ph"] == "M" and e["name"] == "process_name"
+               for e in events)
+    names = {e["name"] for e in spans}
     assert "admission" in names and "fanout" in names
-    for e in events:
+    for e in spans:
         assert isinstance(e["ts"], int) and e["dur"] >= 1
 
 
