@@ -1829,6 +1829,7 @@ def bench_failover(parked: int = 50, share_msgs: int = 60) -> dict:
     from maxmq_tpu.cluster import ClusterManager, PeerSpec
     from maxmq_tpu.hooks import AllowHook
     from maxmq_tpu.mqtt_client import MQTTClient
+    from maxmq_tpu.protocol.packets import Will
 
     line = {"A": ["B"], "B": ["A", "C"], "C": ["B"]}
 
@@ -1884,7 +1885,9 @@ def bench_failover(parked: int = 50, share_msgs: int = 60) -> dict:
         pub = MQTTClient(client_id="fo-pub")
         await pub.connect("127.0.0.1", brokers["A"].test_port)
         for i in range(share_msgs):
-            await pub.publish("fo/s", b"x" * 64)
+            # distinct payloads: the ADR-018 weighted rotation hashes
+            # per publish — identical bytes would pin one owner
+            await pub.publish("fo/s", f"sh-{i:03d}-".encode() + b"x" * 56)
         per_node = {}
         for name, c in members.items():
             n = 0
@@ -1923,6 +1926,87 @@ def bench_failover(parked: int = 50, share_msgs: int = 60) -> dict:
                    5.0)    # ~90% of one report per node per publish
         d["cross_trace"] = trace_stanza(brokers["A"].tracer)
         await sub_x.disconnect()
+
+        # -- partition phase (ADR 018): split-brain + heal under load --
+        # A | B-C on the line (cutting the A-B edge isolates A), with a
+        # cross-node QoS1 stream A -> C and a will-carrying client at
+        # A. Reports the loss window (PUBACKed-but-undelivered after
+        # the heal settles — the zero bar), the will count (exactly one
+        # transferred will per suspected death), and heal-to-delivery
+        # convergence time.
+        from maxmq_tpu import faults as _faults
+        for m in mgrs.values():
+            if m.sessions is not None:
+                m.sessions.will_grace = 0.3
+        sub_p = MQTTClient(client_id="fo-psub")
+        await sub_p.connect("127.0.0.1", brokers["C"].test_port)
+        await sub_p.subscribe(("pt/#", 1))
+        wsub = MQTTClient(client_id="fo-wsub")
+        await wsub.connect("127.0.0.1", brokers["B"].test_port)
+        await wsub.subscribe(("ptwill/#", 1))
+        wc = MQTTClient(client_id="fo-will", version=5, clean_start=False,
+                        session_expiry=600,
+                        will=Will(topic="ptwill/fo", payload=b"rip",
+                                  qos=1))
+        await wc.connect("127.0.0.1", brokers["A"].test_port)
+        await poll(lambda: bool(mgrs["A"].routes.nodes_for("pt/m"))
+                   and bool(mgrs["B"].sessions.ledger.get("fo-will")
+                            and mgrs["B"].sessions.ledger["fo-will"].will),
+                   15.0)
+        sent_p = []
+        for i in range(10):                 # healthy leg
+            await pub.publish("pt/m", f"pre-{i}".encode(), qos=1)
+            sent_p.append(f"pre-{i}".encode())
+        _faults.partition("A", "B")         # split-brain: A | B-C
+        await poll(lambda: mgrs["A"].links_up == 0, 15.0)
+        t0 = time.perf_counter()
+        for i in range(20):                 # publishes INTO the split
+            await pub.publish("pt/m", f"cut-{i}".encode(), qos=1)
+            sent_p.append(f"cut-{i}".encode())
+        d["partition_puback_s_during_split"] = round(
+            time.perf_counter() - t0, 3)    # bounded-degrade proof
+        wills_seen = await poll(
+            lambda: (mgrs["B"].sessions.wills_fired
+                     + mgrs["C"].sessions.wills_fired) >= 1, 15.0)
+        _faults.heal("A", "B")
+        t_heal = time.perf_counter()
+        await poll(lambda: all(m.links_up == len(line[n])
+                               for n, m in mgrs.items()), 30.0)
+        got_p = set()
+
+        async def _drain_p() -> None:
+            while True:
+                try:
+                    got_p.add((await sub_p.next_message(
+                        timeout=1.5)).payload)
+                except asyncio.TimeoutError:
+                    return
+
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and not set(sent_p) <= got_p:
+            await _drain_p()
+        d["partition_pubacked"] = len(sent_p)
+        d["partition_loss_window"] = len(set(sent_p) - got_p)
+        d["partition_heal_convergence_ms"] = round(
+            (time.perf_counter() - t_heal) * 1e3, 1)
+        d["partition_wills_fired"] = (mgrs["B"].sessions.wills_fired
+                                      + mgrs["C"].sessions.wills_fired)
+        d["partition_will_detect_s"] = round(wills_seen, 3) \
+            if wills_seen >= 0 else -1
+        d["partition_fwd_parked"] = mgrs["A"].forwards_parked
+        d["partition_fwd_resent"] = mgrs["A"].fwd_parked_resent
+        d["partition_barrier_degraded"] = mgrs["A"].fwd_barrier_degraded
+        got_w = []
+        while True:
+            try:
+                got_w.append(await wsub.next_message(timeout=1.0))
+            except asyncio.TimeoutError:
+                break
+        d["partition_wills_delivered"] = len(got_w)
+        await wc.disconnect()       # clean: discards the (re-armed) will
+        await wc.close()
+        await sub_p.close()
+        await wsub.close()
 
         # -- live takeover: reconnect-to-CONNACK with a state pull ----
         sess = MQTTClient(client_id="fo-sess", version=5,
@@ -1988,7 +2072,13 @@ def bench_failover(parked: int = 50, share_msgs: int = 60) -> dict:
         f"failover={d['failover_connack_ms']}ms "
         f"loss={d['takeover_loss_window']}/{d['parked_pubacked']} "
         f"share-exactly-once={d['share_exactly_once']} "
-        f"per-node={d['share_deliveries_per_node']}")
+        f"per-node={d['share_deliveries_per_node']} | "
+        f"partition loss={d['partition_loss_window']}"
+        f"/{d['partition_pubacked']} "
+        f"wills={d['partition_wills_fired']} "
+        f"heal={d['partition_heal_convergence_ms']}ms "
+        f"parked={d['partition_fwd_parked']}"
+        f"->{d['partition_fwd_resent']} resent")
     return d
 
 

@@ -167,6 +167,9 @@ def build_cluster(conf: Config, broker: Broker, logger: Logger | None = None):
         session_replication=conf.cluster_session_replication,
         session_sync=conf.cluster_session_sync,
         session_sync_timeout_ms=conf.cluster_session_sync_timeout_ms,
+        fwd_durability=conf.cluster_fwd_durability,
+        replica_expiry_s=float(conf.cluster_replica_expiry_s),
+        share_balance=conf.cluster_share_balance,
         session_takeover_timeout_ms=(
             conf.cluster_session_takeover_timeout_ms),
         trace_propagation=conf.cluster_trace_propagation,

@@ -573,7 +573,13 @@ class Client:
         """Queue all unacked messages again (session resume [MQTT-4.4.0-1]).
         Returns the number of packets queued."""
         n = 0
+        held = set(self.held_pids)
         for p in self.inflight.all():
+            if p.packet_id in held:
+                # held-but-unsent (ADR 018): was never on the wire, so
+                # it is not a resend — _release_held sends it fresh
+                # (no DUP) as send quota opens
+                continue
             q = p.copy()
             if q.type == PT.PUBLISH and force_dup:
                 q.fixed.dup = True

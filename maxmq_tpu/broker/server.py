@@ -15,6 +15,7 @@ import heapq
 import threading
 import time
 from dataclasses import dataclass, field
+from zlib import crc32
 
 from .. import faults
 from ..hooks.base import Hook, Hooks, RejectPacket
@@ -446,6 +447,9 @@ class Broker:
         self._settle_half_open(client)     # handshake completed
         if session_present:
             client.resend_inflight()
+            # quota-parked (held) messages resumed with the session:
+            # nothing acked yet, so kick the drain once (ADR 018)
+            self._release_held(client)
         self.hooks.notify("on_session_established", client, packet)
 
         err: ProtocolError | None = None
@@ -534,6 +538,9 @@ class Broker:
         client.inflight.maximum_receive = self.capabilities.receive_maximum
         client.inflight.receive_quota = client.inflight.maximum_receive
         client.pubrec_inbound = set(existing.pubrec_inbound)
+        # held-but-unsent pids stay parked across the resume (ADR 018):
+        # resend skips them, _release_held drains them under quota
+        client.held_pids = type(client.held_pids)(existing.held_pids)
         return bool(client.subscriptions) or len(client.inflight) > 0
 
     def _purge_session(self, client: Client) -> None:
@@ -554,6 +561,14 @@ class Broker:
         """The ADR-016 session-federation manager, when attached."""
         return (getattr(self.cluster, "sessions", None)
                 if self.cluster is not None else None)
+
+    def _note_pubrec(self, client: Client, pid: int, add: bool) -> None:
+        """ADR 018: stream receiver-side QoS2 dedup (PUBREC-pending)
+        changes to the session federation, so a dead-owner failover
+        keeps the dedup set instead of redelivering on PUBLISH retry."""
+        sessions = self._cluster_sessions()
+        if sessions is not None:
+            sessions.note_pubrec(client, pid, add)
 
     def _send_connack(self, client: Client, code: codes.Code,
                       session_present: bool) -> None:
@@ -765,7 +780,9 @@ class Broker:
             # resolves: a client that times out and retransmits the
             # same id mid-barrier must be deduped, not redelivered
             # (_ack_publish re-adds on send — a set, idempotent)
-            client.pubrec_inbound.add(packet.packet_id)
+            if packet.packet_id not in client.pubrec_inbound:
+                client.pubrec_inbound.add(packet.packet_id)
+                self._note_pubrec(client, packet.packet_id, True)
         if self.matcher is None:
             if tr is None:
                 subscribers = self._match_cached(packet.topic)
@@ -793,19 +810,41 @@ class Broker:
     async def _process_cluster_inbound(self, client: Client,
                                        packet: Packet) -> None:
         """``$cluster/*`` publishes from a recognized bridge peer are
-        the federation wire: ack them on the normal QoS path (the link
-        QoS is the delivery guarantee between nodes) and hand them to
-        the ClusterManager. Everything else in the ``$`` namespace
-        from a network client stays dropped."""
+        the federation wire: hand them to the ClusterManager, then ack
+        on the normal QoS path (the link QoS is the delivery guarantee
+        between nodes). Everything else in the ``$`` namespace from a
+        network client stays dropped.
+
+        The ack moves AFTER the apply (ADR 018): a QoS1 sess/fwd
+        message is PUBACKed only once its op is applied and enqueued to
+        the journal — the sender's replication/fwd barrier then means
+        "the peer holds it", not "the peer's socket read it", closing
+        the MQTT-ack-vs-apply window ADR 016 left open. The inbound
+        half of the directed ``cluster.partition`` site sits before
+        everything: a dropped message is in-flight loss (no ack, no
+        apply), exactly what a blackholed path does."""
         mgr = self.cluster
         if (mgr is None or not packet.topic.startswith("$cluster/")
                 or not mgr.is_bridge_client(client)):
             return
+        sender = mgr.bridge_peer(client)
+        try:
+            hit = faults.fire_detail(
+                faults.CLUSTER_PARTITION,
+                key=faults.partition_key(sender, mgr.node_id))
+        except faults.InjectedFault:
+            hit = ("drop", 0.0)
+        if hit is not None:
+            if hit[0] == "hang":
+                await asyncio.sleep(hit[1])
+            else:
+                mgr.partition_drops_in += 1
+                return      # lost in flight: no ack, no apply
         if not self._check_publish_qos(client, packet):
             return  # repeated QoS2 id: already re-acked
-        self._ack_publish(client, packet, success=True)
         self.info.messages_received += 1
         await mgr.handle_inbound(client, packet)
+        self._ack_publish(client, packet, success=True)
 
     @staticmethod
     def _resolve_inbound_alias(client: Client, packet: Packet) -> None:
@@ -905,7 +944,9 @@ class Broker:
             self._send_ack(client, PT.PUBACK, packet, reason)
         elif qos == 2:
             if success:
-                client.pubrec_inbound.add(packet.packet_id)
+                if packet.packet_id not in client.pubrec_inbound:
+                    client.pubrec_inbound.add(packet.packet_id)
+                    self._note_pubrec(client, packet.packet_id, True)
                 tracer = self.tracer
                 if ((tracer.sample_n or tracer.adopted_open)
                         and packet.__dict__.get("_trace") is not None):
@@ -949,6 +990,17 @@ class Broker:
             # peer can redeliver. Both barriers are bounded/degradable.
             fut = self._combine_barriers(fut,
                                          sessions.sync_barrier(self.loop))
+        if self.cluster is not None and getattr(self.cluster,
+                                               "fwd_coupled", False):
+            # ADR 018: cross-node publish durability — the ack also
+            # waits (bounded) for every peer this publish forwarded to
+            # to PUBACK the forward; the peer acks only after its own
+            # apply+journal enqueue, so a released PUBACK means the
+            # remote subscriber's node holds the message. Timed-out or
+            # stranded forwards are parked for retry-after-heal
+            # (degraded + counted, never a wedged publisher).
+            fut = self._combine_barriers(
+                fut, self.cluster.fwd_barrier(self.loop, packet))
         tr = self._packet_trace(packet)
         if tr is not None:
             tr.t_barrier = self.tracer.clock()
@@ -970,6 +1022,10 @@ class Broker:
             return False
         if self._journal is not None and self._journal.barrier_needed:
             return True
+        if (self.cluster is not None
+                and getattr(self.cluster, "fwd_coupled", False)
+                and self.cluster.links):
+            return True     # ADR 018: the fwd leg may owe a barrier
         sessions = self._cluster_sessions()
         return sessions is not None and sessions.ack_coupled
 
@@ -1260,13 +1316,22 @@ class Broker:
         [MQTT-4.8.2-4]."""
         selected: dict[str, Subscription] = {}
         sessions = self._cluster_sessions()
+        token = None
+        if (sessions is not None
+                and sessions.manager.routes.shares.balance == "weighted"):
+            # ADR 018: fairness-aware cluster $share — every node
+            # derives the same per-publish token from the same bytes,
+            # so the weighted rotation stays exactly-once cluster-wide
+            # (pin mode never reads it: skip the payload hash)
+            token = crc32(packet.payload,
+                          crc32(packet.topic.encode()))
         for (group, filt), candidates in shared.items():
-            if sessions is not None and not sessions.owns_share(group,
-                                                                filt):
-                # ADR 016: cluster-wide $share — another node owns this
-                # (group, filter) pick; its forward copy delivers there,
-                # so the group receives the publish exactly once
-                # cluster-wide instead of once per node
+            if sessions is not None and not sessions.owns_share(
+                    group, filt, token):
+                # ADR 016/018: cluster-wide $share — another node owns
+                # this (group, filter) pick for this publish; its
+                # forward copy delivers there, so the group receives
+                # the publish exactly once cluster-wide
                 continue
             pick = self.topics.select_shared(
                 group, filt, candidates,
@@ -1491,6 +1556,14 @@ class Broker:
         self.info.inflight += 1
         if not client.inflight.take_send_quota():
             client.held_pids.append(out.packet_id)
+            # ADR 018 (satellite): a quota-parked message is IN the
+            # window — notify now so the storage hook journals it and
+            # the session federation replicates it (held=True rides the
+            # record); the release notifies again, clearing the flag.
+            # Without this, a crash or takeover silently dropped every
+            # held message (the shared ADR-014/016 NOT-done gap).
+            self.hooks.notify("on_qos_publish", client, out,
+                              out.created, 0)
             return False
         self.hooks.notify("on_qos_publish", client, out, out.created, 0)
         return True
@@ -1576,6 +1649,7 @@ class Broker:
                     reason_code=codes.ErrPacketIdentifierNotFound.value))
             return
         client.pubrec_inbound.discard(packet.packet_id)
+        self._note_pubrec(client, packet.packet_id, False)
         client.inflight.return_receive_quota()
         if packet.reason_code >= 0x80 or not packet.reason_code_valid():
             # [MQTT-4.3.3-9]: the receiver abandons the inbound QoS2
@@ -1703,8 +1777,11 @@ class Broker:
             client.inflight.set(out.copy())
             self.info.inflight += 1
             if not client.inflight.take_send_quota():
-                # respect the client's receive maximum [MQTT-3.3.4-9]
+                # respect the client's receive maximum [MQTT-3.3.4-9];
+                # parked retained deliveries persist+replicate like any
+                # held message (ADR 018)
                 client.held_pids.append(out.packet_id)
+                self.hooks.notify("on_qos_publish", client, out, now, 0)
                 return
         if client.send(out):
             self.hooks.notify("on_retain_published", client, out)
@@ -2110,6 +2187,16 @@ class Broker:
             "$SYS/broker/cluster/forwards_delivered":
                 mgr.forwards_delivered,
             "$SYS/broker/cluster/loops_dropped": mgr.loops_dropped,
+            # ADR 018: cross-node publish durability + partition health
+            "$SYS/broker/cluster/fwd_parked":
+                getattr(mgr, "fwd_parked_now", 0),
+            "$SYS/broker/cluster/fwd_parked_resent":
+                getattr(mgr, "fwd_parked_resent", 0),
+            "$SYS/broker/cluster/fwd_barrier_degraded":
+                getattr(mgr, "fwd_barrier_degraded", 0),
+            "$SYS/broker/cluster/partition_drops":
+                (getattr(mgr, "partition_drops_in", 0)
+                 + getattr(mgr, "partition_drops_out", 0)),
         }
         # ADR 017: per-peer health — link state, staleness, queue
         # pressure, replication lag and the clock-skew estimate, the
@@ -2136,6 +2223,11 @@ class Broker:
                     sess.sync_faults,
                 "$SYS/broker/cluster/sessions/share_groups":
                     sess.share_groups,
+                # ADR 018: dead-owner lifecycle
+                "$SYS/broker/cluster/sessions/replica_expiries":
+                    sess.replica_expiries,
+                "$SYS/broker/cluster/sessions/wills_fired":
+                    sess.wills_fired,
             })
         return entries
 
@@ -2190,6 +2282,11 @@ class Broker:
                 # rewrite a byte-identical record (ADR 014)
                 client.inflight.note_stored(packet.packet_id)
                 self.info.inflight += 1
+                if getattr(rec, "held", False):
+                    # ADR 018: quota-parked at crash time — re-park, so
+                    # the resumed session's _release_held (not resend)
+                    # sends it within the client's receive maximum
+                    client.held_pids.append(packet.packet_id)
         stored_info = self.hooks.first_non_empty("stored_sys_info")
         if stored_info is not None:
             for k in ("bytes_received", "bytes_sent", "messages_received",
@@ -2208,6 +2305,15 @@ class Broker:
             client.properties.session_expiry = rec.session_expiry
             client.properties.session_expiry_set = rec.session_expiry_set
             client.disconnected_at = rec.disconnected_at or time.time()
+            # a restored session is a DISCONNECTED session: without
+            # this, `closed` stays False (stop() never ran on the fresh
+            # object), deliveries take the live-send path and are
+            # refused+rolled back as slow-consumer drops instead of
+            # queueing in inflight for the resume — every message
+            # published to the session between restart and reconnect
+            # was silently lost (found by the ADR-018 kill-restart
+            # verify drive) — and the expiry sweep never purged it
+            client._stopped.set()
             self.clients.add(client)
         for rec in self.hooks.first_non_empty("stored_subscriptions"):
             sub = Subscription(filter=rec.filter, qos=rec.qos,

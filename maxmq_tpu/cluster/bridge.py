@@ -27,15 +27,26 @@ ledger:
 * **Liveness** — an idle link pings every ``keepalive`` seconds; a
   failed ping tears the link down into the reconnect loop and marks
   the peer down in the membership ledger.
+* **Partition tolerance (ADR 018)** — the directed
+  ``cluster.partition`` fault site fires at every boundary this link's
+  bytes cross (connect, ping, per-item writer), so a chaos harness can
+  blackhole or delay one direction deterministically; and QoS1
+  forwards that a partition strands (refused by a down link, or
+  unacked when the link dies) PARK in a bounded, journal-backed buffer
+  and re-send on link-up — the receiver's per-(origin, epoch) msgid
+  dedup makes the retry at-most-once-delivered, so a PUBACKed publish
+  survives the partition instead of vanishing with the link.
 """
 
 from __future__ import annotations
 
 import asyncio
+import json
+from collections import deque
 
 from .. import faults
 from ..broker.client import OutboundQueue
-from ..mqtt_client import MQTTClient
+from ..mqtt_client import MQTTClient, MQTTError
 from ..protocol.codec import FixedHeader, PacketType as PT
 from ..protocol.packets import Packet
 
@@ -45,6 +56,15 @@ BRIDGE_ID_PREFIX = "$maxmq-cluster/"
 # bounds entry-count bookkeeping the same way broker queues are capped)
 LINK_QUEUE_MAX = 8192
 BURST_BYTES = 65536
+
+# parked-forward bound (ADR 018): QoS1 forwards stranded by a down or
+# partitioned link awaiting retry-after-heal; oldest dropped (counted)
+# past the cap — the bounded-staleness contract, never unbounded memory
+PARKED_MAX = 2048
+
+# journal bucket for parked forwards (survives the PARKING node's own
+# crash; restored by ClusterManager.start)
+FWD_BUCKET = "cluster_fwd"
 
 
 class BridgeLink:
@@ -86,6 +106,15 @@ class BridgeLink:
         self.forward_ack_failures = 0
         self.control_sent = 0
         self.session_sent = 0       # ADR-016 session-federation messages
+        # ADR 018: parked QoS1 forwards awaiting retry-after-heal
+        # [(envelope topic, payload, journal key), ...]; the key set
+        # mirrors it for O(1) already-parked checks
+        self.parked: deque[tuple[str, bytes, str]] = deque()
+        self._parked_keys: set[str] = set()
+        self.forwards_parked = 0
+        self.parked_dropped = 0     # oldest shed past PARKED_MAX
+        self.parked_resent = 0
+        self.partition_drops = 0    # writer items the fault blackholed
         self._task: asyncio.Task | None = None
         self._closed = False
 
@@ -137,7 +166,26 @@ class BridgeLink:
         if hit is not None and hit[0] == "hang":
             await asyncio.sleep(hit[1])
 
+    async def _fire_partition(self, liveness: bool) -> None:
+        """The ADR-018 directed-partition site on this link's outbound
+        direction. ``liveness`` sites (connect, ping) RAISE under drop
+        — a blackholed path fails its handshake/keepalive, so the link
+        is detected down and enters reconnect backoff until healed;
+        data sites handle drop themselves. ``hang`` delays either."""
+        hit = faults.fire_detail(
+            faults.CLUSTER_PARTITION,
+            key=faults.partition_key(self.node_id, self.peer))
+        if hit is None:
+            return
+        mode, delay = hit
+        if mode == "hang":
+            await asyncio.sleep(delay)
+        elif liveness:
+            raise ConnectionError(
+                f"partitioned: {self.node_id}->{self.peer}")
+
     async def _connect_once(self) -> None:
+        await self._fire_partition(liveness=True)
         client = MQTTClient(
             client_id=BRIDGE_ID_PREFIX + self.node_id,
             keepalive=max(int(self.keepalive * 3), 1))
@@ -155,6 +203,15 @@ class BridgeLink:
         client, self.client = self.client, None
         if client is not None:
             await client.close()
+            # ack futures registered AFTER the client's read loop died
+            # (the peer was SIGKILLed mid-burst) were missed by its own
+            # shutdown sweep — fail them here or their forwards never
+            # reclassify as stranded and a PUBACKed publish is lost
+            # (ADR 018; found by the kill-restart verify drive)
+            for fut in client._acks.values():
+                if not fut.done():
+                    fut.set_exception(MQTTError("bridge link down"))
+            client._acks.clear()
         self.manager.membership.note_down(self.peer, reason)
         if was_up:
             self.manager.on_link_down(self, reason)
@@ -197,8 +254,11 @@ class BridgeLink:
             burst = 0
             while True:
                 await self._fire_link_fault()
-                client.writer.write(item)
-                burst += len(item)
+                if await self._partition_drops_item():
+                    self.partition_drops += 1
+                else:
+                    client.writer.write(item)
+                    burst += len(item)
                 if burst >= BURST_BYTES:
                     break
                 try:
@@ -208,10 +268,26 @@ class BridgeLink:
             await client.writer.drain()
             self.manager.membership.note_alive(self.peer)
 
+    async def _partition_drops_item(self) -> bool:
+        """ADR 018: one writer item crossing the partitioned direction
+        — drop blackholes it in flight (already de-accounted, exactly
+        like bytes lost inside a dead TCP window), hang delays it."""
+        hit = faults.fire_detail(
+            faults.CLUSTER_PARTITION,
+            key=faults.partition_key(self.node_id, self.peer))
+        if hit is None:
+            return False
+        mode, delay = hit
+        if mode == "hang":
+            await asyncio.sleep(delay)
+            return False
+        return True
+
     async def _keepalive_loop(self, client: MQTTClient) -> None:
         while True:
             await asyncio.sleep(self.keepalive)
             await self._fire_link_fault()
+            await self._fire_partition(liveness=True)
             await client.ping(timeout=self.connect_timeout)
             self.manager.membership.note_alive(self.peer)
             # ADR 017: the proved-alive link refreshes its clock-skew
@@ -229,35 +305,89 @@ class BridgeLink:
                       protocol_version=4, topic=topic, payload=payload,
                       packet_id=packet_id).encode()
 
-    def forward(self, topic: str, payload: bytes, qos: int = 0) -> bool:
+    def forward(self, topic: str, payload: bytes, qos: int = 0,
+                collect: list | None = None, park: bool = False,
+                _parked_key: str | None = None) -> bool:
         """Enqueue one forwarded publish; False = refused (link down,
         byte budget, or queue full). A refused QoS1 forward rolls its
         provisional ack entry back — the ADR-012 no-leak invariant
         applied to the bridge. Ledger charges are the EXACT encoded
-        wire bytes (ADR 012's pre-encoded-wire discipline)."""
+        wire bytes (ADR 012's pre-encoded-wire discipline).
+
+        ADR 018: ``collect`` (a list) receives the QoS1 PUBACK future —
+        the fwd-durability barrier waits on it. ``park=True`` makes a
+        refused or never-acked QoS1 forward PARK for retry-after-heal
+        instead of being lost (the envelope's origin msgid makes the
+        receiver dedup the retry)."""
         client = self.client
-        if not self.connected or client is None:
+        if (not self.connected or client is None
+                or client._closed.is_set()):
+            # _closed: the client's read loop is already dead (peer
+            # killed) even though the supervisor hasn't torn the link
+            # down yet — an ack registered now could never resolve
+            if park and qos > 0:
+                self._park(topic, payload, _parked_key)
             return False
         pid = 0
+        cb = None
         if qos > 0:
             pid = client._alloc_id()
             fut = client._await_ack(PT.PUBACK, pid)
-            fut.add_done_callback(self._on_forward_ack)
+            cb = self._fwd_ack_cb(topic, payload, park, _parked_key)
+            fut.add_done_callback(cb)
+            if collect is not None:
+                collect.append(fut)
         wire = self._encode_publish(topic, payload, qos, False, pid)
-        if (self.byte_budget
-                and self.outbound.bytes + len(wire) > self.byte_budget):
-            self._refuse_forward(client, pid, qos)
-            return False
-        try:
-            self.outbound.put_nowait(wire, len(wire))
-        except asyncio.QueueFull:
-            self._refuse_forward(client, pid, qos)
+        if ((self.byte_budget
+                and self.outbound.bytes + len(wire) > self.byte_budget)
+                or not self._try_put(wire)):
+            self._handle_refusal(client, pid, qos, cb, collect, park,
+                                 topic, payload, _parked_key)
             return False
         self.forwards_sent += 1
         return True
 
-    def _refuse_forward(self, client: MQTTClient, pid: int,
-                        qos: int) -> None:
+    def _handle_refusal(self, client: MQTTClient, pid: int, qos: int,
+                        cb, collect: list | None, park: bool,
+                        topic: str, payload: bytes,
+                        parked_key: str | None) -> None:
+        """One refused enqueue: count + roll the ack entry back, drop
+        the cancelled future from the barrier's collect list (the
+        caller counts this refusal's degrade exactly once off the
+        False return), and park the copy for retry when asked."""
+        self._refuse_forward(client, pid, qos, cb)
+        if qos > 0:
+            if collect is not None:
+                collect.pop()
+            if park:
+                self._park(topic, payload, parked_key)
+
+    def _try_put(self, wire: bytes) -> bool:
+        try:
+            self.outbound.put_nowait(wire, len(wire))
+        except asyncio.QueueFull:
+            return False
+        return True
+
+    def _fwd_ack_cb(self, topic: str, payload: bytes, park: bool,
+                    parked_key: str | None):
+        """The QoS1 forward's ack outcome: success settles (and clears
+        a parked-retry journal row); a dead link's failed ack re-parks
+        the forward when fwd durability is on (ADR 018) — the retry
+        fires on the next link-up."""
+        def cb(fut: asyncio.Future) -> None:
+            if fut.cancelled() or fut.exception() is not None:
+                self.forward_ack_failures += 1
+                if park:
+                    self._park(topic, payload, parked_key)
+            else:
+                self.forwards_acked += 1
+                if parked_key is not None:
+                    self._journal_delete(parked_key)
+        return cb
+
+    def _refuse_forward(self, client: MQTTClient, pid: int, qos: int,
+                        cb=None) -> None:
         """One refused forward: count it, roll back a QoS1 ack entry,
         and attribute it to the bridge stage on the ADR-015 error
         counter so the loss shows up next to the bridge latency."""
@@ -266,24 +396,75 @@ class BridgeLink:
         if tracer is not None:
             tracer.note_error("bridge", "refused")
         if qos > 0:
-            self._rollback_refused_ack(client, pid)
+            self._rollback_refused_ack(client, pid, cb)
 
-    def _rollback_refused_ack(self, client: MQTTClient,
-                              pid: int) -> None:
+    def _rollback_refused_ack(self, client: MQTTClient, pid: int,
+                              cb=None) -> None:
         """Withdraw the ack entry a refused QoS1 forward registered:
         the publish never hit the wire, so nothing may sit waiting for
         a PUBACK that cannot come (mirrors the broker's
-        ``_rollback_refused_qos``)."""
+        ``_rollback_refused_qos``). The park-on-failure callback is
+        removed FIRST — the refusal path parks explicitly, and the
+        cancel must not park a second copy."""
         fut = client._acks.pop((PT.PUBACK, pid), None)
         if fut is not None and not fut.done():
-            fut.remove_done_callback(self._on_forward_ack)
+            if cb is not None:
+                fut.remove_done_callback(cb)
             fut.cancel()
 
-    def _on_forward_ack(self, fut: asyncio.Future) -> None:
-        if fut.cancelled() or fut.exception() is not None:
-            self.forward_ack_failures += 1
-        else:
-            self.forwards_acked += 1
+    # -- parked forwards (ADR 018) -------------------------------------
+
+    def _park(self, topic: str, payload: bytes,
+              key: str | None = None) -> None:
+        """Park one stranded QoS1 forward for retry-after-heal: bounded
+        (oldest dropped + counted past PARKED_MAX) and journaled (the
+        ``cluster_fwd`` bucket — a crash of THIS node mid-partition
+        still redelivers after restart; ADR-014 write-behind rules
+        apply)."""
+        if key is None:
+            # `$cluster/fwd/<origin>/<epoch>/<msgid>/...`: the identity
+            # the receiver dedups on — one journal row per message
+            levels = topic.split("/", 5)
+            ident = ":".join(levels[2:5]) if len(levels) > 5 else topic
+            key = f"{self.peer}|{ident}"
+        if key in self._parked_keys:
+            return      # already parked (refused enqueue + failed ack)
+        while len(self.parked) >= PARKED_MAX:
+            _t, _p, old_key = self.parked.popleft()
+            self._parked_keys.discard(old_key)
+            self.parked_dropped += 1
+            self._journal_delete(old_key)
+        self.parked.append((topic, payload, key))
+        self._parked_keys.add(key)
+        self.forwards_parked += 1
+        store = self._fwd_store()
+        if store is not None:
+            store.put(FWD_BUCKET, key,
+                      json.dumps({"t": topic, "p": payload.hex()}))
+
+    def drain_parked(self) -> int:
+        """Re-send every parked forward on a fresh link (called from
+        ClusterManager.on_link_up). Failures re-park with the same
+        journal key; the receiver's per-(origin, epoch) msgid dedup
+        drops any copy that did land before the partition."""
+        items, self.parked = self.parked, deque()
+        self._parked_keys.clear()
+        n = 0
+        for topic, payload, key in items:
+            if self.forward(topic, payload, qos=1, park=True,
+                            _parked_key=key):
+                n += 1
+        self.parked_resent += n
+        return n
+
+    def _fwd_store(self):
+        hook = getattr(self.manager.broker, "_storage_hook", None)
+        return None if hook is None else hook.store
+
+    def _journal_delete(self, key: str) -> None:
+        store = self._fwd_store()
+        if store is not None:
+            store.delete(FWD_BUCKET, key)
 
     def send_session(self, topic: str, payload: bytes,
                      on_ack=None) -> bool:

@@ -36,6 +36,7 @@ namespace; ordinary clients cannot publish ``$`` topics):
 
 from __future__ import annotations
 
+import json
 import time
 from collections import deque
 
@@ -88,6 +89,9 @@ class ClusterManager:
                  session_sync: str = "batched",
                  session_sync_timeout_ms: int = 750,
                  session_takeover_timeout_ms: int = 750,
+                 fwd_durability: str = "coupled",
+                 replica_expiry_s: float = 3600.0,
+                 share_balance: str = "weighted",
                  trace_propagation: bool = True,
                  trace_return: bool = True,
                  telemetry_interval_s: float = 5.0,
@@ -96,14 +100,27 @@ class ClusterManager:
             raise ValueError(f"bad cluster node id {node_id!r}")
         if any(p.node_id == node_id for p in peers):
             raise ValueError("cluster_peers lists this node itself")
+        if fwd_durability not in ("coupled", "always", "off"):
+            raise ValueError(f"unknown cluster_fwd_durability "
+                             f"{fwd_durability!r} (want coupled/always/off)")
+        if share_balance not in ("weighted", "pin"):
+            raise ValueError(f"unknown cluster_share_balance "
+                             f"{share_balance!r} (want weighted/pin)")
         self.broker = broker
         self.node_id = node_id
         self.link_qos = min(max(link_qos, 0), 1)
         self.max_hops = max_hops
         self.log = logger
+        # ADR 018: cross-node publish durability policy — when active,
+        # QoS>0 forwards ride QoS1 on the link, strand-park for
+        # retry-after-heal, and (when coupled) the publisher's ack
+        # waits on the peers' forward PUBACKs
+        self.fwd_durability = fwd_durability
+        self.fwd_timeout = max(session_sync_timeout_ms, 1) / 1000.0
         self.routes = RouteTable(
             node_id, epoch if epoch is not None
             else int(time.time() * 1000))
+        self.routes.shares.balance = share_balance
         self._epoch_pinned = epoch is not None
         self.membership = Membership(peers)
         self._link_kw = dict(node_id=node_id, qos=self.link_qos,
@@ -128,7 +145,8 @@ class ClusterManager:
             self.sessions = SessionFederation(
                 self, sync=session_sync,
                 sync_timeout_ms=session_sync_timeout_ms,
-                takeover_timeout_ms=session_takeover_timeout_ms)
+                takeover_timeout_ms=session_takeover_timeout_ms,
+                replica_expiry_s=replica_expiry_s)
             broker.add_hook(self.sessions)
         # cluster observability plane (ADR 017): telemetry gossip,
         # clock-skew probes, and the trace span-return leg. Always
@@ -151,6 +169,16 @@ class ClusterManager:
         self.route_apply_failures = 0
         self.syncs_sent = 0
         self.inbound_rejected = 0       # malformed/spoofed $cluster wire
+        # ADR 018: fwd-durability barrier + partition-harness health
+        self.fwd_barrier_waits = 0      # publisher acks that waited on
+                                        # a cross-node forward PUBACK
+        self.fwd_barrier_timeouts = 0   # barriers released by timeout
+        self.fwd_barrier_degraded = 0   # barriers released without
+                                        # full peer forward durability
+        self.fwd_restore_errors = 0     # parked-forward journal rows
+                                        # that failed to parse at boot
+        self.partition_drops_in = 0     # inbound $cluster messages the
+                                        # partition site dropped in flight
 
     # ------------------------------------------------------------------
     # Lifecycle (driven by Broker.serve / Broker.close)
@@ -179,9 +207,39 @@ class ClusterManager:
             # after the epoch adoption above and the broker's own
             # restore: the ledger rebuild must see the final boot epoch
             self.sessions.start()
+        self._restore_parked_forwards()
         self.telemetry.start()
         for link in self.links.values():
             link.start()
+
+    def _restore_parked_forwards(self) -> None:
+        """ADR 018: reload journal-parked forwards (QoS1 forwards a
+        partition stranded before this node crashed/restarted) into
+        their links' park buffers — drained at each link-up, deduped
+        by the receiver's per-(origin, epoch) msgid window."""
+        if not self.fwd_park_active:
+            return
+        hook = getattr(self.broker, "_storage_hook", None)
+        if hook is None:
+            return
+        from .bridge import FWD_BUCKET, PARKED_MAX
+        for key, raw in hook.store.all(FWD_BUCKET).items():
+            peer, _, _ident = key.partition("|")
+            link = self.links.get(peer)
+            if link is None:
+                hook.store.delete(FWD_BUCKET, key)  # peer left the
+                continue                            # seed list
+            try:
+                d = json.loads(raw)
+                topic, payload = str(d["t"]), bytes.fromhex(d["p"])
+            except Exception:
+                self.fwd_restore_errors += 1
+                hook.store.delete(FWD_BUCKET, key)
+                continue
+            if len(link.parked) < PARKED_MAX \
+                    and key not in link._parked_keys:
+                link.parked.append((topic, payload, key))
+                link._parked_keys.add(key)
 
     async def close(self) -> None:
         self._started = False
@@ -209,6 +267,11 @@ class ClusterManager:
         cid = getattr(client, "id", "")
         return (cid.startswith(BRIDGE_ID_PREFIX)
                 and cid[len(BRIDGE_ID_PREFIX):] in self.membership.peers)
+
+    @staticmethod
+    def bridge_peer(client) -> str:
+        """The peer node id behind a recognized bridge client."""
+        return client.id[len(BRIDGE_ID_PREFIX):]
 
     # ------------------------------------------------------------------
     # Local subscription tracking (called by broker/server.py)
@@ -314,6 +377,10 @@ class ClusterManager:
         self._send_snapshot(link)
         if self.sessions is not None:
             self.sessions.on_link_up(link)
+        if self.fwd_park_active:
+            # ADR 018: retry the forwards the partition stranded —
+            # before new traffic piles in behind them
+            link.drain_parked()
         self.telemetry.on_link_up(link)
 
     def on_link_alive(self, link: BridgeLink) -> None:
@@ -326,7 +393,6 @@ class ClusterManager:
         An old peer counts the unknown kind as inbound_rejected and
         carries on; a peer that never heard OUR hello sends us plain
         pre-017 envelopes, which we parse fine."""
-        import json
         from .telemetry import WIRE_CAPS
         link.send_control(f"$cluster/hello/{self.node_id}",
                           json.dumps({"v": 1,
@@ -346,25 +412,35 @@ class ClusterManager:
     # Forwarding decision (called from the broker fan-out, sync)
     # ------------------------------------------------------------------
 
+    @property
+    def fwd_park_active(self) -> bool:
+        """ADR 018: QoS>0 forwards ride QoS1 on the link and park for
+        retry-after-heal when stranded (any ``cluster_fwd_durability``
+        but ``off``)."""
+        return self.fwd_durability != "off"
+
+    @property
+    def fwd_coupled(self) -> bool:
+        """ADR 018: the publisher's QoS ack additionally waits (bounded)
+        on the peers' forward PUBACKs — ``always``, or ``coupled`` when
+        ``cluster_session_sync=always`` already couples acks to peers."""
+        if self.fwd_durability == "always":
+            return True
+        return (self.fwd_durability == "coupled"
+                and self.sessions is not None
+                and self.sessions.sync == "always")
+
     def maybe_forward(self, packet: Packet) -> None:
         """Forward one locally fanned-out publish to every peer whose
         advertised routes match (retained messages flood so any future
         remote subscriber finds them), once per peer, guarded by the
-        origin/hop rails."""
+        origin/hop rails. Under ADR-018 fwd durability QoS>0 publishes
+        ride QoS1 on the link (parked when stranded) and their PUBACK
+        futures are collected on the packet for the ack barrier."""
         topic = packet.topic
         if topic.startswith("$"):
             return
-        origin = getattr(packet, "_cluster_origin", None)
-        via = getattr(packet, "_cluster_via", None)
-        hops = getattr(packet, "_cluster_hops", 0)
-        if origin is None:
-            origin = self.node_id
-            epoch = self.routes.epoch
-            self._next_msg_id += 1
-            msgid = self._next_msg_id
-        else:
-            epoch = packet._cluster_epoch
-            msgid = packet._cluster_msgid
+        origin, epoch, msgid, via, hops = self._fwd_identity(packet)
         if packet.fixed.retain:
             targets = set(self.links)       # flood retained state
         else:
@@ -376,22 +452,106 @@ class ClusterManager:
         if hops >= self.max_hops:
             self.hops_dropped += 1
             return
-        flags = f"{min(packet.fixed.qos, self.link_qos)}" + \
-            ("r" if packet.fixed.retain else "")
+        park = self.fwd_park_active and packet.fixed.qos > 0
+        qos = 1 if park else min(packet.fixed.qos, self.link_qos)
+        collect = [] if park and self.fwd_coupled else None
+        flags = f"{qos}" + ("r" if packet.fixed.retain else "")
         base = f"$cluster/fwd/{origin}/{epoch}/{msgid}/{hops + 1}/"
         envelope = base + flags + "/" + topic
         traced_env = self._traced_envelope(packet, base, flags, topic)
         for node in targets:
-            link = self.links.get(node)
-            if link is None or not link.connected:
-                self.forwards_skipped_down += 1
-                tracer = getattr(self.broker, "tracer", None)
-                if tracer is not None:
-                    tracer.note_error("bridge", "link_down")
-                continue
-            link.forward(self._env_for(node, envelope, traced_env),
-                         packet.payload,
-                         qos=min(packet.fixed.qos, self.link_qos))
+            self._forward_to(node, envelope, traced_env, packet, qos,
+                             collect, park)
+        if collect:
+            packet._fwd_waits = collect
+
+    def _fwd_identity(self, packet: Packet) -> tuple:
+        """(origin, epoch, msgid, via, hops) for one forward — local
+        publishes mint a fresh per-origin msgid, relayed ones carry
+        theirs verbatim."""
+        via = getattr(packet, "_cluster_via", None)
+        hops = getattr(packet, "_cluster_hops", 0)
+        origin = getattr(packet, "_cluster_origin", None)
+        if origin is None:
+            self._next_msg_id += 1
+            return (self.node_id, self.routes.epoch, self._next_msg_id,
+                    via, hops)
+        return (origin, packet._cluster_epoch, packet._cluster_msgid,
+                via, hops)
+
+    def _forward_to(self, node: str, envelope: str,
+                    traced_env: str | None, packet: Packet, qos: int,
+                    collect: list | None, park: bool) -> None:
+        """Enqueue one forward on one peer's link; a down link counts
+        the skip and (under fwd durability) still PARKS the copy for
+        the heal — the publish's durability at that peer is pending,
+        so a coupled barrier counts the degrade."""
+        link = self.links.get(node)
+        if link is not None and link.connected:
+            ok = link.forward(self._env_for(node, envelope, traced_env),
+                              packet.payload, qos=qos, collect=collect,
+                              park=park)
+            if not ok and collect is not None:
+                # parked without an ack future (dead-read-loop window,
+                # budget refusal): this release lacks that peer's
+                # durability — count the degrade the barrier can't see
+                self.fwd_barrier_degraded += 1
+            return
+        self.forwards_skipped_down += 1
+        tracer = getattr(self.broker, "tracer", None)
+        if tracer is not None:
+            tracer.note_error("bridge", "link_down")
+        if park and link is not None:
+            link.forward(envelope, packet.payload, qos=1, park=True)
+            if collect is not None:
+                self.fwd_barrier_degraded += 1
+
+    def fwd_barrier(self, loop, packet: Packet):
+        """The ADR-018 cross-node durability barrier for one publish:
+        a future resolved once every collected forward PUBACK has
+        landed, or after ``fwd_timeout`` (degraded + counted — a
+        partitioned peer costs latency once, never a wedged publisher).
+        ``None`` when the publish forwarded nowhere or everything is
+        already acked."""
+        waits = packet.__dict__.pop("_fwd_waits", None)
+        if not waits:
+            return None
+        pending = self._fwd_pending(waits)
+        if not pending:
+            return None
+        self.fwd_barrier_waits += 1
+        fut = loop.create_future()
+        state = {"n": len(pending)}
+
+        def _one(f) -> None:
+            if f.cancelled() or f.exception() is not None:
+                self.fwd_barrier_degraded += 1
+            state["n"] -= 1
+            if state["n"] == 0 and not fut.done():
+                fut.set_result(None)
+
+        def _timeout() -> None:
+            if not fut.done():
+                self.fwd_barrier_timeouts += 1
+                self.fwd_barrier_degraded += 1
+                fut.set_result(None)
+
+        for f in pending:
+            f.add_done_callback(_one)
+        loop.call_later(self.fwd_timeout, _timeout)
+        return fut
+
+    def _fwd_pending(self, waits: list) -> list:
+        """Split one publish's forward-ack futures: already-failed ones
+        (refused at enqueue -> parked for retry-after-heal) count a
+        degrade NOW — that release lacks peer durability even if
+        nothing is left to wait on — and the still-pending rest come
+        back for the barrier."""
+        failed = sum(1 for f in waits if f.done()
+                     and (f.cancelled() or f.exception() is not None))
+        if failed:
+            self.fwd_barrier_degraded += failed
+        return [f for f in waits if not f.done()]
 
     def _env_for(self, node: str, envelope: str,
                  traced_env: str | None) -> str:
@@ -470,7 +630,6 @@ class ClusterManager:
         """ADR-017 capability announcement: record what wire the peer
         can parse (pre-017 peers never send one and get pre-017
         envelopes forever)."""
-        import json
         if levels[2] != sender:
             self.inbound_rejected += 1      # spoofed identity
             return
@@ -488,7 +647,14 @@ class ClusterManager:
         try:
             origin, epoch = levels[2], int(levels[3])
             msgid, hops, flags = int(levels[4]), int(levels[5]), levels[6]
-            qos = min(int(flags[0]), self.link_qos)
+            # ADR 018: with fwd durability on, the sender upgrades QoS>0
+            # forwards to a QoS1 link leg — honor that here even when
+            # link_qos is 0, or the local fan-out silently downgrades
+            # the durable copy; still capped at 1 (a peer can never
+            # smuggle QoS2 wire through the bridge)
+            qos_cap = max(self.link_qos, 1) if self.fwd_park_active \
+                else self.link_qos
+            qos = min(int(flags[0]), qos_cap)
             retain = "r" in flags
         except (ValueError, IndexError):
             self.inbound_rejected += 1
@@ -687,6 +853,26 @@ class ClusterManager:
     @property
     def forwards_refused(self) -> int:
         return sum(lk.forwards_refused for lk in self.links.values())
+
+    @property
+    def forwards_parked(self) -> int:
+        return sum(lk.forwards_parked for lk in self.links.values())
+
+    @property
+    def fwd_parked_now(self) -> int:
+        return sum(len(lk.parked) for lk in self.links.values())
+
+    @property
+    def fwd_parked_dropped(self) -> int:
+        return sum(lk.parked_dropped for lk in self.links.values())
+
+    @property
+    def fwd_parked_resent(self) -> int:
+        return sum(lk.parked_resent for lk in self.links.values())
+
+    @property
+    def partition_drops_out(self) -> int:
+        return sum(lk.partition_drops for lk in self.links.values())
 
     @property
     def link_flaps(self) -> int:

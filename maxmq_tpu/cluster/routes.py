@@ -174,23 +174,40 @@ class IncrementalCover:
 
 
 class ShareLedger:
-    """Cluster-wide ``$share`` group-membership ledger (ADR 016).
+    """Cluster-wide ``$share`` group-membership ledger (ADR 016/018).
 
     Maps ``(group, filter)`` to live-member counts per *member id* —
     node ids for the federation, worker ids for the in-process delivery
     pool (broker/workers.py routes its gossip through this same class,
     so a filter shared across both a pool and a peer node resolves
     ownership through one set of rules). Ownership is deterministic
-    with no coordination round: the lowest member id with a live count
-    owns the pick for every publish (the ADR-005 fairness trade,
-    documented there and in ADR 016). A key nobody (else) claims is
-    owned locally — at worst a short double-delivery window while
-    gossip converges, never a dropped message."""
+    with no coordination round; two balance modes (ADR 018):
 
-    __slots__ = ("self_id", "_members")
+    * ``pin`` — the lowest member id with a live count owns the pick
+      for every publish (the ADR-005 fairness trade; the in-process
+      worker pool keeps this mode).
+    * ``weighted`` — the owner rotates per publish, weighted by each
+      member's live-subscriber count: every node derives the same
+      owner from the same ``token`` (a content hash of the publish)
+      and the same converged ledger, so the exactly-once invariant
+      holds while a node with 3 live group members receives ~3x the
+      picks of a node with 1. A ``token=None`` caller (or a
+      single-member key) falls back to ``pin``.
 
-    def __init__(self, self_id) -> None:
+    A key nobody (else) claims is owned locally. Divergence window
+    (both modes, ADR 016/018): while gossip is in flight two nodes can
+    disagree on the ledger and a publish can double- or zero-deliver
+    for that round — ``pin`` diverges only on membership-set changes,
+    ``weighted`` also on member-count changes (and on mixed-version
+    clusters: run ``pin`` until every node speaks ADR 018 — see
+    migration.md). The window is one gossip round, bounded by the
+    session-replication debounce."""
+
+    __slots__ = ("self_id", "_members", "balance")
+
+    def __init__(self, self_id, balance: str = "pin") -> None:
         self.self_id = self_id
+        self.balance = balance
         # (group, filter) -> member id -> live local-subscription count
         self._members: dict[tuple[str, str], dict] = {}
 
@@ -224,11 +241,32 @@ class ShareLedger:
         per = self._members.get(key)
         return sorted(m for m, n in (per or {}).items() if n > 0)
 
-    def owns(self, key: tuple[str, str]) -> bool:
-        members = self.members_for(key)
+    def owner_for(self, key: tuple[str, str], token: int | None = None):
+        """The member that owns this publish's pick, or None when the
+        key has no live members. Deterministic on every node from the
+        (converged) ledger: ``weighted`` walks the sorted members with
+        their live counts as weights, indexed by ``token``; anything
+        else — or no token — pins to the lowest member id."""
+        per = self._members.get(key)
+        members = sorted(m for m, n in (per or {}).items() if n > 0)
         if not members:
-            return True     # nobody claims it: local delivery is safe
-        return members[0] == self.self_id
+            return None
+        if (token is None or self.balance != "weighted"
+                or len(members) == 1):
+            return members[0]
+        weights = [per[m] for m in members]
+        slot = token % sum(weights)
+        for m, w in zip(members, weights):
+            slot -= w
+            if slot < 0:
+                return m
+        return members[-1]      # unreachable (slot < sum of weights)
+
+    def owns(self, key: tuple[str, str],
+             token: int | None = None) -> bool:
+        owner = self.owner_for(key, token)
+        # nobody claims it: local delivery is safe
+        return owner is None or owner == self.self_id
 
     @property
     def group_count(self) -> int:

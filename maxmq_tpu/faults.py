@@ -66,6 +66,9 @@ POOL_WORKER = "pool.worker"            # delivery-pool worker process
 CLIENT_WRITE = "client.write"          # broker client writer loop (ADR 012)
 LISTENER_ACCEPT = "listener.accept"    # broker connection accept (ADR 012)
 CLUSTER_LINK = "cluster.link"          # bridge link connect/pump (ADR 013)
+CLUSTER_PARTITION = "cluster.partition"  # directed inter-node network
+                                       # partition (ADR 018; keyed per
+                                       # link direction "src->dst")
 CLUSTER_ROUTE_APPLY = "cluster.route_apply"  # route snapshot/delta apply
 CLUSTER_SESSION_SYNC = "cluster.session_sync"  # session replication send/
                                        # apply (ADR 016; keyed per peer)
@@ -203,6 +206,60 @@ class FaultRegistry:
 
 
 REGISTRY = FaultRegistry()
+
+
+# ----------------------------------------------------------------------
+# Network partitions (ADR 018): the ``cluster.partition`` site family
+# ----------------------------------------------------------------------
+#
+# The site is keyed per DIRECTED link: ``cluster.partition#A->B``
+# affects traffic traveling from node A to node B only. The production
+# code fires it at every place bytes cross a node boundary — bridge
+# connect, bridge keepalive ping, the bridge writer loop (per wire
+# item), and the receiving broker's ``$cluster/*`` inbound dispatch —
+# so an armed direction behaves like a blackholed network path: sends
+# vanish in flight, pings fail (the link is detected down and enters
+# reconnect backoff), reconnects fail until healed. Modes:
+#
+# * ``drop`` — bytes in the armed direction silently vanish; QoS1
+#   bridge traffic times out unacked and (ADR 018) parks for
+#   retry-after-heal.
+# * ``hang`` — bytes are delayed by ``delay_s`` (latency injection);
+#   everything still arrives.
+#
+# ``partition(a, b)`` arms BOTH directions (a full split);
+# ``partition(a, b, mode="asym")`` arms only a->b (asymmetric loss:
+# a's traffic to b vanishes while b still reaches a). ``heal(a, b)``
+# disarms both directions. Arms are count=-1 (until healed).
+
+
+def partition_key(src: str, dst: str) -> str:
+    return f"{src}->{dst}"
+
+
+def partition(a: str, b: str, mode: str = "drop",
+              delay_s: float = 0.05) -> None:
+    """Arm a network partition between nodes ``a`` and ``b`` (ADR 018).
+
+    ``mode="drop"``/``"hang"`` arm both directions; ``mode="asym"``
+    arms a->b only (drop). Stays armed until :func:`heal`."""
+    if mode == "asym":
+        dirs, armed_mode = [(a, b)], "drop"
+    elif mode in ("drop", "hang"):
+        dirs, armed_mode = [(a, b), (b, a)], mode
+    else:
+        raise ValueError(f"unknown partition mode {mode!r} "
+                         "(want drop/hang/asym)")
+    for src, dst in dirs:
+        REGISTRY.arm(f"{CLUSTER_PARTITION}#{partition_key(src, dst)}",
+                     armed_mode, -1, delay_s)
+
+
+def heal(a: str, b: str) -> None:
+    """Disarm a partition between ``a`` and ``b`` (both directions)."""
+    for src, dst in ((a, b), (b, a)):
+        REGISTRY.disarm(f"{CLUSTER_PARTITION}#{partition_key(src, dst)}")
+
 
 # module-level conveniences bound to the process registry
 arm = REGISTRY.arm

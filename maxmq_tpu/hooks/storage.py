@@ -101,6 +101,10 @@ class MessageRecord:
     created: float = 0.0
     expiry: int | None = None
     properties_json: str = "{}"
+    # ADR 018: inflight record parked in held_pids (allocated into the
+    # window but never sent — send quota was exhausted); restore/
+    # takeover re-parks it instead of resending past receive maximum
+    held: bool = False
 
     @classmethod
     def from_packet(cls, packet: Packet, client_id: str = "") -> "MessageRecord":
@@ -332,8 +336,14 @@ class StorageHook(Hook):
             # so the rewrite buys nothing — skip it (ADR 014)
             self.rewrites_skipped += 1
             return
+        rec = MessageRecord.from_packet(packet, client.id)
+        if packet.packet_id in getattr(client, "held_pids", ()):
+            # ADR 018: quota-parked — persist the held-ness so restore
+            # re-parks instead of resending past receive maximum (the
+            # release rewrites the record with held cleared)
+            rec.held = True
         self.store.put("inflight", f"{client.id}|{packet.packet_id}",
-                       MessageRecord.from_packet(packet, client.id).to_json())
+                       rec.to_json())
         if inflight is not None:
             inflight.note_stored(packet.packet_id)
 

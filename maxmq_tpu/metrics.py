@@ -536,9 +536,39 @@ def _register_cluster_metrics(registry: Registry, broker) -> None:
             ("hops_dropped", "Onward forwards dropped by the hop cap"),
             ("link_flaps", "Bridge link up->down transitions"),
             ("connect_attempts",
-             "Bridge connect attempts (incl. backoff retries)")):
+             "Bridge connect attempts (incl. backoff retries)"),
+            ("forwards_parked",
+             "QoS1 forwards parked for retry-after-heal (ADR 018: "
+             "stranded by a down/partitioned link)"),
+            ("fwd_parked_resent",
+             "Parked forwards re-sent on link-up (receiver dedups "
+             "any copy that landed before the partition)"),
+            ("fwd_parked_dropped",
+             "Parked forwards shed past the park bound (the bounded-"
+             "staleness cap; counted loss)"),
+            ("fwd_barrier_waits",
+             "Publisher acks that waited on the ADR-018 cross-node "
+             "forward-durability barrier"),
+            ("fwd_barrier_timeouts",
+             "Forward-durability barriers released by the timeout"),
+            ("fwd_barrier_degraded",
+             "Forward-durability barriers released without full peer "
+             "coverage (timeout/parked/link down)"),
+            ("fwd_restore_errors",
+             "Parked-forward journal rows that failed to parse at "
+             "restore"),
+            ("partition_drops_in",
+             "Inbound $cluster messages the cluster.partition fault "
+             "dropped in flight (ADR 018 chaos harness)"),
+            ("partition_drops_out",
+             "Outbound bridge wire items the cluster.partition fault "
+             "blackholed (ADR 018 chaos harness)")):
         registry.counter_func(f"maxmq_cluster_{name}_total", help_,
                               lambda n=name: getattr(mgr, n))
+    registry.gauge_func(
+        "maxmq_cluster_fwd_parked",
+        "QoS1 forwards currently parked awaiting retry-after-heal "
+        "(ADR 018)", lambda: mgr.fwd_parked_now)
 
     def _peer_series(attr):
         links = sorted(mgr.links.items())[:CLUSTER_PEER_SERIES]
@@ -662,7 +692,13 @@ def _register_session_metrics(registry: Registry, mgr) -> None:
             ("restore_errors", "Ledger journal rows that failed to "
              "parse at restore"),
             ("trace_ops_applied", "Replicated inflight ops applied "
-             "that carried ADR-017 trace identity")):
+             "that carried ADR-017 trace identity"),
+            ("replica_expiries", "Dead-owner replicas purged by the "
+             "replica-side expiry timer (ADR 018)"),
+            ("wills_fired", "Transferred wills fired here for a dead "
+             "owner's sessions (ADR 018)"),
+            ("wills_cleared", "Replica wills cleared by a peer's "
+             "willfire broadcast (the exactly-once stand-down)")):
         registry.counter_func(f"maxmq_cluster_session_{name}_total",
                               help_, lambda n=name: getattr(sess, n))
 

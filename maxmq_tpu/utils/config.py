@@ -97,6 +97,22 @@ class Config:
     cluster_session_sync_timeout_ms: int = 750      # barrier degrade bound
     cluster_session_takeover_timeout_ms: int = 750  # state-pull wait bound
 
+    # -- partition tolerance (ADR 018) ---------------------------------------
+    # cross-node publish durability: coupled = when session_sync is
+    # "always", QoS>0 forwards ride QoS1 links, park for retry-after-
+    # heal when stranded, and the publisher's ack waits (bounded) for
+    # the peers' forward acks; always = the fwd barrier regardless of
+    # session_sync; off = pre-018 fire-and-forget forwards
+    cluster_fwd_durability: str = "coupled"
+    # replica-side expiry fallback for a DEAD owner's sessions that
+    # carry no expiry metadata (seconds; 0 = keep such replicas
+    # forever, the pre-018 behavior)
+    cluster_replica_expiry_s: float = 3600.0
+    # cluster-wide $share ownership: weighted = per-publish rotation
+    # weighted by each node's live member count; pin = lowest node id
+    # owns every pick (the pre-018 / ADR-005 trade)
+    cluster_share_balance: str = "weighted"
+
     # -- cluster observability plane (ADR 017) --------------------------------
     # carry trace context on forwarded publishes to capability-
     # negotiated peers (one correlated trace across the cluster) and

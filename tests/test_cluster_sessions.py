@@ -312,10 +312,12 @@ async def test_clean_start_purges_replicated_state():
 async def test_cluster_wide_share_exactly_once_on_line():
     """A $share group with one member on each node of a 3-node line
     receives every matching publish exactly once CLUSTER-WIDE — the
-    ledger's lowest-live-member-node rule, with membership replicated
-    transitively across the middle node."""
+    ledger's lowest-live-member-node rule (pin mode; the ADR-018
+    weighted rotation has its own suite in test_partition.py), with
+    membership replicated transitively across the middle node."""
     line = {"A": ["B"], "B": ["A", "C"], "C": ["B"]}
-    async with cluster(line, session_sync="batched") as (brokers, mgrs):
+    async with cluster(line, session_sync="batched",
+                       share_balance="pin") as (brokers, mgrs):
         members = {}
         for name in ("A", "B", "C"):
             m = await connect(brokers[name], f"sh-{name}")

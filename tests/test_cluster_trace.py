@@ -193,8 +193,8 @@ async def test_old_peer_gets_pre017_envelope():
         link = mgrs["A"].links["B"]
         sent = []
         orig = link.forward
-        link.forward = lambda topic, payload, qos=0: (
-            sent.append(topic), orig(topic, payload, qos=qos))[1]
+        link.forward = lambda topic, payload, qos=0, **kw: (
+            sent.append(topic), orig(topic, payload, qos=qos, **kw))[1]
         brokers["A"].tracer.sample_n = 1
         pub = await connect(brokers["A"], "pub")
 

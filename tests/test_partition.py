@@ -1,0 +1,783 @@
+"""Network-partition chaos harness (ADR 018): the directed
+``cluster.partition`` fault family driving a multi-node cluster
+through split-brain, heal, asymmetric loss, and flapping — proving
+
+* zero PUBACKed loss across a split-brain + heal under
+  ``cluster_session_sync=always`` (cross-node publisher included:
+  stranded QoS1 forwards park and retry after heal, deduped by the
+  receiver's per-(origin, epoch) msgid window),
+* CONNECT and PUBACK never wedge under any partition mode (every
+  barrier is bounded and degrades counted),
+* exactly one transferred will fired per owner death (elected judge +
+  epoch-fenced willfire stand-down),
+* dead-owner replica expiry (seeded from replicated expiry metadata,
+  returning owner wins),
+* replica convergence after a relay node (middle of a 3-node line)
+  restarts mid-replication-stream,
+* the ADR-018 satellite gaps: held-but-unsent (quota-parked) inflight
+  and the receiver-side QoS2 dedup set surviving takeover.
+"""
+
+import asyncio
+import time
+from contextlib import asynccontextmanager
+
+import pytest
+
+from maxmq_tpu import faults
+from maxmq_tpu.broker import Broker, BrokerOptions, Capabilities, TCPListener
+from maxmq_tpu.cluster import ClusterManager, PeerSpec
+from maxmq_tpu.cluster.bridge import FWD_BUCKET
+from maxmq_tpu.cluster.routes import ShareLedger
+from maxmq_tpu.hooks import AllowHook
+from maxmq_tpu.hooks.storage import MemoryStore, MessageRecord, StorageHook
+from maxmq_tpu.mqtt_client import MQTTClient
+from maxmq_tpu.protocol.codec import FixedHeader, PacketType as PT
+from maxmq_tpu.protocol.packets import Packet, Will
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+async def make_node(store=None, **caps) -> Broker:
+    caps.setdefault("sys_topic_interval", 0)
+    b = Broker(BrokerOptions(capabilities=Capabilities(**caps)))
+    b.add_hook(AllowHook())
+    if store is not None:
+        b.add_hook(StorageHook(store))
+    listener = b.add_listener(TCPListener("t", "127.0.0.1:0"))
+    await b.serve()
+    b.test_port = listener._server.sockets[0].getsockname()[1]
+    return b
+
+
+def make_manager(broker: Broker, name: str, peers: list[PeerSpec],
+                 **kw) -> ClusterManager:
+    kw.setdefault("keepalive", 0.25)
+    kw.setdefault("backoff_initial_s", 0.05)
+    kw.setdefault("backoff_max_s", 0.3)
+    kw.setdefault("session_sync", "always")
+    kw.setdefault("session_sync_timeout_ms", 400)
+    kw.setdefault("session_takeover_timeout_ms", 400)
+    kw.setdefault("replica_expiry_s", 3600.0)
+    mgr = ClusterManager(broker, name, peers, **kw)
+    broker.attach_cluster(mgr)
+    return mgr
+
+
+@asynccontextmanager
+async def cluster(topology: dict[str, list[str]], stores=None, **kw):
+    brokers: dict[str, Broker] = {}
+    managers: dict[str, ClusterManager] = {}
+    node_caps = kw.pop("node_caps", {})     # extra caps, FIRST node only
+    first = next(iter(topology))
+    for name in topology:
+        brokers[name] = await make_node(
+            store=(stores or {}).get(name),
+            **(node_caps if name == first else {}))
+    for name, peers in topology.items():
+        specs = [PeerSpec(p, "127.0.0.1", brokers[p].test_port)
+                 for p in peers]
+        mgr = make_manager(brokers[name], name, specs, **kw)
+        managers[name] = mgr
+        await mgr.start()
+    try:
+        yield brokers, managers
+    finally:
+        for b in brokers.values():
+            await b.close()
+
+
+MESH = {"A": ["B", "C"], "B": ["A", "C"], "C": ["A", "B"]}
+LINE = {"A": ["B"], "B": ["A", "C"], "C": ["B"]}
+
+
+async def wait_for(predicate, timeout: float = 10.0, what: str = ""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        await asyncio.sleep(0.02)
+    raise AssertionError(f"condition not reached in {timeout}s: {what}")
+
+
+async def links_converged(managers, topology):
+    await wait_for(lambda: all(m.links_up == len(topology[n])
+                               for n, m in managers.items()),
+                   what="all links up")
+
+
+async def connect(broker: Broker, client_id: str, **kw) -> MQTTClient:
+    c = MQTTClient(client_id=client_id, **kw)
+    await c.connect("127.0.0.1", broker.test_port)
+    return c
+
+
+async def drain(cli: MQTTClient, timeout: float = 0.6) -> list[bytes]:
+    got = []
+    while True:
+        try:
+            got.append((await cli.next_message(timeout=timeout)).payload)
+        except asyncio.TimeoutError:
+            return got
+
+
+# ----------------------------------------------------------------------
+# Units: partition arming, weighted share rotation
+# ----------------------------------------------------------------------
+
+
+def test_partition_helpers_arm_directed_keys():
+    faults.partition("A", "B")
+    assert faults.armed("cluster.partition#A->B")
+    assert faults.armed("cluster.partition#B->A")
+    faults.heal("A", "B")
+    assert not faults.armed("cluster.partition#A->B")
+    faults.partition("A", "B", mode="asym")
+    assert faults.armed("cluster.partition#A->B")
+    assert not faults.armed("cluster.partition#B->A")
+    faults.heal("A", "B")
+    with pytest.raises(ValueError):
+        faults.partition("A", "B", mode="nope")
+    # armed directions stay armed (count=-1) across many fires
+    faults.partition("A", "B", mode="hang", delay_s=0.0)
+    for _ in range(5):
+        assert faults.fire_detail(faults.CLUSTER_PARTITION,
+                                  key="A->B") == ("hang", 0.0)
+    faults.heal("A", "B")
+
+
+def test_share_ledger_weighted_rotation():
+    """Weighted mode rotates ownership ~proportional to live member
+    counts, deterministically per token, on every node; pin mode and
+    token-less callers keep the lowest-id behavior."""
+    key = ("g", "f")
+    ledgers = {n: ShareLedger(n, balance="weighted") for n in "ABC"}
+    for led in ledgers.values():
+        led.set_member("A", key, 3)
+        led.set_member("B", key, 1)
+    owners = []
+    for token in range(200):
+        picks = {n: led.owner_for(key, token)
+                 for n, led in ledgers.items()}
+        assert len(set(picks.values())) == 1    # all nodes agree
+        owners.append(picks["A"])
+        # exactly one node owns the pick
+        assert sum(led.owns(key, token)
+                   for led in ledgers.values()) == 1
+    assert 120 <= owners.count("A") <= 180      # ~3/4 of the picks
+    assert owners.count("B") >= 20              # B is not starved
+    # pin fallback: no token, or balance=pin
+    assert ledgers["A"].owner_for(key) == "A"
+    pinned = ShareLedger("B", balance="pin")
+    pinned.set_member("A", key, 1)
+    pinned.set_member("B", key, 9)
+    assert all(pinned.owner_for(key, t) == "A" for t in range(10))
+    # empty key: owned locally (never a dropped message)
+    assert ledgers["C"].owns(("g", "nope"), 7)
+
+
+# ----------------------------------------------------------------------
+# Tentpole: split-brain + heal, zero PUBACKed loss, never-wedge
+# ----------------------------------------------------------------------
+
+
+async def test_split_brain_zero_pubacked_loss():
+    """A|BC split-brain under sync=always + fwd durability: every
+    QoS1 publish the cross-node publisher got a PUBACK for reaches the
+    remote subscriber after the heal — stranded forwards park and
+    retry, the dedup window keeps redelivery exactly-once — and no
+    PUBACK ever wedges (bounded degrade, counted)."""
+    async with cluster(MESH) as (brokers, mgrs):
+        await links_converged(mgrs, MESH)
+        sub = await connect(brokers["B"], "pt-sub")
+        await sub.subscribe(("t/#", 1))
+        await wait_for(lambda: mgrs["A"].routes.nodes_for("t/x"),
+                       what="routes at A")
+        pub = await connect(brokers["A"], "pt-pub")
+
+        pubacked = []
+        for i in range(8):          # healthy phase
+            await pub.publish("t/x", f"m-{i}".encode(), qos=1, timeout=5)
+            pubacked.append(f"m-{i}".encode())
+        faults.partition("A", "B")
+        faults.partition("A", "C")      # A | B-C
+        await wait_for(lambda: mgrs["A"].links_up == 0,
+                       what="A isolated")
+        t0 = time.monotonic()
+        for i in range(8, 16):      # publishes INTO the partition
+            await pub.publish("t/x", f"m-{i}".encode(), qos=1, timeout=5)
+            pubacked.append(f"m-{i}".encode())
+        # bounded: 8 degraded PUBACKs well under 8 * full sync timeout
+        assert time.monotonic() - t0 < 6.0
+        assert mgrs["A"].fwd_parked_now > 0     # stranded -> parked
+        faults.heal("A", "B")
+        faults.heal("A", "C")
+        await links_converged(mgrs, MESH)
+        for i in range(16, 20):     # post-heal phase
+            await pub.publish("t/x", f"m-{i}".encode(), qos=1, timeout=5)
+            pubacked.append(f"m-{i}".encode())
+
+        got: set[bytes] = set()
+
+        async def settle():
+            got.update(await drain(sub, timeout=1.5))
+            return set(pubacked) <= got
+
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and not await settle():
+            pass
+        lost = set(pubacked) - got
+        assert not lost, f"PUBACKed messages lost across the heal: {lost}"
+        assert mgrs["A"].fwd_parked_resent > 0
+        assert mgrs["A"].fwd_barrier_degraded > 0   # counted, not silent
+
+        # replicas convergent within the heal window: A's and C's
+        # replica of the subscriber's session carries the same digest
+        # as B's live window once replication drains
+        cli_b = brokers["B"].clients.get("pt-sub")
+
+        def digests_match():
+            live = cli_b.inflight.digest()
+            return all(
+                (e := m.sessions.ledger.get("pt-sub")) is not None
+                and tuple(e.digest) == live
+                for m in (mgrs["A"], mgrs["C"]))
+
+        await wait_for(digests_match, what="replica digests converged")
+        await pub.close()
+        await sub.close()
+
+
+async def test_partition_never_wedges_connect_or_puback():
+    """With EVERY link of a node blackholed, a fresh client still
+    CONNECTs (takeover/claim legs degrade bounded) and QoS1 publishes
+    still ack within the degrade bounds."""
+    async with cluster(MESH) as (brokers, mgrs):
+        await links_converged(mgrs, MESH)
+        for peer in ("B", "C"):
+            faults.partition("A", peer)
+        await wait_for(lambda: mgrs["A"].links_up == 0,
+                       what="A isolated")
+        t0 = time.monotonic()
+        c = MQTTClient(client_id="pt-wedge", version=5,
+                       clean_start=False, session_expiry=300)
+        await asyncio.wait_for(
+            c.connect("127.0.0.1", brokers["A"].test_port), timeout=5)
+        await c.subscribe(("w/#", 1))
+        for i in range(3):
+            await c.publish("w/x", b"p", qos=1, timeout=5)
+        assert time.monotonic() - t0 < 5.0
+        await c.close()
+
+
+async def test_asymmetric_loss_and_flapping():
+    """asym A->B: A's direction blackholes (A detects its link down,
+    strands+parks), while B->A still flows publishes. Then flap the
+    full partition several times under load — the cluster converges
+    and no PUBACKed message is lost."""
+    pair = {"A": ["B"], "B": ["A"]}
+    async with cluster(pair) as (brokers, mgrs):
+        await links_converged(mgrs, pair)
+        sub_b = await connect(brokers["B"], "asym-sub-b")
+        await sub_b.subscribe(("ab/#", 1))
+        sub_a = await connect(brokers["A"], "asym-sub-a")
+        await sub_a.subscribe(("ba/#", 1))
+        await wait_for(lambda: mgrs["A"].routes.nodes_for("ab/x")
+                       and mgrs["B"].routes.nodes_for("ba/x"),
+                       what="routes both ways")
+        pub_a = await connect(brokers["A"], "asym-pub-a")
+        pub_b = await connect(brokers["B"], "asym-pub-b")
+
+        faults.partition("A", "B", mode="asym")     # A->B dies only
+        await wait_for(lambda: not mgrs["A"].links["B"].connected,
+                       what="A's link to B down")
+        assert mgrs["B"].links["A"].connected       # B->A alive
+        await pub_b.publish("ba/x", b"b-to-a", qos=1, timeout=5)
+        assert b"b-to-a" in set(await drain(sub_a, timeout=2.0))
+        await pub_a.publish("ab/x", b"a-to-b", qos=1, timeout=5)
+        faults.heal("A", "B")
+        got_b = set()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and b"a-to-b" not in got_b:
+            got_b.update(await drain(sub_b, timeout=1.0))
+        assert b"a-to-b" in got_b                   # parked -> healed
+
+        # flapping: 3 rapid partition/heal cycles under publish load
+        sent = []
+        for cycle in range(3):
+            faults.partition("A", "B")
+            for i in range(3):
+                p = f"f-{cycle}-{i}".encode()
+                await pub_a.publish("ab/x", p, qos=1, timeout=5)
+                sent.append(p)
+            faults.heal("A", "B")
+            await asyncio.sleep(0.2)
+        await links_converged(mgrs, pair)
+        got_b = set()
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and not set(sent) <= got_b:
+            got_b.update(await drain(sub_b, timeout=1.0))
+        lost = set(sent) - got_b
+        assert not lost, f"flapping lost PUBACKed messages: {lost}"
+        for c in (sub_a, sub_b, pub_a, pub_b):
+            await c.close()
+
+
+async def test_forward_parks_in_dead_read_loop_window():
+    """The SIGKILL window the kill-restart drive exposed: the bridge
+    client's read loop is already dead (its shutdown sweep of pending
+    acks has run) but the supervisor hasn't torn the link down yet. A
+    QoS1 forward enqueued in that window must park immediately — an
+    ack registered on the corpse could never resolve, and the message
+    would silently miss the retry-after-heal path."""
+    pair = {"A": ["B"], "B": ["A"]}
+    async with cluster(pair) as (brokers, mgrs):
+        await links_converged(mgrs, pair)
+        link = mgrs["A"].links["B"]
+        link.client._closed.set()       # read loop died; link up
+        assert link.connected
+        ok = link.forward("$cluster/fwd/A/1/999/1/1/t/x", b"p",
+                          qos=1, park=True)
+        assert not ok
+        assert len(link.parked) == 1
+
+
+async def test_fwd_durability_off_keeps_legacy_behavior():
+    """cluster_fwd_durability=off: forwards are fire-and-forget again —
+    nothing parks, nothing survives the partition (the documented
+    pre-018 trade), and nothing is retried at heal."""
+    pair = {"A": ["B"], "B": ["A"]}
+    async with cluster(pair, fwd_durability="off",
+                       session_sync="batched") as (brokers, mgrs):
+        await links_converged(mgrs, pair)
+        sub = await connect(brokers["B"], "off-sub")
+        await sub.subscribe(("t/#", 1))
+        await wait_for(lambda: mgrs["A"].routes.nodes_for("t/x"),
+                       what="routes at A")
+        pub = await connect(brokers["A"], "off-pub")
+        faults.partition("A", "B")
+        await wait_for(lambda: mgrs["A"].links_up == 0, what="A cut")
+        await pub.publish("t/x", b"gone", qos=1, timeout=5)
+        faults.heal("A", "B")
+        await links_converged(mgrs, pair)
+        assert mgrs["A"].forwards_parked == 0
+        assert b"gone" not in set(await drain(sub, timeout=1.0))
+        await pub.close()
+        await sub.close()
+
+
+# ----------------------------------------------------------------------
+# Tentpole: dead-owner lifecycle — will transfer + replica expiry
+# ----------------------------------------------------------------------
+
+
+async def test_will_fires_exactly_once_on_owner_death():
+    """The owner node drops off the network with a will-carrying client
+    attached: the elected judge (lowest live node id) fires the
+    transferred will exactly once; its willfire broadcast stands the
+    other replica down. Subscribers everywhere see ONE will."""
+    async with cluster(MESH) as (brokers, mgrs):
+        await links_converged(mgrs, MESH)
+        for m in mgrs.values():
+            m.sessions.will_grace = 0.3
+        sub_b = await connect(brokers["B"], "will-sub-b")
+        await sub_b.subscribe(("dead/#", 1))
+        sub_c = await connect(brokers["C"], "will-sub-c")
+        await sub_c.subscribe(("dead/#", 1))
+        wc = MQTTClient(client_id="will-cli", version=5,
+                        clean_start=False, session_expiry=600,
+                        will=Will(topic="dead/will-cli", payload=b"rip",
+                                  qos=1))
+        await wc.connect("127.0.0.1", brokers["A"].test_port)
+        await wait_for(
+            lambda: all("will-cli" in m.sessions.ledger
+                        and m.sessions.ledger["will-cli"].will
+                        for m in (mgrs["B"], mgrs["C"])),
+            what="will replicated to both replicas")
+        # A drops off the network (the judges can't tell a dead node
+        # from a partitioned one — that's the point)
+        faults.partition("A", "B")
+        faults.partition("A", "C")
+        await wait_for(lambda: mgrs["B"].sessions.wills_fired
+                       + mgrs["C"].sessions.wills_fired == 1,
+                       timeout=8, what="exactly one will fired")
+        await wait_for(lambda: mgrs["B"].sessions.wills_cleared
+                       + mgrs["C"].sessions.wills_cleared == 1,
+                       what="the other judge stood down by willfire")
+        got_b = await drain(sub_b, timeout=1.0)
+        got_c = await drain(sub_c, timeout=1.0)
+        assert got_b.count(b"rip") == 1
+        assert got_c.count(b"rip") == 1     # forwarded from B, once
+        await asyncio.sleep(0.8)            # no late second fire
+        assert (mgrs["B"].sessions.wills_fired
+                + mgrs["C"].sessions.wills_fired) == 1
+        for c in (sub_b, sub_c, wc):
+            await c.close()
+
+
+async def test_reconnect_cancels_pending_will():
+    """The client reconnects at a peer before the judges' grace
+    elapses: the takeover claim (higher epoch) re-owns the replica
+    entries and the transferred will is cancelled — a returning client
+    always wins over a suspected death."""
+    async with cluster(MESH) as (brokers, mgrs):
+        await links_converged(mgrs, MESH)
+        for m in mgrs.values():
+            m.sessions.will_grace = 0.6
+        wc = MQTTClient(client_id="wr-cli", version=5,
+                        clean_start=False, session_expiry=600,
+                        will=Will(topic="dead/wr-cli", payload=b"rip"))
+        await wc.connect("127.0.0.1", brokers["A"].test_port)
+        await wait_for(
+            lambda: "wr-cli" in mgrs["B"].sessions.ledger
+            and mgrs["B"].sessions.ledger["wr-cli"].will,
+            what="will replicated")
+        faults.partition("A", "B")
+        faults.partition("A", "C")
+        await wait_for(lambda: not mgrs["B"].links["A"].connected,
+                       what="B sees A down")
+        # the client comes back at B before the grace elapses
+        wc2 = MQTTClient(client_id="wr-cli", version=5,
+                         clean_start=False, session_expiry=600,
+                         will=Will(topic="dead/wr-cli", payload=b"rip"))
+        await wc2.connect("127.0.0.1", brokers["B"].test_port)
+        await asyncio.sleep(1.5)    # well past grace + stagger
+        assert mgrs["B"].sessions.wills_fired == 0
+        assert mgrs["C"].sessions.wills_fired == 0
+        entry = mgrs["C"].sessions.ledger.get("wr-cli")
+        assert entry is not None and entry.owner == "B"
+        await wc2.close()
+        await wc.close()
+
+
+async def test_replica_expiry_purges_dead_owners_sessions():
+    """A disconnected session whose owner then dies: the judge's
+    replica-side timer (seeded from the replicated session expiry)
+    purges the orphan, broadcasts the epoch-fenced third-party purge
+    (transitive holders purge too), and leaves a tombstone so a
+    re-created session claims above the dead epoch."""
+    async with cluster(MESH) as (brokers, mgrs):
+        await links_converged(mgrs, MESH)
+        for m in mgrs.values():
+            m.sessions.will_grace = 0.2     # stagger base
+        c = MQTTClient(client_id="exp-cli", version=5,
+                       clean_start=False, session_expiry=1)
+        await c.connect("127.0.0.1", brokers["A"].test_port)
+        await c.subscribe(("e/#", 1))
+        await wait_for(lambda: "exp-cli" in mgrs["B"].sessions.ledger,
+                       what="replicated")
+        await c.disconnect()
+        await c.close()
+        await wait_for(
+            lambda: not mgrs["B"].sessions.ledger["exp-cli"].connected,
+            what="disconnect replicated")
+        faults.partition("A", "B")
+        faults.partition("A", "C")
+        await wait_for(lambda: "exp-cli" not in mgrs["B"].sessions.ledger,
+                       timeout=8, what="B expired the replica")
+        assert mgrs["B"].sessions.replica_expiries == 1
+        await wait_for(lambda: "exp-cli" not in mgrs["C"].sessions.ledger,
+                       what="C purged via broadcast")
+        assert mgrs["B"].sessions._tombstones.get("exp-cli", 0) >= 1
+
+
+async def test_replica_expiry_returning_owner_wins():
+    """The owner heals before the expiry elapses: the countdown is
+    fenced — the replica survives and reconnects keep working."""
+    async with cluster(MESH) as (brokers, mgrs):
+        await links_converged(mgrs, MESH)
+        c = MQTTClient(client_id="ret-cli", version=5,
+                       clean_start=False, session_expiry=2)
+        await c.connect("127.0.0.1", brokers["A"].test_port)
+        await c.subscribe(("r/#", 1))
+        await wait_for(lambda: "ret-cli" in mgrs["B"].sessions.ledger,
+                       what="replicated")
+        faults.partition("A", "B")
+        faults.partition("A", "C")
+        await wait_for(lambda: not mgrs["B"].links["A"].connected,
+                       what="B sees A down")
+        faults.heal("A", "B")
+        faults.heal("A", "C")
+        await links_converged(mgrs, MESH)
+        await asyncio.sleep(1.0)    # countdown must have reset
+        assert "ret-cli" in mgrs["B"].sessions.ledger
+        assert mgrs["B"].sessions.replica_expiries == 0
+        await c.close()
+
+
+# ----------------------------------------------------------------------
+# Satellites: pubrec streaming, held replication, relay restart
+# ----------------------------------------------------------------------
+
+
+async def test_pubrec_pending_streams_to_replicas():
+    """The broker-side inbound QoS2 dedup set (PUBREC sent, PUBREL
+    pending) streams as replication ops — a replica holds it WITHOUT a
+    state pull, so a dead-owner failover keeps deduping retried
+    publishes."""
+    pair = {"A": ["B"], "B": ["A"]}
+    async with cluster(pair) as (brokers, mgrs):
+        await links_converged(mgrs, pair)
+        c = MQTTClient(client_id="q2-cli", version=5,
+                       clean_start=False, session_expiry=600)
+        await c.connect("127.0.0.1", brokers["A"].test_port)
+        leftover = await c.pause_reading()   # manual QoS2 state machine
+        assert not leftover
+        pkt = Packet(fixed=FixedHeader(type=PT.PUBLISH, qos=2),
+                     protocol_version=5, topic="q2/x", payload=b"z",
+                     packet_id=77)
+        c.writer.write(pkt.encode())
+        await c.writer.drain()
+        await wait_for(
+            lambda: 77 in (mgrs["B"].sessions.ledger.get("q2-cli").pubrec
+                           if mgrs["B"].sessions.ledger.get("q2-cli")
+                           else []),
+            what="pubrec streamed to B")
+        rel = Packet(fixed=FixedHeader(type=PT.PUBREL),
+                     protocol_version=5, packet_id=77)
+        c.writer.write(rel.encode())
+        await c.writer.drain()
+        await wait_for(
+            lambda: 77 not in mgrs["B"].sessions.ledger["q2-cli"].pubrec,
+            what="pubrec release streamed to B")
+        await c.close()
+
+
+async def test_held_inflight_replicates_and_survives_takeover():
+    """Quota-parked (held-but-unsent) messages replicate with their
+    held flag and survive a cross-node takeover: the new owner re-parks
+    them and drains within the receive window — nothing is dropped,
+    nothing overruns the client's receive maximum."""
+    pair = {"A": ["B"], "B": ["A"]}
+    stores = {"A": MemoryStore(), "B": MemoryStore()}
+    async with cluster(pair, stores=stores,
+                       node_caps={"receive_maximum": 1}) as (brokers,
+                                                             mgrs):
+        # receive_maximum=1 applies to node A (first topology entry)
+        await links_converged(mgrs, pair)
+        sub = MQTTClient(client_id="held-sub", version=5,
+                         clean_start=False, session_expiry=600)
+        await sub.connect("127.0.0.1", brokers["A"].test_port)
+        await sub.subscribe(("h/#", 1))
+        await wait_for(lambda: "held-sub" in mgrs["B"].sessions.ledger,
+                       what="session replicated")
+        await sub.pause_reading()       # stop acking: quota stays taken
+        pub = await connect(brokers["A"], "held-pub")
+        for i in range(3):
+            await pub.publish("h/x", f"h-{i}".encode(), qos=1, timeout=5)
+        cli = brokers["A"].clients.get("held-sub")
+        await wait_for(lambda: len(cli.held_pids) == 2,
+                       what="two messages quota-parked")
+        entry = mgrs["B"].sessions.ledger["held-sub"]
+        await wait_for(lambda: len(entry.inflight) == 3,
+                       what="all three replicated")
+        held_flags = sorted(
+            MessageRecord.from_json(raw).held
+            for raw in entry.inflight.values())
+        assert held_flags == [False, True, True]
+        # local journal carries held too (ADR-014 leg of the satellite)
+        stored = [MessageRecord.from_json(v) for k, v in
+                  stores["A"].all("inflight").items()
+                  if k.startswith("held-sub|")]
+        assert sorted(r.held for r in stored) == [False, True, True]
+
+        # takeover at B: held messages re-park, then drain under quota
+        sub2 = MQTTClient(client_id="held-sub", version=5,
+                          clean_start=False, session_expiry=600)
+        await sub2.connect("127.0.0.1", brokers["B"].test_port)
+        assert sub2.session_present
+        got = set()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and len(got) < 3:
+            got.update(await drain(sub2, timeout=1.0))
+        assert got == {b"h-0", b"h-1", b"h-2"}
+        await sub2.close()
+        await pub.close()
+        await sub.close()
+
+
+async def test_relay_restart_mid_stream_converges():
+    """The middle node of a 3-node line restarts while an inflight
+    replication stream is flowing A -> B -> C: after B returns (new
+    boot epoch, fresh link), A's resync re-ships the full window and
+    the transitive relay converges C's replica to A's live state."""
+    async with cluster(LINE) as (brokers, mgrs):
+        await links_converged(mgrs, LINE)
+        sub = MQTTClient(client_id="rel-sub", version=5,
+                         clean_start=False, session_expiry=600)
+        await sub.connect("127.0.0.1", brokers["A"].test_port)
+        await sub.subscribe(("rl/#", 1))
+        await sub.pause_reading()       # unacked: window accumulates
+        await wait_for(lambda: "rel-sub" in mgrs["C"].sessions.ledger,
+                       what="session reached C transitively")
+        pub = await connect(brokers["A"], "rel-pub")
+        for i in range(5):
+            await pub.publish("rl/x", f"r-{i}".encode(), qos=1, timeout=5)
+
+        # restart B on the same port, mid-stream
+        port_b = brokers["B"].test_port
+        await brokers["B"].close()
+        for i in range(5, 10):
+            await pub.publish("rl/x", f"r-{i}".encode(), qos=1, timeout=5)
+        b2 = Broker(BrokerOptions(
+            capabilities=Capabilities(sys_topic_interval=0)))
+        b2.add_hook(AllowHook())
+        lst = b2.add_listener(TCPListener("t", f"127.0.0.1:{port_b}"))
+        await b2.serve()
+        b2.test_port = port_b
+        mgr_b2 = make_manager(
+            b2, "B", [PeerSpec("A", "127.0.0.1", brokers["A"].test_port),
+                      PeerSpec("C", "127.0.0.1", brokers["C"].test_port)])
+        await mgr_b2.start()
+        brokers["B"] = b2               # the fixture closes the new one
+        mgrs["B"] = mgr_b2
+        await links_converged(mgrs, LINE)
+        for i in range(10, 12):
+            await pub.publish("rl/x", f"r-{i}".encode(), qos=1, timeout=5)
+
+        cli = brokers["A"].clients.get("rel-sub")
+
+        def converged(m):
+            e = m.sessions.ledger.get("rel-sub")
+            return (e is not None and e.owner == "A"
+                    and set(e.inflight) == {p.packet_id
+                                            for p in cli.inflight.all()})
+
+        await wait_for(lambda: converged(mgrs["B"]),
+                       what="B replica converged after restart")
+        await wait_for(lambda: converged(mgrs["C"]),
+                       what="C replica converged through the relay")
+        await pub.close()
+        await sub.close()
+
+
+# ----------------------------------------------------------------------
+# Satellites: parked-forward journal restore, weighted $share e2e
+# ----------------------------------------------------------------------
+
+
+async def test_restored_offline_session_queues_publishes():
+    """A session restored from the journal after a restart is a
+    DISCONNECTED session: publishes arriving before the client returns
+    must queue in its inflight window (they were refused+rolled back
+    as slow-consumer drops — the restored Client object never ran
+    stop(), so `closed` was False; found by the ADR-018 kill-restart
+    verify drive)."""
+    store = MemoryStore()
+    b1 = await make_node(store=store)
+    sub = MQTTClient(client_id="ro-sub", version=5, clean_start=False,
+                     session_expiry=3600)
+    await sub.connect("127.0.0.1", b1.test_port)
+    await sub.subscribe(("ro/#", 1))
+    await sub.close()
+    await b1.close()
+
+    b2 = await make_node(store=store)        # restore: session offline
+    cli = b2.clients.get("ro-sub")
+    assert cli is not None and cli.closed    # restored == disconnected
+    pub = await connect(b2, "ro-pub")
+    await pub.publish("ro/x", b"queued", qos=1, timeout=5)
+    assert len(cli.inflight) == 1            # parked for the resume
+    sub2 = MQTTClient(client_id="ro-sub", version=5, clean_start=False,
+                      session_expiry=3600)
+    await sub2.connect("127.0.0.1", b2.test_port)
+    assert sub2.session_present
+    assert (await sub2.next_message(timeout=5)).payload == b"queued"
+    await sub2.close()
+    await pub.close()
+    await b2.close()
+
+
+async def test_parked_forwards_survive_node_restart():
+    """A partition strands QoS1 forwards at A (journaled in the
+    cluster_fwd bucket); A then crashes and restarts: the parked
+    forwards restore from the journal and deliver after the link
+    heals — cross-node publish durability survives BOTH failure
+    modes."""
+    pair = {"A": ["B"], "B": ["A"]}
+    store_a = MemoryStore()
+    async with cluster(pair, stores={"A": store_a}) as (brokers, mgrs):
+        await links_converged(mgrs, pair)
+        sub = await connect(brokers["B"], "pk-sub")
+        await sub.subscribe(("pk/#", 1))
+        await wait_for(lambda: mgrs["A"].routes.nodes_for("pk/x"),
+                       what="routes at A")
+        pub = await connect(brokers["A"], "pk-pub")
+        faults.partition("A", "B")
+        await wait_for(lambda: mgrs["A"].links_up == 0, what="A cut")
+        await pub.publish("pk/x", b"parked", qos=1, timeout=5)
+        await wait_for(lambda: mgrs["A"].fwd_parked_now >= 1,
+                       what="forward parked")
+        await wait_for(lambda: store_a.all(FWD_BUCKET),
+                       what="parked forward journaled")
+        await pub.close()
+
+        # "crash" A, restart on the same store (heal first so the new
+        # incarnation's link comes straight up)
+        port_a = brokers["A"].test_port
+        await brokers["A"].close()
+        faults.heal("A", "B")
+        a2 = Broker(BrokerOptions(
+            capabilities=Capabilities(sys_topic_interval=0)))
+        a2.add_hook(AllowHook())
+        a2.add_hook(StorageHook(store_a))
+        a2.add_listener(TCPListener("t", f"127.0.0.1:{port_a}"))
+        await a2.serve()
+        a2.test_port = port_a
+        mgr_a2 = make_manager(
+            a2, "A", [PeerSpec("B", "127.0.0.1", brokers["B"].test_port)])
+        await mgr_a2.start()
+        assert mgr_a2.fwd_parked_now == 1       # restored from journal
+        brokers["A"] = a2
+        mgrs["A"] = mgr_a2
+        got = set()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and b"parked" not in got:
+            got.update(await drain(sub, timeout=1.0))
+        assert b"parked" in got
+        await wait_for(lambda: not store_a.all(FWD_BUCKET),
+                       what="journal row cleared on ack")
+        await sub.close()
+
+
+async def test_weighted_share_exactly_once_and_balanced():
+    """ADR-018 fairness: a $share group with 2 members at B and 1 at C
+    stays exactly-once cluster-wide under weighted rotation, and BOTH
+    nodes receive picks (the old pin starved everyone but the lowest
+    node id)."""
+    async with cluster(MESH, session_sync="batched") as (brokers, mgrs):
+        await links_converged(mgrs, MESH)
+        members = {}
+        for name, n in (("B", 2), ("C", 1)):
+            for k in range(n):
+                m = await connect(brokers[name], f"shw-{name}{k}")
+                await m.subscribe(("$share/g/ws/t", 0))
+                members[f"{name}{k}"] = m
+        key = ("g", "$share/g/ws/t")
+        await wait_for(
+            lambda: all(
+                sorted(mgr.routes.shares.members_for(key)) == ["B", "C"]
+                for mgr in mgrs.values()),
+            what="share membership converged everywhere")
+        pub = await connect(brokers["A"], "shw-pub")
+        n_msgs = 60
+        for i in range(n_msgs):
+            await pub.publish("ws/t", f"weighted-payload-{i * 7}".encode())
+        per_member = {name: await drain(m, timeout=1.0)
+                      for name, m in members.items()}
+        all_payloads = [p for got in per_member.values() for p in got]
+        assert len(all_payloads) == n_msgs, \
+            f"not exactly-once: {len(all_payloads)} != {n_msgs}"
+        assert len(set(all_payloads)) == n_msgs
+        per_node = {"B": len(per_member["B0"]) + len(per_member["B1"]),
+                    "C": len(per_member["C0"])}
+        assert per_node["B"] > 0 and per_node["C"] > 0, per_node
+        # 2 members vs 1: this payload set hashes 36/24 toward B
+        assert per_node["B"] > per_node["C"], per_node
+        for m in list(members.values()) + [pub]:
+            await m.close()
