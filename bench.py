@@ -1474,6 +1474,149 @@ def bench_overload(n_clients: int = 8, msgs: int = 300) -> dict:
     return d
 
 
+def bench_fanout(msgs: int = 400, sizes: tuple = (1, 64, 1024)) -> dict:
+    """ADR-019 zero-copy fan-out measurement (MAXMQ_BENCH_CONFIGS=
+    fanout): a live broker + real TCP subscribers at 1/64/1024-way
+    fan-out, in two delivery regimes per size — QoS0 (shared wire
+    bytes, writev burst drain) and QoS1 (patched-template buffer
+    sequences, PUBACK-paced end to end). Alongside the throughput
+    rows it reports the zero-copy ledger the templates exist for:
+    bytes copied vs shared per publish, template reuse, writev batch
+    shape, and the coalesced writer-wake counters — so a regression
+    in any of them shows up as a number in the BENCH trajectory, not
+    as a silent return to N encodes per publish."""
+    import asyncio
+
+    from maxmq_tpu.broker import (Broker, BrokerOptions, Capabilities,
+                                  TCPListener)
+    from maxmq_tpu.hooks import AllowHook
+    from maxmq_tpu.mqtt_client import MQTTClient
+
+    try:                    # 1024 subscribers = ~2x that in fds
+        import resource
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        if soft < 8192:
+            resource.setrlimit(resource.RLIMIT_NOFILE,
+                               (min(8192, hard), hard))
+    except Exception:
+        pass
+
+    payload = b"f" * 256
+
+    async def run() -> dict:
+        b = Broker(BrokerOptions(capabilities=Capabilities(
+            sys_topic_interval=0, maximum_keepalive=0)))
+        b.add_hook(AllowHook())
+        lst = b.add_listener(TCPListener("t", "127.0.0.1:0"))
+        await b.serve()
+        port = lst._server.sockets[0].getsockname()[1]
+        pub = MQTTClient(client_id="pub", keepalive=0)
+        await pub.connect("127.0.0.1", port)
+        subs: list = []
+
+        async def grow_to(n: int) -> None:
+            while len(subs) < n:
+                batch = []
+                for i in range(len(subs), min(n, len(subs) + 64)):
+                    c = MQTTClient(client_id=f"f{i}", version=5,
+                                   keepalive=0)
+                    batch.append(c)
+
+                async def attach(c):
+                    await c.connect("127.0.0.1", port)
+                    await c.subscribe(("fan/t", 0), ("fanq/t", 1))
+                await asyncio.gather(*(attach(c) for c in batch))
+                subs.extend(batch)
+
+        async def measure(topic: str, qos: int, pubs: int) -> dict:
+            """``pubs`` QoS1-paced publishes fanning out to every
+            subscriber at effective QoS ``qos``; throughput is
+            delivered/sec over the span to the last delivery, with
+            the ADR-019 ledger deltas for the phase."""
+            for c in subs:
+                while not c.messages.empty():
+                    c.messages.get_nowait()
+            ov, sched = b.overload, b.flush_sched
+            z0 = (ov.template_builds, ov.template_sends,
+                  ov.slow_encodes, ov.shared_bytes, ov.copied_bytes,
+                  ov.writev_batches, ov.writev_buffers)
+            f0 = (sched.flushes, sched.deferred) if sched else (0, 0)
+            got = 0
+            t0 = time.perf_counter()
+            t_last = t0
+
+            async def drain(c):
+                nonlocal got, t_last
+                while True:
+                    try:
+                        await c.next_message(timeout=1.0)
+                    except asyncio.TimeoutError:
+                        return
+                    got += 1
+                    t_last = time.perf_counter()
+
+            for _ in range(pubs):
+                await pub.publish(topic, payload, qos=1)
+            await asyncio.gather(*(drain(c) for c in subs))
+            span = max(t_last - t0, 1e-9)
+            builds, sends, slow, shared, copied, wvb, wvn = (
+                v1 - v0 for v1, v0 in zip(
+                    (ov.template_builds, ov.template_sends,
+                     ov.slow_encodes, ov.shared_bytes, ov.copied_bytes,
+                     ov.writev_batches, ov.writev_buffers), z0))
+            d = {"publishes": pubs,
+                 "msgs_per_sec": round(got / span, 1),
+                 "delivered_frac": round(got / (pubs * len(subs)), 3),
+                 "template_builds": builds, "template_sends": sends,
+                 "slow_encodes": slow,
+                 "shared_bytes_per_publish": round(shared / pubs, 1),
+                 "copied_bytes_per_publish": round(copied / pubs, 1),
+                 "writev_buffers_per_batch": round(wvn / max(wvb, 1), 2)}
+            if sched:
+                d["flush_wakes_deferred"] = sched.deferred - f0[1]
+                d["flush_passes"] = sched.flushes - f0[0]
+            return d
+
+        d: dict = {"config": "fanout", "payload_bytes": len(payload),
+                   "fan_sizes": list(sizes)}
+        for n in sizes:
+            await grow_to(n)
+            # constant-ish delivery volume across fan sizes: the
+            # wide phases measure fan-out cost, not publisher pacing
+            p0 = max(10, min(msgs, (msgs * 32) // n))
+            q1 = max(4, min(msgs // 2, (msgs * 8) // n))
+            for key, v in (await measure("fan/t", 0, p0)).items():
+                d[f"qos0_fan{n}_{key}"] = v
+            for key, v in (await measure("fanq/t", 1, q1)).items():
+                d[f"qos1_fan{n}_{key}"] = v
+
+        # ADR 015: a short fully-sampled round AFTER the measured
+        # phases populates the stage histograms (fanout + drain p99)
+        # without biasing the headline numbers
+        b.tracer.sample_n = 1
+        await measure("fan/t", 0, max(10, min(msgs, 3200) // len(subs)))
+        b.tracer.sample_n = 0
+        d["trace"] = trace_stanza(b.tracer)
+
+        async def bye(c):
+            try:
+                await c.disconnect()
+            except Exception:
+                pass
+        await asyncio.gather(*(bye(c) for c in subs + [pub]))
+        await b.close()
+        return d
+
+    d = asyncio.run(run())
+    widest = max(sizes)
+    log(f"[fanout] qos0 x{widest}="
+        f"{d.get(f'qos0_fan{widest}_msgs_per_sec')}/s "
+        f"qos1 x{widest}={d.get(f'qos1_fan{widest}_msgs_per_sec')}/s "
+        f"copied/pub={d.get(f'qos0_fan{widest}_copied_bytes_per_publish')}B "
+        f"shared/pub={d.get(f'qos0_fan{widest}_shared_bytes_per_publish')}B")
+    return d
+
+
 def bench_durable(msgs: int = 600, window: int = 64) -> dict:
     """ADR-014 durability-policy measurement (MAXMQ_BENCH_CONFIGS=
     durable): QoS1 publish throughput + mean PUBACK latency against a
@@ -2343,6 +2486,11 @@ def main() -> None:
         # ADR-012 host-path ladder: healthy vs shedding (stalled
         # consumer + CONNECT storm) vs recovered broker throughput
         runs.append(("overload", lambda: bench_overload()))
+    if "fanout" in which:
+        # ADR-019 zero-copy fan-out: 1/64/1024-way QoS0 + PUBACK-paced
+        # QoS1 delivery rates with the shared-vs-copied byte ledger
+        runs.append(("fanout",
+                     lambda: bench_fanout(msgs=max(64, int(400 * scale)))))
     if "durable" in which:
         # ADR-014 storage pipeline: QoS1 throughput/ack latency under
         # storage_sync always vs batched vs off + kill-recovery time
@@ -2447,7 +2595,8 @@ CONFIG_DEADLINES = {"1": 900, "2": 900, "3": 1200, "4": 2400,
                     "4h": 2400, "lat": 900, "lath": 900, "latd": 900,
                     "latdo": 1200, "5": 2400, "e2e": 4200,
                     "widthab": 1200, "degraded": 1200, "overload": 900,
-                    "cluster": 900, "durable": 900}
+                    "cluster": 900, "durable": 900, "failover": 900,
+                    "fanout": 900}
 
 
 def run_supervised(which: list[str]) -> None:

@@ -67,6 +67,9 @@ def capabilities_from_config(conf: Config) -> Capabilities:
         trace_sample_n=conf.trace_sample_n,
         trace_slow_ms=float(conf.trace_slow_ms),
         trace_ring=conf.trace_ring,
+        # zero-copy fan-out (ADR 019)
+        native_encode=conf.broker_native_encode,
+        flush_coalesce=conf.broker_flush_coalesce,
     )
 
 
@@ -215,6 +218,12 @@ def build_broker(conf: Config, logger: Logger) -> Broker:
     broker = Broker(BrokerOptions(capabilities=capabilities_from_config(conf),
                                   logger=logger.with_prefix("mqtt")))
     broker.add_hook(LoggingHook(logger.with_prefix("mqtt")))
+    if conf.log_level == "trace":
+        # per-packet tx logging lives in its own hook: its
+        # on_packet_sent override disables zero-copy fan-out (ADR 019),
+        # so it is only attached when TRACE would actually emit
+        from .hooks.logging import PacketTxLogHook
+        broker.add_hook(PacketTxLogHook(logger.with_prefix("mqtt")))
     if conf.auth_ledger:
         from .hooks.auth import Ledger, LedgerHook
         broker.add_hook(LedgerHook(Ledger.from_file(conf.auth_ledger)))

@@ -43,20 +43,93 @@ def _estimate_wire(packet: Packet) -> int:
     """Cheap wire-size estimate for byte accounting: exact encoding is
     deferred to the writer task, so the budget ledger uses topic+payload
     plus a flat header/property allowance. The estimate is stored with
-    the queued item, so enqueue/dequeue accounting is always symmetric."""
+    the queued item, so enqueue/dequeue accounting is always symmetric.
+    Since ADR 019 converted fan-out to exact-sized wire entries this
+    covers only the residual Packet paths (hook-override deliveries,
+    resends, retained sends, acks) — the variable-length v5 properties
+    are summed in so the watermarks fire on real bytes, not a flat
+    allowance an adversarial publisher can hide a kilobyte of user
+    properties under."""
     if packet.type == PT.PUBLISH:
-        return 32 + len(packet.topic) + len(packet.payload or b"")
+        est = 32 + len(packet.topic) + len(packet.payload or b"")
+        if packet.protocol_version >= 5:
+            pr = packet.properties
+            if pr.content_type:
+                est += 3 + len(pr.content_type)
+            if pr.response_topic:
+                est += 3 + len(pr.response_topic)
+            if pr.correlation_data:
+                est += 3 + len(pr.correlation_data)
+            for k, v in pr.user_properties:
+                est += 5 + len(k) + len(v)
+        return est
     return 32
 
 
 def _droppable_qos0(item) -> bool:
     """True for queued items the slow-consumer policy may shed: QoS0
     PUBLISH deliveries only — never acks, control packets, QoS>0
-    publishes (those park on session rules), or the shutdown sentinel."""
-    if type(item) is bytes:
+    publishes (those park on session rules), or the shutdown sentinel.
+    Items are ``bytes`` (pre-encoded wire), ``tuple`` (ADR 019 shared-
+    template buffer sequences, first buffer = frame head), a Packet,
+    or None."""
+    t = type(item)
+    if t is bytes:
         return (item[0] >> 4) == PT.PUBLISH and (item[0] & 0x06) == 0
+    if t is tuple:
+        head = item[0]
+        return (head[0] >> 4) == PT.PUBLISH and (head[0] & 0x06) == 0
     return (item is not None and item.type == PT.PUBLISH
             and item.fixed.qos == 0)
+
+
+class FlushScheduler:
+    """Per-loop-iteration getter-wake coalescing (ADR 019). A 1→N
+    fan-out enqueues its N deliveries synchronously; completing each
+    parked getter future inline schedules N task wake-ups before the
+    fan-out loop finishes, and a client hit K times in one iteration
+    is scheduled K times. Deferring the completions to one
+    ``loop.call_soon`` callback wakes each writer exactly once per
+    iteration — after its FULL backlog is queued, so the greedy burst
+    sees everything on its first dequeue."""
+
+    __slots__ = ("_pending", "_scheduled", "flushes", "deferred",
+                 "coalesced")
+
+    def __init__(self) -> None:
+        self._pending: list = []
+        self._scheduled = False
+        self.flushes = 0        # call_soon flush passes run
+        self.deferred = 0       # wakes parked for a flush pass
+        self.coalesced = 0      # duplicate wakes absorbed by one park
+
+    def defer(self, q: "OutboundQueue") -> bool:
+        """Park one queue's getter wake; False when no loop is running
+        (inline/test contexts), letting the caller wake directly."""
+        if q._wake_deferred:
+            self.coalesced += 1
+            return True
+        if not self._scheduled:
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                return False
+            loop.call_soon(self._flush)
+            self._scheduled = True
+        q._wake_deferred = True
+        self._pending.append(q)
+        self.deferred += 1
+        return True
+
+    def _flush(self) -> None:
+        self._scheduled = False
+        pending, self._pending = self._pending, []
+        self.flushes += 1
+        for q in pending:
+            q._wake_deferred = False
+            g = q._getter
+            if g is not None and not g.done():
+                g.set_result(None)
 
 
 class OutboundQueue:
@@ -66,11 +139,17 @@ class OutboundQueue:
     (``overload.queued_bytes``) stay exact without re-deriving sizes at
     dequeue. The sole consumer is the client's writer task."""
 
-    def __init__(self, maxsize: int, overload=None) -> None:
+    def __init__(self, maxsize: int, overload=None,
+                 scheduler: FlushScheduler | None = None) -> None:
         self._q: deque = deque()
         self._maxsize = maxsize
         self._getter: asyncio.Future | None = None
         self._overload = overload
+        # ADR 019: getter wakes route through the broker's per-loop-
+        # iteration flush scheduler when one is attached; direct wake
+        # otherwise (inline clients, queues built outside a broker)
+        self._scheduler = scheduler
+        self._wake_deferred = False
         self.bytes = 0
         # cumulative entry counters (ADR 015): a drain-span watcher
         # registered at enqueue seq S is settled by the first flush
@@ -92,7 +171,9 @@ class OutboundQueue:
             self._overload.note_put(size)
         g = self._getter
         if g is not None and not g.done():
-            g.set_result(None)
+            s = self._scheduler
+            if s is None or not s.defer(self):
+                g.set_result(None)
 
     def get_nowait(self):
         if not self._q:
@@ -191,10 +272,12 @@ class Client:
 
         maxq = server.capabilities.maximum_client_writes_pending
         # bytes items are pre-encoded wire (QoS0 fan-out fast path);
+        # tuple items are ADR-019 shared-template buffer sequences;
         # None is the writer-shutdown sentinel. Byte-accounted against
         # the per-client and broker budgets (ADR 012).
         self.outbound = OutboundQueue(
-            maxq, overload=getattr(server, "overload", None))
+            maxq, overload=getattr(server, "overload", None),
+            scheduler=getattr(server, "flush_sched", None))
         self._writer_task: asyncio.Task | None = None
         self._reader_task: asyncio.Task | None = None
         # slow-consumer ledger (ADR 012): writer progress timestamp for
@@ -342,9 +425,32 @@ class Client:
     # of de-accounted inside the transport buffer (ADR 012)
     BURST_BYTES = 65536
 
+    def _flush_bufs(self, bufs: list) -> None:
+        """Hand one burst's collected wire buffers to the transport in
+        a single writev-style call (ADR 019): shared template segments
+        are joined once at the socket layer per burst, not copied once
+        per subscriber at fan-out. Writer facades without writelines
+        (WS / embedder stream shims expose only write) get the burst
+        as one joined write — same bytes, one frame."""
+        writelines = getattr(self.writer, "writelines", None)
+        if writelines is not None:
+            writelines(bufs)
+        else:
+            self.writer.write(b"".join(bufs))
+        overload = getattr(self.server, "overload", None)
+        if overload is not None:
+            overload.writev_batches += 1
+            overload.writev_buffers += len(bufs)
+        bufs.clear()
+
     async def _write_loop(self) -> None:
         assert self.writer is not None
         get_nowait = self.outbound.get_nowait
+        info = self.server.info
+        # wire buffers collected across the burst, flushed through ONE
+        # transport.writelines per burst (or before any Packet item,
+        # which must encode+write in order)
+        bufs: list = []
         try:
             while True:
                 packet = await self.outbound.get()
@@ -358,15 +464,28 @@ class Client:
                         # without blocking the loop (tests/bench arm
                         # client.write#<id>; see faults.fire_detail)
                         await asyncio.sleep(stall)
-                    if type(packet) is bytes:  # pre-encoded fast path
-                        self.writer.write(packet)
-                        info = self.server.info
-                        info.bytes_sent += len(packet)
+                    t = type(packet)
+                    if t is bytes:             # pre-encoded fast path
+                        bufs.append(packet)
+                        n = len(packet)
+                        info.bytes_sent += n
                         info.packets_sent += 1
-                        burst += len(packet)
+                        burst += n
                         if packet[0] >> 4 == PT.PUBLISH:
                             info.messages_sent += 1
+                    elif t is tuple:           # ADR 019 buffer sequence
+                        n = 0
+                        for b in packet:
+                            n += len(b)
+                        bufs.extend(packet)
+                        info.bytes_sent += n
+                        info.packets_sent += 1
+                        burst += n
+                        if packet[0][0] >> 4 == PT.PUBLISH:
+                            info.messages_sent += 1
                     else:
+                        if bufs:               # keep the wire in order
+                            self._flush_bufs(bufs)
                         self._write_packet(packet)
                         burst += _estimate_wire(packet)
                     if burst >= self.BURST_BYTES:
@@ -377,7 +496,11 @@ class Client:
                         break
                 else:
                     break                      # drained a None: stop
+                if bufs:
+                    self._flush_bufs(bufs)
                 await self._flush_burst()
+            if bufs:
+                self._flush_bufs(bufs)
             await self._drain()
         except asyncio.CancelledError:
             pass
@@ -417,6 +540,12 @@ class Client:
         self.server.info.packets_sent += 1
         if packet.type == PT.PUBLISH:
             self.server.info.messages_sent += 1
+            overload = getattr(self.server, "overload", None)
+            if overload is not None:
+                # ADR 019 ledger: a Packet entry reaching the writer is
+                # a per-subscriber encode the template path didn't cover
+                overload.slow_encodes += 1
+                overload.copied_bytes += len(wire)
         self.server.hooks.notify("on_packet_sent", self, packet, len(wire))
 
     async def _drain(self) -> None:
@@ -480,9 +609,10 @@ class Client:
                 hooks = self.server.hooks
                 if hooks.overrides("on_publish_dropped"):
                     for item in items:
-                        # pre-encoded wire sheds have no Packet to hand
-                        # the hook; counters above remain authoritative
-                        if type(item) is not bytes:
+                        # pre-encoded wire/buffer-sequence sheds have no
+                        # Packet to hand the hook; the counters above
+                        # remain authoritative
+                        if type(item) not in (bytes, tuple):
                             hooks.notify("on_publish_dropped",
                                          self, item)
             if self.outbound.bytes + size > budget:
@@ -530,6 +660,27 @@ class Client:
             return False
         try:
             self.outbound.put_nowait(wire, size)
+            return True
+        except asyncio.QueueFull:
+            self.note_drop("queue_full", 1, size)
+            return False
+
+    def send_buffers(self, bufs: tuple, size: int,
+                     publish: bool = True) -> bool:
+        """Enqueue one ADR-019 buffer-sequence delivery (shared
+        template segments + a per-subscriber head) with its EXACT wire
+        size — the writer hands the buffers to transport.writelines
+        unchanged, so enqueue accounting equals socket bytes. Refusal
+        accounting mirrors send_wire: one refusal, one reason, one
+        budget_drops increment, on both fast and slow paths."""
+        if self.closed or self.writer is None:
+            return False
+        if publish and (reason := self._refuse_publish(size)) is not None:
+            self.note_drop(reason, 1, size)
+            self.server.overload.budget_drops += 1
+            return False
+        try:
+            self.outbound.put_nowait(bufs, size)
             return True
         except asyncio.QueueFull:
             self.note_drop("queue_full", 1, size)

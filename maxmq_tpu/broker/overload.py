@@ -71,6 +71,21 @@ class OverloadState:
         self.connects_refused = 0   # token-bucket socket refusals
         self.half_open_refused = 0  # half-open-handshake cap refusals
         self.stalled_disconnects = 0
+        # -- zero-copy fan-out ledger (ADR 019) ------------------------
+        # one publish should cost one encode: template_sends counts
+        # deliveries assembled from a shared template (wire0 cache hits
+        # included), slow_encodes the per-subscriber Packet encodes
+        # that remain (hook overrides, oversize fallbacks, resends).
+        # shared_bytes/copied_bytes split every delivered wire byte by
+        # whether fan-out copied it per subscriber — the bench's
+        # bytes-copied-per-publish ledger reads these.
+        self.template_builds = 0    # shared templates encoded
+        self.template_sends = 0     # deliveries from shared wire
+        self.slow_encodes = 0       # per-subscriber full encodes left
+        self.shared_bytes = 0       # delivered bytes reused, not copied
+        self.copied_bytes = 0       # delivered bytes copied/subscriber
+        self.writev_batches = 0     # transport.writelines burst flushes
+        self.writev_buffers = 0     # buffers handed to writelines
 
     # -- byte accounting (called by every OutboundQueue put/get) -------
 

@@ -23,11 +23,12 @@ from ..trace import MAX_DRAIN_SPANS, PipelineTracer
 from ..matching.topics import valid_filter, valid_topic_name
 from ..matching.trie import (SubscriberSet, TopicIndex,
                              VersionedTopicCache)
-from ..protocol import codes
+from ..protocol import codes, wire
 from ..protocol.codec import (FixedHeader, MalformedPacketError,
                               PacketType as PT, write_varint)
 from ..protocol.packets import Packet, ProtocolError, Subscription
-from .client import Client, ClientRegistry, PacketIDExhausted
+from .client import (Client, ClientRegistry, FlushScheduler,
+                     PacketIDExhausted)
 from .listeners import Listener, Listeners
 from .overload import OverloadState, TokenBucket, top_offenders
 from .sys_info import SysInfo
@@ -102,6 +103,13 @@ class Capabilities:
     trace_slow_ms: float = 0.0        # flight-record only e2e >= this
     trace_ring: int = 64              # flight-recorder entries kept
 
+    # -- zero-copy fan-out (ADR 019) -----------------------------------
+    native_encode: bool = True        # C frame-head assembly when the
+                                      # maxmq_decode extension is built;
+                                      # False pins the Python builder
+    flush_coalesce: bool = True       # coalesce writer wakes to one
+                                      # flush per loop iteration
+
 
 @dataclass
 class BrokerOptions:
@@ -154,6 +162,13 @@ class Broker:
         # deliveries parked while shedding (drained on recovery)
         self.overload = OverloadState(self.capabilities)
         self._half_open = 0
+        # zero-copy fan-out (ADR 019): per-loop-iteration writer-wake
+        # coalescing — one flush pass wakes every writer a fan-out
+        # touched, after its full backlog is queued. None disables
+        # (direct wakes), for latency-sensitive single-subscriber
+        # deployments that prefer the pre-019 behavior.
+        self.flush_sched = (FlushScheduler()
+                            if self.capabilities.flush_coalesce else None)
         # (client_id, filter) -> (sub, existing): keyed so a client
         # re-SUBSCRIBing during the shed window gets ONE delivery on
         # recovery and the ledger is bounded by the subscription count
@@ -1359,8 +1374,12 @@ class Broker:
                             packet: Packet) -> bool:
         """True when the delivered packet carries no per-subscriber state
         (qos 0 out, retain cleared, no v5 subscription ids / aliases) —
-        its wire bytes are then IDENTICAL for every such subscriber.
-        Disabled when any hook watches the encode/sent events."""
+        its wire bytes are then IDENTICAL for every such subscriber and
+        ONE shared bytes object serves them all. Per-subscriber feature
+        flags no longer force the copy+encode slow path: they select
+        the patched-template strategy instead (_send_template_qos0 /
+        _send_template_qos, ADR 019). Disabled when any hook watches
+        the encode/sent events."""
         return (min(packet.fixed.qos, sub.qos,
                     self.capabilities.maximum_qos) == 0
                 and not client.closed
@@ -1414,12 +1433,18 @@ class Broker:
             else:
                 wire = self._delivery_form(packet, version).encode()
             cache[version] = wire
+            self.overload.template_builds += 1
         if not client.send_wire(wire):
             self.info.messages_dropped += 1
             if self.hooks.overrides("on_publish_dropped"):
                 self.hooks.notify("on_publish_dropped", client,
                                   self._delivery_form(packet, version))
-        elif self.tracer.sample_n or self.tracer.adopted_open:
+            return
+        # ADR 019 ledger: the single shared bytes object is enqueued
+        # per subscriber — every delivered byte is reused, none copied
+        self.overload.template_sends += 1
+        self.overload.shared_bytes += len(wire)
+        if self.tracer.sample_n or self.tracer.adopted_open:
             self._trace_drain(client, packet)
 
     def _trace_drain(self, client: Client, packet: Packet) -> None:
@@ -1432,6 +1457,120 @@ class Broker:
             tr.n_drain += 1
             client._drain_traces.append(
                 (tr, self.tracer.clock(), client.outbound.enqueued))
+
+    def _template_eligible(self, client: Client) -> bool:
+        """ADR 019: per-subscriber frame variation (QoS flags, packet
+        id, v5 subscription ids / topic alias / retain-as-published /
+        max-packet-size) selects a patch strategy over the shared wire
+        template instead of the per-subscriber copy+encode. Encode/sent
+        hook overrides force the slow path — those hooks must observe
+        each delivery as a real mutable Packet — and so does an
+        instance-patched ``send``/``send_buffers`` (the embedder/test
+        seam for intercepting shaped deliveries)."""
+        d = client.__dict__
+        return ("send" not in d and "send_buffers" not in d
+                and not self.hooks.overrides("on_packet_encode")
+                and not self.hooks.overrides("on_packet_sent"))
+
+    def _template_for(self, packet: Packet, version: int):
+        """The (packet, version) shared template, counted on first
+        build (the ledger term the fan-out bench divides by)."""
+        cache = packet.__dict__.get("_tmpl")
+        if cache is None or (5 if version >= 5 else 4) not in cache:
+            self.overload.template_builds += 1
+        return wire.publish_template(packet, version)
+
+    def _send_template_qos0(self, client: Client, sub: Subscription,
+                            packet: Packet) -> bool:
+        """One QoS0 delivery whose frame VARIES per subscriber
+        (retain-as-published, v5 subscription ids / topic alias, a
+        client max-packet-size to honor): patch the shared template
+        instead of copy+encode (ADR 019). Returns False to fall back
+        to the per-subscriber encode — only when the worst-case frame
+        could exceed the client's maximum packet size, decided BEFORE
+        any outbound alias is consumed so the fallback's own
+        ``assign_outbound`` is the only assignment."""
+        version = client.properties.protocol_version
+        tmpl = self._template_for(packet, version)
+        retain = bool(sub.retain_as_published and packet.fixed.retain)
+        ids: list = []
+        alias = None
+        alias_topic = False
+        mid = b""
+        if version >= 5:
+            ids = sorted(set(sub.identifiers.values())
+                         or ({sub.identifier} if sub.identifier
+                             else set()))
+            mid = wire.sid_alias_seg(ids, None)
+            aliases_on = (client.aliases is not None
+                          and client.properties.topic_alias_maximum)
+            mps = client.properties.maximum_packet_size
+            if mps and tmpl.frame_size(
+                    len(mid) + (3 if aliases_on else 0), False) > mps:
+                return False    # encode_under may shed user properties
+            if aliases_on:
+                a, first = client.aliases.assign_outbound(packet.topic)
+                if a:
+                    alias = a
+                    alias_topic = not first
+                    mid = wire.sid_alias_seg(ids, alias)
+        bufs, size = tmpl.patch(0, retain, 0, mid, alias_topic,
+                                native=self.capabilities.native_encode)
+        if not client.send_buffers(bufs, size):
+            self.info.messages_dropped += 1
+            if self.hooks.overrides("on_publish_dropped"):
+                out = self._delivery_form(packet, version)
+                out.fixed.retain = retain
+                if version >= 5:
+                    out.properties.subscription_ids = ids
+                    out.properties.topic_alias = alias
+                    if alias_topic:
+                        out.topic = ""
+                self.hooks.notify("on_publish_dropped", client, out)
+            return True
+        overload = self.overload
+        overload.template_sends += 1
+        overload.shared_bytes += tmpl.shared_len
+        overload.copied_bytes += size - tmpl.shared_len
+        if self.tracer.sample_n or self.tracer.adopted_open:
+            self._trace_drain(client, packet)
+        return True
+
+    def _send_template_qos(self, client: Client, out: Packet,
+                           packet: Packet) -> bool:
+        """One QoS>0 first transmission patched from the shared
+        template (ADR 019). ``out`` is the inflight-registered shaped
+        copy from _build_outbound — the patch derives flags, packet id
+        and the spliced v5 segment from it, so session resume, DUP
+        resends and the ack state machines keep operating on real
+        Packets. Returns False to fall back to _send_outbound (frame
+        over the client's max packet size: encode_under may still
+        save it by shedding user properties)."""
+        version = client.properties.protocol_version
+        tmpl = self._template_for(packet, version)
+        mid = b""
+        alias_topic = False
+        if version >= 5:
+            pr = out.properties
+            mid = wire.sid_alias_seg(pr.subscription_ids,
+                                     pr.topic_alias)
+            alias_topic = not out.topic
+        bufs, size = tmpl.patch(out.fixed.qos, out.fixed.retain,
+                                out.packet_id, mid, alias_topic,
+                                native=self.capabilities.native_encode)
+        mps = client.properties.maximum_packet_size
+        if mps and size > mps:
+            return False
+        if not client.send_buffers(bufs, size):
+            self._count_refused_send(client, out)
+            return True
+        overload = self.overload
+        overload.template_sends += 1
+        overload.shared_bytes += tmpl.shared_len
+        overload.copied_bytes += size - tmpl.shared_len
+        if self.tracer.sample_n or self.tracer.adopted_open:
+            self._trace_drain(client, packet)
+        return True
 
     def _publish_to_client(self, client_id: str, sub: Subscription,
                            packet: Packet, shared: bool) -> None:
@@ -1446,6 +1585,12 @@ class Broker:
         if self._fast_qos0_eligible(client, sub, packet):
             self._send_fast_qos0(client, packet)
             return
+        template = self._template_eligible(client)
+        if (template and not client.closed
+                and min(packet.fixed.qos, sub.qos,
+                        self.capabilities.maximum_qos) == 0
+                and self._send_template_qos0(client, sub, packet)):
+            return
 
         out = self._build_outbound(client, sub, packet)
         if client.closed and out.fixed.qos == 0:
@@ -1454,6 +1599,9 @@ class Broker:
             return  # dropped, exhausted, or parked on send quota
         if client.closed:
             return  # queued in inflight for session resume
+        if (template and out.fixed.qos > 0
+                and self._send_template_qos(client, out, packet)):
+            return
         self._send_outbound(client, out, packet)
 
     def _send_outbound(self, client: Client, out: Packet,
@@ -1765,6 +1913,11 @@ class Broker:
         out.fixed.dup = False
         if out.protocol_version < 5:
             out.properties = type(out.properties)()
+        else:
+            # retained deliveries carry the establishing subscription's
+            # identifier like any forwarded publish [MQTT-3.3.4-3]
+            out.properties.subscription_ids = \
+                [sub.identifier] if sub.identifier else []
         if out.fixed.qos > 0:
             if len(client.inflight) >= self.capabilities.maximum_inflight:
                 self.info.inflight_dropped += 1

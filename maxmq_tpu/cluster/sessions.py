@@ -140,8 +140,11 @@ class SessionEntry:
         self.shares: list = list(shares or [])   # [[group, filter], ...]
         self.digest = tuple(digest)              # (count, xor of pids)
         # ADR 018 will transfer: [topic, payload_hex, qos, retain,
-        # delay_s] while the owner's client is connected with a will,
-        # else None — a replica can fire it if the owner node dies
+        # delay_s] while the owner's client is connected with a will —
+        # or, once disconnected, while the will sits in the owner's
+        # _will_delays countdown with delay_s the REMAINING delay
+        # (ADR 019 satellite) — else None. A replica can fire it if
+        # the owner node dies.
         self.will = list(will) if will else None
         self.inflight: dict[int, str] = {}
         self.pubrec: list[int] = []
@@ -610,6 +613,21 @@ class SessionFederation(Hook):
             will = [p.will.topic, p.will.payload.hex(),
                     int(p.will.qos), int(p.will.retain),
                     float(p.will_delay or 0)]
+        elif not connected:
+            # ADR 019 (satellite): a will-delay-parked will is STILL
+            # pending on this owner (_queue_will parked it before the
+            # disconnect hook fired), so it must keep riding the
+            # replicated entry with its REMAINING delay — an owner
+            # dying mid-countdown used to lose the will cluster-wide.
+            # The judge resumes the countdown from disconnected_seen;
+            # the owner's own local fire replicates the stand-down
+            # (on_will_sent below).
+            parked = self.broker._will_delays.get(client.id)
+            if parked is not None:
+                due, wp = parked
+                will = [wp.topic, wp.payload.hex(), int(wp.fixed.qos),
+                        int(wp.fixed.retain),
+                        max(due - time.time(), 0.0)]
         return SessionEntry(
             client.id, self.node_id, epoch, self.broker.boot_epoch,
             p.session_expiry, p.session_expiry_set, p.protocol_version,
@@ -628,6 +646,27 @@ class SessionFederation(Hook):
     def on_disconnect(self, client, err, expire: bool) -> None:
         if not expire:      # expiry rides the purge path instead
             self._note_client(client, connected=False)
+
+    def on_will_sent(self, client, packet) -> None:
+        """ADR 019 (satellite): the owner's own will fired locally —
+        including a delayed will whose _will_delays countdown just
+        elapsed, where the client object is already gone. Clear the
+        replicated copy and broadcast the stand-down, or a judge
+        sweeping this node's later death would fire the will a second
+        time from the stale entry."""
+        cid = getattr(packet, "origin", "")
+        if not cid:
+            return
+        entry = self.ledger.get(cid)
+        if entry is None or entry.owner != self.node_id \
+                or entry.will is None:
+            return
+        entry.will = None
+        hook = getattr(self.broker, "_storage_hook", None)
+        if hook is not None:
+            hook.store.put(SESS_BUCKET, entry.cid, entry.meta_json())
+        if self.manager.links:
+            self._mark_dirty(cid)
 
     def on_qos_publish(self, client, packet, sent: float,
                        resends: int) -> None:
@@ -1133,7 +1172,7 @@ class SessionFederation(Hook):
             else self._started_mono
         down_for = now - last
         stagger = self.will_grace * (1 + rank)
-        if entry.connected and entry.will is not None:
+        if entry.will is not None:
             try:
                 delay = float(entry.will[4]) \
                     if len(entry.will) > 4 else 0.0
@@ -1143,7 +1182,30 @@ class SessionFederation(Hook):
                 # to a counted skip, so one bad entry can never wedge
                 # the whole sweep round
                 delay = 0.0
-            if down_for >= stagger + delay:
+            if entry.connected:
+                # died with the client attached: the will-delay clock
+                # starts at the owner's death
+                if down_for >= stagger + delay:
+                    self._fire_replica_will(entry)
+            elif entry.disconnected_seen:
+                # ADR 019 (satellite): the owner died while the will
+                # sat in ITS _will_delays countdown — the replicated
+                # entry carries the delay REMAINING at disconnect, so
+                # the judge resumes that countdown from the disconnect
+                # it observed instead of restarting it at owner death
+                # (which double-charged the delay and, pre-fix, never
+                # fired at all: disconnected entries were skipped).
+                # The rank stagger applies at the FIRE instant — every
+                # judge's countdown expires at the same moment, so
+                # staggering only the death observation would let all
+                # ranks fire together before the stand-down lands
+                if (down_for >= stagger
+                        and now - entry.disconnected_seen
+                        >= delay + self.will_grace * rank):
+                    self._fire_replica_will(entry)
+            elif down_for >= stagger + delay:
+                # no observed disconnect instant (entry applied cold,
+                # e.g. judge joined later): fall back to owner death
                 self._fire_replica_will(entry)
         self._maybe_expire(entry, now, down_for, stagger)
 
@@ -1167,6 +1229,13 @@ class SessionFederation(Hook):
             else down_for
         if elapsed < limit + stagger:
             return
+        if entry.will is not None:
+            # ADR 019 (satellite): an expiring session with a still-
+            # pending transferred will fires it on the way out — expiry
+            # ends the will delay early per [MQTT-3.1.2-10] (session
+            # end publishes the will), and silently purging it lost
+            # the will entirely
+            self._fire_replica_will(entry)
         self.replica_expiries += 1
         self._remove_entry(entry.cid)
         self._note_tombstone(entry.cid, entry.session_epoch)
@@ -1417,6 +1486,11 @@ class SessionFederation(Hook):
         live storage rows dropped — the claimant persists it now."""
         broker = self.broker
         client.taken_over = True
+        # ADR 019 (satellite): a pending delayed will is cancelled by
+        # the takeover — the session lives on at the claimant, and the
+        # will-delay contract [MQTT-3.1.3-9] says a session resumption
+        # before the delay elapses suppresses the will
+        broker._will_delays.pop(cid, None)
         if not client.closed:
             broker.disconnect_client(client, codes.ErrSessionTakenOver)
             broker._spawn(

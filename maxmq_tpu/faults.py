@@ -77,6 +77,9 @@ CLUSTER_TAKEOVER = "cluster.takeover"  # CONNECT takeover/state handoff
 STORAGE_PUT = "storage.put"            # journal enqueue boundary (ADR 014)
 STORAGE_COMMIT = "storage.commit"      # journal writer-thread group commit
 STORAGE_RESTORE = "storage.restore"    # per-record boot restore parse
+NATIVE_ENCODE = "native.encode"        # C publish-frame head assembly
+                                       # (ADR 019; trips fall back to the
+                                       # pure-Python encoder)
 
 
 class _Spec:

@@ -90,11 +90,6 @@ class LoggingHook(Hook):
                        bytes=packet.fixed.remaining)
         return packet
 
-    def on_packet_sent(self, client, packet, nbytes: int) -> None:
-        self.log.trace("sent packet", client=_cid(client),
-                       type=_ptype(packet.fixed.type), id=packet.packet_id,
-                       bytes=nbytes)
-
     def on_packet_id_exhausted(self, client, packet) -> None:
         self.log.warn("packet ids exhausted", client=_cid(client))
 
@@ -151,3 +146,22 @@ class LoggingHook(Hook):
     def on_will_sent(self, client, packet) -> None:
         self.log.debug("will message sent", client=_cid(client),
                        topic=packet.topic)
+
+
+class PacketTxLogHook(Hook):
+    """TRACE-level per-packet tx logging, as its own hook because an
+    ``on_packet_sent`` override anywhere forces every fan-out delivery
+    onto the per-client encode path (the hook must observe a real
+    Packet, ADR 019) — attached by bootstrap only when the configured
+    level actually emits TRACE, so the default deployment keeps
+    zero-copy fan-out."""
+
+    id = "logging-tx"
+
+    def __init__(self, logger: Logger) -> None:
+        self.log = logger
+
+    def on_packet_sent(self, client, packet, nbytes: int) -> None:
+        self.log.trace("sent packet", client=_cid(client),
+                       type=_ptype(packet.fixed.type), id=packet.packet_id,
+                       bytes=nbytes)

@@ -410,6 +410,8 @@ def register_broker_metrics(registry: Registry, broker) -> None:
     _register_storage_metrics(registry, broker)
     # publish-path tracing (ADR 015)
     _register_trace_metrics(registry, broker)
+    # zero-copy fan-out (ADR 019)
+    _register_fanout_metrics(registry, broker)
 
 
 # stage-error label cardinality bound: stages are a fixed set and
@@ -840,6 +842,48 @@ def _register_overload_metrics(registry: Registry, broker) -> None:
         "series)",
         lambda: [({"client": row["client"]}, row["dropped"])
                  for row in top_offenders(broker.clients.all())])
+
+
+def _register_fanout_metrics(registry: Registry, broker) -> None:
+    """ADR-019 zero-copy fan-out ledger: template reuse vs the
+    residual per-subscriber encodes, shared vs copied wire bytes,
+    writev batch shape, and the per-loop-iteration writer-wake
+    coalescing — the terms the fanout bench config divides by."""
+    over = getattr(broker, "overload", None)
+    if over is None:
+        return
+    for name, help_ in (
+            ("template_builds",
+             "Shared PUBLISH wire templates/frames built (one per "
+             "publish x protocol major version)"),
+            ("template_sends",
+             "Deliveries enqueued as shared wire bytes or patched "
+             "template buffer sequences"),
+            ("slow_encodes",
+             "Deliveries that took the per-subscriber copy+encode "
+             "slow path (hook overrides, resends, retained sends)"),
+            ("shared_bytes",
+             "Wire bytes served from shared template segments, never "
+             "copied per subscriber"),
+            ("copied_bytes",
+             "Wire bytes materialized per subscriber (patched frame "
+             "heads + slow-path encodes)"),
+            ("writev_batches",
+             "Writer burst flushes handed to transport.writelines"),
+            ("writev_buffers",
+             "Wire buffers carried by those writelines batches")):
+        registry.counter_func(f"maxmq_broker_fanout_{name}_total",
+                              help_, lambda n=name: getattr(over, n))
+    sched = getattr(broker, "flush_sched", None)
+    if sched is not None:
+        for name, help_ in (
+                ("flushes", "Coalesced writer-wake flush passes run"),
+                ("deferred", "Writer wakes parked for a flush pass"),
+                ("coalesced",
+                 "Duplicate same-iteration wakes absorbed by a park")):
+            registry.counter_func(
+                f"maxmq_broker_fanout_flush_{name}_total", help_,
+                lambda n=name: getattr(sched, n))
 
 
 def _register_matcher_metrics(registry: Registry, broker) -> None:

@@ -135,6 +135,16 @@ class Config:
                                         # 0 records every sampled publish
     trace_ring: int = 64                # flight-recorder entries kept
 
+    # -- zero-copy fan-out (ADR 019) ------------------------------------------
+    # assemble patched-template frame heads with the C encoder when the
+    # native extension loads (any native error falls back per call to
+    # the byte-identical Python builder); off forces pure Python
+    broker_native_encode: bool = True
+    # coalesce writer-task wake-ups to one per event-loop iteration so
+    # a 1->N fan-out wakes each subscriber's writer once with its full
+    # backlog queued; off restores the per-enqueue direct wake
+    broker_flush_coalesce: bool = True
+
     # -- persistence --------------------------------------------------------
     storage_backend: str = ""           # "" | memory | sqlite
     storage_path: str = "maxmq.db"

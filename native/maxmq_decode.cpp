@@ -45,6 +45,7 @@
 #include <algorithm>
 #include <cstddef>
 #include <cstdint>
+#include <cstring>
 #include <unordered_map>
 
 // write-intent prefetch (the union read-modify-writes its slot);
@@ -2363,6 +2364,69 @@ PyObject *slot_map_stats(PyObject *, PyObject *arg) {
                        t->slot_entries);
 }
 
+// ADR 019: the per-subscriber PUBLISH frame head — fixed-header flags
+// byte, remaining-length varint, topic segment, optional packet id,
+// optional property-length varint. The one fresh allocation a patched
+// template delivery makes; must stay byte-identical to the Python
+// builder in protocol/wire.py (_encode_head_py), which the
+// differential tests pin. props_len < 0 means a v3 frame (no
+// properties block); tail_len is the payload byte count following the
+// head and properties on the wire.
+inline int head_varint(uint8_t *dst, Py_ssize_t v) {
+  int n = 0;
+  do {
+    uint8_t b = static_cast<uint8_t>(v & 0x7f);
+    v >>= 7;
+    if (v) b |= 0x80;
+    dst[n++] = b;
+  } while (v);
+  return n;
+}
+
+PyObject *encode_publish_template(PyObject *, PyObject *args) {
+  int flags;
+  Py_buffer topic;
+  Py_ssize_t packet_id, props_len, tail_len;
+  if (!PyArg_ParseTuple(args, "iy*nnn", &flags, &topic, &packet_id,
+                        &props_len, &tail_len))
+    return nullptr;
+  Py_ssize_t remaining = topic.len + (packet_id ? 2 : 0) + tail_len;
+  uint8_t pbuf[5];
+  int pn = 0;
+  if (props_len >= 0) {
+    pn = head_varint(pbuf, props_len);
+    remaining += pn + props_len;
+  }
+  if (remaining > 268435455) {  // varint ceiling [MQTT-2.2.3]
+    PyBuffer_Release(&topic);
+    PyErr_SetString(PyExc_ValueError, "frame exceeds varint ceiling");
+    return nullptr;
+  }
+  uint8_t rbuf[5];
+  const int rn = head_varint(rbuf, remaining);
+  const Py_ssize_t total =
+      1 + rn + topic.len + (packet_id ? 2 : 0) + pn;
+  PyObject *out = PyBytes_FromStringAndSize(nullptr, total);
+  if (!out) {
+    PyBuffer_Release(&topic);
+    return nullptr;
+  }
+  auto *w =
+      reinterpret_cast<uint8_t *>(PyBytes_AS_STRING(out));
+  *w++ = static_cast<uint8_t>(flags);
+  std::memcpy(w, rbuf, rn);
+  w += rn;
+  std::memcpy(w, topic.buf, topic.len);
+  w += topic.len;
+  if (packet_id) {
+    *w++ = static_cast<uint8_t>((packet_id >> 8) & 0xff);
+    *w++ = static_cast<uint8_t>(packet_id & 0xff);
+  }
+  std::memcpy(w, pbuf, pn);
+  PyBuffer_Release(&topic);
+  return out;
+}
+
 PyMethodDef methods[] = {
     {"configure", configure, METH_VARARGS,
      "Register merge_subscription and the Subscription copy helper."},
@@ -2406,6 +2470,9 @@ PyMethodDef methods[] = {
     {"_slot_map_stats", slot_map_stats, METH_O,
      "(rows_with_slot_maps, slot_entries) for a table capsule — "
      "chained-decode anchor population observability."},
+    {"encode_publish_template", encode_publish_template, METH_VARARGS,
+     "Assemble one subscriber's PUBLISH frame head (ADR 019): "
+     "(flags, topic_seg, packet_id, props_len, tail_len) -> bytes."},
     {nullptr, nullptr, 0, nullptr}};
 
 PyModuleDef mod = {PyModuleDef_HEAD_INIT, "maxmq_decode",

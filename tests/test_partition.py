@@ -34,6 +34,7 @@ from maxmq_tpu.hooks.storage import MemoryStore, MessageRecord, StorageHook
 from maxmq_tpu.mqtt_client import MQTTClient
 from maxmq_tpu.protocol.codec import FixedHeader, PacketType as PT
 from maxmq_tpu.protocol.packets import Packet, Will
+from maxmq_tpu.protocol.properties import Properties
 
 
 @pytest.fixture(autouse=True)
@@ -453,6 +454,196 @@ async def test_reconnect_cancels_pending_will():
         assert entry is not None and entry.owner == "B"
         await wc2.close()
         await wc.close()
+
+
+async def test_parked_will_delay_survives_owner_death():
+    """ADR 019 satellite regression: the client disconnects abnormally
+    (will parked in the owner's ``_will_delays`` countdown) and THEN
+    the owner dies mid-countdown. Pre-fix the replicated entry stood
+    peers down at disconnect, losing the will cluster-wide; now the
+    disconnected entry keeps the will with its REMAINING delay and the
+    judge resumes the countdown — no early fire, exactly one fire."""
+    async with cluster(MESH) as (brokers, mgrs):
+        await links_converged(mgrs, MESH)
+        for m in mgrs.values():
+            m.sessions.will_grace = 0.3
+        sub_b = await connect(brokers["B"], "wd-sub-b")
+        await sub_b.subscribe(("dead/#", 1))
+        will = Will(topic="dead/wd-cli", payload=b"rip", qos=1,
+                    properties=Properties(will_delay=2))
+        wc = MQTTClient(client_id="wd-cli", version=5,
+                        clean_start=False, session_expiry=600,
+                        will=will)
+        await wc.connect("127.0.0.1", brokers["A"].test_port)
+        await wait_for(
+            lambda: "wd-cli" in mgrs["B"].sessions.ledger
+            and mgrs["B"].sessions.ledger["wd-cli"].will,
+            what="will replicated while connected")
+        await wc.close()                    # abnormal: will parks at A
+        await wait_for(
+            lambda: not mgrs["B"].sessions.ledger["wd-cli"].connected,
+            what="disconnect replicated")
+        entry = mgrs["B"].sessions.ledger["wd-cli"]
+        assert entry.will is not None, "parked will lost at disconnect"
+        assert 0.0 < float(entry.will[4]) <= 2.0   # REMAINING delay
+        assert "wd-cli" in brokers["A"]._will_delays
+        # the owner dies mid-countdown
+        faults.partition("A", "B")
+        faults.partition("A", "C")
+        await wait_for(lambda: not mgrs["B"].links["A"].connected,
+                       what="B sees A down")
+        await asyncio.sleep(0.6)    # past stagger, NOT past the delay
+        assert mgrs["B"].sessions.wills_fired == 0
+        assert mgrs["C"].sessions.wills_fired == 0
+        await wait_for(lambda: mgrs["B"].sessions.wills_fired
+                       + mgrs["C"].sessions.wills_fired == 1,
+                       timeout=8, what="resumed countdown fired once")
+        got_b = await drain(sub_b, timeout=1.0)
+        assert got_b.count(b"rip") == 1
+        await asyncio.sleep(0.8)            # no late second fire
+        assert (mgrs["B"].sessions.wills_fired
+                + mgrs["C"].sessions.wills_fired) == 1
+        await sub_b.close()
+        await wc.close()
+
+
+async def test_owner_local_delayed_will_fire_stands_replicas_down():
+    """The owner survives and its own ``_will_delays`` countdown
+    elapses: ``on_will_sent`` clears the replicated copy everywhere,
+    so a LATER owner death cannot fire the will a second time from a
+    stale entry."""
+    async with cluster({"A": ["B"], "B": ["A"]}) as (brokers, mgrs):
+        await links_converged(mgrs, {"A": ["B"], "B": ["A"]})
+        sub_b = await connect(brokers["B"], "lf-sub-b")
+        await sub_b.subscribe(("dead/#", 1))
+        will = Will(topic="dead/lf-cli", payload=b"rip", qos=1,
+                    properties=Properties(will_delay=1))
+        wc = MQTTClient(client_id="lf-cli", version=5,
+                        clean_start=False, session_expiry=600,
+                        will=will)
+        await wc.connect("127.0.0.1", brokers["A"].test_port)
+        await wait_for(
+            lambda: "lf-cli" in mgrs["B"].sessions.ledger
+            and mgrs["B"].sessions.ledger["lf-cli"].will,
+            what="will replicated")
+        await wc.close()
+        await wait_for(
+            lambda: (await_entry := mgrs["B"].sessions.ledger.get(
+                "lf-cli")) is not None and await_entry.will is not None
+            and not await_entry.connected,
+            what="parked will rides the disconnected entry")
+        # the owner's own countdown fires it locally (~1s)
+        got_b = await drain(sub_b, timeout=3.0)
+        assert got_b.count(b"rip") == 1     # delivered via forward
+        await wait_for(
+            lambda: mgrs["B"].sessions.ledger["lf-cli"].will is None,
+            what="on_will_sent replicated the stand-down")
+        assert not brokers["A"]._will_delays
+        assert mgrs["B"].sessions.wills_fired == 0
+        await sub_b.close()
+        await wc.close()
+
+
+async def test_takeover_cancels_parked_will_delay():
+    """The client reconnects AT A PEER while its will ticks in the old
+    owner's ``_will_delays``: the takeover eviction cancels the parked
+    will [MQTT-3.1.3-9] — no will fires anywhere."""
+    async with cluster(MESH) as (brokers, mgrs):
+        await links_converged(mgrs, MESH)
+        sub_c = await connect(brokers["C"], "tc-sub-c")
+        await sub_c.subscribe(("dead/#", 1))
+        will = Will(topic="dead/tc-cli", payload=b"rip", qos=1,
+                    properties=Properties(will_delay=2))
+        wc = MQTTClient(client_id="tc-cli", version=5,
+                        clean_start=False, session_expiry=600,
+                        will=will)
+        await wc.connect("127.0.0.1", brokers["A"].test_port)
+        await wait_for(lambda: "tc-cli" in mgrs["B"].sessions.ledger,
+                       what="replicated")
+        await wc.close()                    # parks the will at A
+        await wait_for(lambda: "tc-cli" in brokers["A"]._will_delays,
+                       what="will parked")
+        wc2 = MQTTClient(client_id="tc-cli", version=5,
+                         clean_start=False, session_expiry=600,
+                         will=Will(topic="dead/tc-cli", payload=b"rip"))
+        await wc2.connect("127.0.0.1", brokers["B"].test_port)
+        await wait_for(lambda: "tc-cli" not in brokers["A"]._will_delays,
+                       what="takeover cancelled the parked will")
+        await asyncio.sleep(2.4)            # past the original delay
+        assert await drain(sub_c, timeout=0.5) == []
+        for m in mgrs.values():
+            assert m.sessions.wills_fired == 0
+        await wc2.close()
+        await sub_c.close()
+
+
+def _scripted_entry(cid: str, owner: str, will_delay: float,
+                    connected: bool, expiry: int = 0) -> "SessionEntry":
+    from maxmq_tpu.cluster.sessions import SessionEntry
+    return SessionEntry(cid, owner, session_epoch=3, boot_epoch=7,
+                        expiry=expiry, expiry_set=bool(expiry),
+                        connected=connected,
+                        will=["dead/" + cid, b"rip".hex(), 1, 0,
+                              will_delay])
+
+
+async def test_scripted_clock_will_countdown_resume():
+    """Deterministic ``_sweep_entry`` arithmetic (no sleeps): a
+    disconnected entry's will fires when BOTH the judge stagger (from
+    owner death) and the remaining delay (from the disconnect the
+    judge observed) have elapsed — not before either, not restarted
+    from owner death — and a connected entry keeps the ADR-018 clock
+    (stagger + full delay from death)."""
+    pair = {"A": ["B"], "B": ["A"]}
+    async with cluster(pair) as (brokers, mgrs):
+        fed = mgrs["B"].sessions
+        fed.will_grace = 0.3
+        fed._started_mono = 1000.0      # owner "Z" death observed here
+        # -- disconnected entry: countdown resumes from the observed
+        #    disconnect, NOT from owner death
+        e = _scripted_entry("sc-d", "Z", will_delay=5.0, connected=False)
+        e.disconnected_seen = 990.0     # disconnected 10s before death
+        fed.ledger["sc-d"] = e
+        fed._sweep_entry(e, 1000.2, rank=0)     # stagger not elapsed
+        assert e.will is not None and fed.wills_fired == 0
+        # stagger elapsed AND 990+5 delay long since elapsed -> fire.
+        # (pre-fix: disconnected entries never fired; a restart-at-
+        # death bug would demand now >= 1000 + 0.3 + 5.0)
+        fed._sweep_entry(e, 1000.4, rank=0)
+        assert e.will is None and fed.wills_fired == 1
+        # -- disconnected entry whose remaining delay is NOT yet up
+        e2 = _scripted_entry("sc-r", "Z", will_delay=5.0,
+                             connected=False)
+        e2.disconnected_seen = 998.0
+        fed.ledger["sc-r"] = e2
+        fed._sweep_entry(e2, 1002.0, rank=0)    # 4.0 of 5.0 elapsed
+        assert e2.will is not None and fed.wills_fired == 1
+        # a rank-1 judge staggers the FIRE instant (delay + one grace):
+        # every judge's countdown expires at the same moment, so the
+        # stand-down window must sit between the ranks' fire times
+        fed._sweep_entry(e2, 1003.2, rank=1)    # 5.2 < 5.0 + 0.3
+        assert e2.will is not None and fed.wills_fired == 1
+        fed._sweep_entry(e2, 1003.1, rank=0)    # 5.1 of 5.0 -> fires
+        assert e2.will is None and fed.wills_fired == 2
+        # -- connected entry: unchanged ADR-018 clock, death + stagger
+        #    + full delay (rank stagger honored)
+        e3 = _scripted_entry("sc-c", "Z", will_delay=2.0, connected=True)
+        fed.ledger["sc-c"] = e3
+        fed._sweep_entry(e3, 1002.2, rank=1)    # 2.2 < 0.6 + 2.0
+        assert e3.will is not None and fed.wills_fired == 2
+        fed._sweep_entry(e3, 1002.7, rank=1)    # 2.7 >= 2.6 -> fires
+        assert e3.will is None and fed.wills_fired == 3
+        # -- expiring entry fires its pending will on the way out
+        e4 = _scripted_entry("sc-x", "Z", will_delay=600.0,
+                             connected=False, expiry=1)
+        e4.disconnected_seen = 999.0
+        fed.ledger["sc-x"] = e4
+        fed._sweep_entry(e4, 1000.5, rank=0)    # expiry 1s + stagger up
+        assert fed.wills_fired == 4 and e4.will is None
+        assert fed.replica_expiries == 1
+        assert "sc-x" not in fed.ledger
+        for cid in ("sc-d", "sc-r", "sc-c"):
+            fed.ledger.pop(cid, None)
 
 
 async def test_replica_expiry_purges_dead_owners_sessions():
