@@ -1955,6 +1955,43 @@ def bench_cluster_federation(msgs: int = 400) -> dict:
     return d
 
 
+def bench_macroday(scale: float = 1.0) -> dict:
+    """ADR-020 composed production-day scenario (MAXMQ_BENCH_CONFIGS=
+    macroday): the harness/macroday.py scheduler replays a compressed
+    fleet day on a live 3-node mesh with cluster_fwd_durability=
+    chained — concurrent connect storm, QoS1 fan-in/fan-out, a wedged
+    consumer driving the shed ladder, subscription churn, a directed
+    partition + heal with the tracked stream relaying under the
+    hop-chained barrier, and a node kill with a will + parked session
+    window — scored against one machine-checkable SLO sheet whose
+    loss/recovery fields bench_compare gates on."""
+    import asyncio
+
+    from maxmq_tpu import faults
+
+    from harness.macroday import MacroDay
+
+    def n(base: int, floor: int) -> int:
+        return max(floor, int(base * scale))
+
+    try:
+        d = asyncio.run(MacroDay(
+            storm_clients=n(24, 9), telemetry_msgs=n(30, 6),
+            command_msgs=n(20, 5), cut_msgs=n(20, 6),
+            parked_msgs=n(30, 8)).run())
+    finally:
+        faults.clear()      # a leaked armed fault must not outlive this
+    log(f"[macroday] pass={d['pass']} "
+        f"loss={d['pubacked_loss']}/{d['pubacked_total']} "
+        f"wills={d['wills_fired']} "
+        f"takeover={d['takeover_recovery_ms']}ms "
+        f"heal={d['heal_convergence_ms']}ms "
+        f"shed-recover={d['shed_recover_ms']}ms "
+        f"relay-waits={d['relay_chain_waits']} "
+        f"violations={d['violations']}")
+    return d
+
+
 def bench_failover(parked: int = 50, share_msgs: int = 60) -> dict:
     """ADR-016 session-federation measurement (MAXMQ_BENCH_CONFIGS=
     failover): a 3-node line A-B-C with cluster_session_sync=always.
@@ -2511,6 +2548,11 @@ def main() -> None:
                      lambda: bench_failover(
                          parked=max(10, int(50 * scale)),
                          share_msgs=max(12, int(60 * scale)))))
+    if "macroday" in which:
+        # ADR-020 composed production-day scenario: every fault ladder
+        # armed concurrently on a 3-node mesh, scored against one SLO
+        # sheet (loss=0, will exactly-once, recovery times)
+        runs.append(("macroday", lambda: bench_macroday(scale=scale)))
     if "5" in which:
         runs.append(("cluster", lambda: bench_cluster(subs=s(100_000))))
     if "e2e" in which:
@@ -2596,7 +2638,7 @@ CONFIG_DEADLINES = {"1": 900, "2": 900, "3": 1200, "4": 2400,
                     "latdo": 1200, "5": 2400, "e2e": 4200,
                     "widthab": 1200, "degraded": 1200, "overload": 900,
                     "cluster": 900, "durable": 900, "failover": 900,
-                    "fanout": 900}
+                    "fanout": 900, "macroday": 900}
 
 
 def run_supervised(which: list[str]) -> None:

@@ -1,0 +1,12 @@
+"""Composed-fault macro-scenario harnesses (ADR 020).
+
+Single-subsystem tests prove each degradation ladder in isolation;
+the harnesses here compose them — connect storms, overload shed,
+subscription churn, node kills, and partitions running CONCURRENTLY
+on a live multi-node cluster — and score the run against one
+machine-checkable SLO sheet.
+"""
+
+from .macroday import MacroDay
+
+__all__ = ["MacroDay"]

@@ -2350,6 +2350,19 @@ class Broker:
             "$SYS/broker/cluster/partition_drops":
                 (getattr(mgr, "partition_drops_in", 0)
                  + getattr(mgr, "partition_drops_out", 0)),
+            # ADR 020: hop-chained relay durability + blip audit
+            "$SYS/broker/cluster/relay_chain_waits":
+                getattr(mgr, "relay_chain_waits", 0),
+            "$SYS/broker/cluster/relay_chain_timeouts":
+                getattr(mgr, "relay_chain_timeouts", 0),
+            "$SYS/broker/cluster/blips_detected":
+                getattr(mgr, "blips_detected", 0),
+            "$SYS/broker/cluster/blip_resyncs":
+                getattr(mgr, "blip_resyncs", 0),
+            "$SYS/broker/cluster/route_sync_waits":
+                getattr(mgr, "route_sync_waits", 0),
+            "$SYS/broker/cluster/route_sync_timeouts":
+                getattr(mgr, "route_sync_timeouts", 0),
         }
         # ADR 017: per-peer health — link state, staleness, queue
         # pressure, replication lag and the clock-skew estimate, the

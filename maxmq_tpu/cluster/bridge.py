@@ -115,6 +115,13 @@ class BridgeLink:
         self.parked_dropped = 0     # oldest shed past PARKED_MAX
         self.parked_resent = 0
         self.partition_drops = 0    # writer items the fault blackholed
+        # ADR 020 sub-keepalive blip detection: per-connection monotonic
+        # heartbeat seq + cumulative data-item enqueue count (both reset
+        # at connect — the peer's fresh server-side client resets its
+        # mirror), and the debounce stamp for peer-reported blips
+        self.hb_seq = 0
+        self.items_sent = 0
+        self.last_blip_resync = 0.0
         self._task: asyncio.Task | None = None
         self._closed = False
 
@@ -192,6 +199,8 @@ class BridgeLink:
         await client.connect(self.spec.host, self.spec.port,
                              timeout=self.connect_timeout)
         self.client = client
+        self.hb_seq = 0             # fresh connection, fresh audit frame
+        self.items_sent = 0
         self.connected = True
         self.manager.membership.note_up(self.peer)
         self.manager.on_link_up(self)
@@ -286,6 +295,7 @@ class BridgeLink:
     async def _keepalive_loop(self, client: MQTTClient) -> None:
         while True:
             await asyncio.sleep(self.keepalive)
+            self._send_hb()
             await self._fire_link_fault()
             await self._fire_partition(liveness=True)
             await client.ping(timeout=self.connect_timeout)
@@ -293,6 +303,28 @@ class BridgeLink:
             # ADR 017: the proved-alive link refreshes its clock-skew
             # estimate at the keepalive cadence
             self.manager.on_link_alive(self)
+
+    def _send_hb(self) -> None:
+        """ADR 020: one audit heartbeat through the WRITER QUEUE (so it
+        crosses the same partition drop site the data does — a healed
+        blip shows as a seq gap), carrying this connection's monotonic
+        seq and the cumulative data-item enqueue count. FIFO order
+        makes the claim exact: everything counted in ``n`` was written
+        (or blackholed) before this heartbeat. Uncounted on both ends;
+        a full queue just skips the beat. Capability-gated like every
+        post-013 wire kind: a pre-020 peer that never announced
+        ``blip-hb`` is not sent frames it would count as rejected."""
+        if not self.manager._peer_has_cap(self.peer, "blip-hb"):
+            return
+        payload = json.dumps({"seq": self.hb_seq + 1,
+                              "n": self.items_sent}).encode()
+        wire = self._encode_publish(f"$cluster/hb/{self.node_id}",
+                                    payload, 0, False)
+        try:
+            self.outbound.put_nowait(wire, len(wire))
+        except asyncio.QueueFull:
+            return
+        self.hb_seq += 1
 
     # ------------------------------------------------------------------
     # Enqueue side (called synchronously from the fan-out path)
@@ -367,6 +399,7 @@ class BridgeLink:
             self.outbound.put_nowait(wire, len(wire))
         except asyncio.QueueFull:
             return False
+        self.items_sent += 1    # ADR 020: audited by the heartbeat
         return True
 
     def _fwd_ack_cb(self, topic: str, payload: bytes, park: bool,
@@ -499,14 +532,19 @@ class BridgeLink:
                     f.cancel()
             return False
         self.session_sent += 1
+        self.items_sent += 1    # ADR 020: audited by the heartbeat
         return True
 
     def send_control(self, topic: str, payload: bytes,
-                     retain: bool = False) -> bool:
+                     retain: bool = False,
+                     counted: bool = True) -> bool:
         """Enqueue a route/control message. Budget-exempt (dropping
         route deltas to save bytes would desync the mesh — the same
         reasoning that exempts acks from the broker's client budgets),
-        but still accounted on the ledgers."""
+        but still accounted on the ledgers. ``counted=False`` keeps a
+        message out of the ADR-020 heartbeat audit — only for the audit
+        plane's OWN messages (blip notices), which the receiver equally
+        excludes from its mirror count."""
         if not self.connected or self.client is None:
             return False
         wire = self._encode_publish(topic, payload, 0, retain)
@@ -515,4 +553,6 @@ class BridgeLink:
         except asyncio.QueueFull:
             return False
         self.control_sent += 1
+        if counted:
+            self.items_sent += 1    # ADR 020: audited by the heartbeat
         return True

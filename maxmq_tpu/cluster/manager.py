@@ -36,6 +36,7 @@ namespace; ordinary clients cannot publish ``$`` topics):
 
 from __future__ import annotations
 
+import asyncio
 import json
 import time
 from collections import deque
@@ -100,9 +101,10 @@ class ClusterManager:
             raise ValueError(f"bad cluster node id {node_id!r}")
         if any(p.node_id == node_id for p in peers):
             raise ValueError("cluster_peers lists this node itself")
-        if fwd_durability not in ("coupled", "always", "off"):
+        if fwd_durability not in ("coupled", "always", "chained", "off"):
             raise ValueError(f"unknown cluster_fwd_durability "
-                             f"{fwd_durability!r} (want coupled/always/off)")
+                             f"{fwd_durability!r} "
+                             f"(want coupled/always/chained/off)")
         if share_balance not in ("weighted", "pin"):
             raise ValueError(f"unknown cluster_share_balance "
                              f"{share_balance!r} (want weighted/pin)")
@@ -179,6 +181,33 @@ class ClusterManager:
                                         # that failed to parse at boot
         self.partition_drops_in = 0     # inbound $cluster messages the
                                         # partition site dropped in flight
+        # chained multi-hop durability (ADR 020): relay-side upstream
+        # PUBACKs held for the downstream forward chain
+        self.relay_chain_waits = 0      # relayed fwds whose upstream ack
+                                        # waited on the downstream chain
+        self.relay_chain_timeouts = 0   # relay waits released degraded
+                                        # by the bounded timeout
+        # sub-keepalive blip detection (ADR 020): heartbeat-gap resyncs
+        self.blip_resyncs = 0           # debounced resyncs triggered by
+                                        # a peer's blip notice
+        self.blips_detected = 0         # hb seq gaps / item deficits
+                                        # seen on inbound links
+        # relay route-sync gate (ADR 020): a freshly restarted relay
+        # can receive an upstream's parked-forward drain BEFORE the
+        # downstream peer's route snapshot arrives — it would fan out
+        # to nobody, relay nothing onward, and still ack upstream,
+        # losing a PUBACKed message forever. Inbound forwards wait
+        # (bounded) until every configured peer's first route
+        # advertisement landed; a node with fewer than two peers can
+        # never relay and is ready immediately.
+        self.route_sync_waits = 0       # inbound fwds held for the
+                                        # initial route convergence
+        self.route_sync_timeouts = 0    # holds released degraded by
+                                        # the bounded timeout
+        self._route_synced: set[str] = set()
+        self._routes_ready = asyncio.Event()
+        if len(self.links) < 2:
+            self._routes_ready.set()
 
     # ------------------------------------------------------------------
     # Lifecycle (driven by Broker.serve / Broker.close)
@@ -422,13 +451,23 @@ class ClusterManager:
     @property
     def fwd_coupled(self) -> bool:
         """ADR 018: the publisher's QoS ack additionally waits (bounded)
-        on the peers' forward PUBACKs — ``always``, or ``coupled`` when
-        ``cluster_session_sync=always`` already couples acks to peers."""
-        if self.fwd_durability == "always":
+        on the peers' forward PUBACKs — ``always``/``chained``, or
+        ``coupled`` when ``cluster_session_sync=always`` already couples
+        acks to peers."""
+        if self.fwd_durability in ("always", "chained"):
             return True
         return (self.fwd_durability == "coupled"
                 and self.sessions is not None
                 and self.sessions.sync == "always")
+
+    @property
+    def fwd_chained(self) -> bool:
+        """ADR 020: relays extend the fwd-ack chain hop-by-hop — a relay
+        PUBACKs its upstream only after its own onward forwards are
+        acked or journal-parked, so the publisher's released PUBACK
+        covers the whole route (a 3-node line, not just direct peers).
+        Each hop's wait is bounded by ``fwd_timeout``."""
+        return self.fwd_durability == "chained"
 
     def maybe_forward(self, packet: Packet) -> None:
         """Forward one locally fanned-out publish to every peer whose
@@ -436,7 +475,17 @@ class ClusterManager:
         remote subscriber finds them), once per peer, guarded by the
         origin/hop rails. Under ADR-018 fwd durability QoS>0 publishes
         ride QoS1 on the link (parked when stranded) and their PUBACK
-        futures are collected on the packet for the ack barrier."""
+        futures are collected on the packet for the ack barrier. The
+        relay-chain future a chained ``_handle_fwd`` planted (ADR 020)
+        is settled on EVERY exit — including the no-target and
+        hop-capped early returns — or the relay's bounded upstream-ack
+        wait would always run to its timeout."""
+        try:
+            self._forward_targets(packet)
+        finally:
+            self._settle_relay(packet)
+
+    def _forward_targets(self, packet: Packet) -> None:
         topic = packet.topic
         if topic.startswith("$"):
             return
@@ -451,6 +500,12 @@ class ClusterManager:
             return
         if hops >= self.max_hops:
             self.hops_dropped += 1
+            # per-origin stage attribution (ADR 015): a hop-capped drop
+            # is explained cross-node loss — the macroday harness
+            # asserts no loss is counted ONLY by the aggregate
+            tracer = getattr(self.broker, "tracer", None)
+            if tracer is not None:
+                tracer.note_error("bridge", "hop_cap")
             return
         park = self.fwd_park_active and packet.fixed.qos > 0
         qos = 1 if park else min(packet.fixed.qos, self.link_qos)
@@ -541,6 +596,28 @@ class ClusterManager:
         loop.call_later(self.fwd_timeout, _timeout)
         return fut
 
+    def _settle_relay(self, packet: Packet) -> None:
+        """ADR 020 (chained durability): resolve the relay-chain future
+        ``_handle_fwd`` planted on a relayed publish once this node's
+        own onward forwards are durable. No onward targets (or dedup'd/
+        hop-capped copies) resolve immediately; otherwise the standard
+        ``fwd_barrier`` — bounded by ``fwd_timeout``, degrades counted —
+        is chained into it, so the upstream PUBACK releases exactly when
+        a local publisher's would."""
+        fut = packet.__dict__.pop("_relay_chain", None)
+        if fut is None or fut.done():
+            return
+        barrier = self.fwd_barrier(fut.get_loop(), packet)
+        if barrier is None:
+            fut.set_result(None)
+            return
+
+        def _done(_f) -> None:
+            if not fut.done():
+                fut.set_result(None)
+
+        barrier.add_done_callback(_done)
+
     def _fwd_pending(self, waits: list) -> list:
         """Split one publish's forward-ack futures: already-failed ones
         (refused at enqueue -> parked for retry-after-heal) count a
@@ -590,6 +667,18 @@ class ClusterManager:
         sender = client.id[len(BRIDGE_ID_PREFIX):]
         levels = packet.topic.split("/")
         kind = levels[1] if len(levels) > 1 else ""
+        if kind == "hb" and len(levels) == 3:
+            # counted OUTSIDE _cluster_rx on both ends: heartbeats
+            # audit the data stream, they are not part of it
+            self._handle_hb(client, sender, levels, packet)
+            return
+        if kind == "blip" and len(levels) == 3:
+            self._handle_blip(sender, levels)
+            return
+        # per-connection inbound data count (ADR 020 blip detection):
+        # compared against the sender's enqueue count carried on its
+        # next heartbeat — a deficit is sub-keepalive in-flight loss
+        client._cluster_rx = getattr(client, "_cluster_rx", 0) + 1
         if kind == "fwd" and len(levels) >= 8:
             await self._handle_fwd(client, sender, levels, packet)
         elif kind == "routes" and len(levels) >= 3:
@@ -624,6 +713,85 @@ class ClusterManager:
             self.telemetry.handle_trace(sender, levels, packet)
         else:
             self.inbound_rejected += 1
+
+    def _handle_hb(self, client, sender: str, levels: list[str],
+                   packet: Packet) -> None:
+        """ADR 020 (sub-keepalive blip detection, receive side): one
+        per-link heartbeat — monotonic per-connection seq plus the
+        sender's cumulative data-item enqueue count. A seq gap (a
+        heartbeat itself was blackholed) or an item deficit (data
+        enqueued before this heartbeat never arrived on the FIFO
+        stream) means the path dropped bytes WITHOUT flapping the link:
+        notify the sender over our own outbound link so it resyncs.
+        The count re-baselines to the sender's after a detection — only
+        NEW loss re-triggers, so a healed blip costs one notice."""
+        if levels[2] != sender:
+            self.inbound_rejected += 1      # spoofed identity
+            return
+        try:
+            d = json.loads(packet.payload)
+            seq, n_sent = int(d["seq"]), int(d["n"])
+        except Exception:
+            self.inbound_rejected += 1
+            return
+        rx = getattr(client, "_cluster_rx", 0)
+        last_seq = getattr(client, "_hb_seq", 0)
+        client._hb_seq = seq
+        if seq > last_seq + 1 or rx < n_sent:
+            self.blips_detected += 1
+            client._cluster_rx = n_sent     # re-baseline
+            link = self.links.get(sender)
+            if link is not None and link.connected:
+                link.send_control(f"$cluster/blip/{self.node_id}", b"",
+                                  counted=False)
+            if self.log is not None:
+                self.log.warn("cluster blip detected", peer=sender,
+                              hb_gap=seq - last_seq - 1,
+                              item_deficit=max(n_sent - rx, 0))
+
+    def _handle_blip(self, sender: str, levels: list[str]) -> None:
+        """ADR 020 (blip detection, send side): the peer saw a gap on
+        OUR link to it — some of what we enqueued vanished in flight
+        while the connection stayed up, the loss class a keepalive-
+        driven flap can never catch. Debounced per link (one resync per
+        keepalive window): fail the pending forward PUBACK futures so
+        their park-on-failure callbacks journal the copies, re-snapshot
+        the routes, resync sessions, and drain the parked forwards —
+        the receiver's per-(origin, epoch) dedup keeps it at-most-once."""
+        if levels[2] != sender:
+            self.inbound_rejected += 1
+            return
+        link = self.links.get(sender)
+        if link is None:
+            return
+        now = time.monotonic()
+        if now - link.last_blip_resync < link.keepalive:
+            return      # debounce: one resync per keepalive window
+        link.last_blip_resync = now
+        self.blip_resyncs += 1
+        client = link.client
+        if client is not None:
+            from ..mqtt_client import MQTTError
+            # ONLY the forward PUBACK futures: a blanket sweep would
+            # also fail an in-flight PINGRESP future and the keepalive
+            # loop's ping await would tear the link down — the exact
+            # flap the resync exists to avoid
+            for key in [k for k, f in client._acks.items()
+                        if k[0] == PT.PUBACK and not f.done()]:
+                fut = client._acks.pop(key)
+                fut.set_exception(MQTTError("blip resync"))
+        link.needs_snapshot = True
+        self._refresh_advertisements()
+        if self.sessions is not None:
+            self.sessions.on_link_up(link)
+        if self.fwd_park_active:
+            # the failed acks re-park through done-callbacks the
+            # event loop runs via call_soon — defer the drain one
+            # loop pass so it sees the re-parked copies, not an
+            # empty buffer
+            asyncio.get_running_loop().call_soon(link.drain_parked)
+        if self.log is not None:
+            self.log.warn("cluster blip resync", peer=sender)
 
     def _handle_hello(self, sender: str, levels: list[str],
                       packet: Packet) -> None:
@@ -678,6 +846,8 @@ class ClusterManager:
             return
         if not self._admit_fwd(origin, epoch, msgid):
             return
+        if not self._routes_ready.is_set() and self.fwd_park_active:
+            await self._await_route_sync()
         out = Packet(fixed=FixedHeader(type=PT.PUBLISH, qos=qos,
                                        retain=retain),
                      topic=topic, payload=packet.payload,
@@ -690,6 +860,16 @@ class ClusterManager:
         if retain:
             self.broker.retain_message(client, out)
         self.forwards_delivered += 1
+        relay_fut = None
+        if self.fwd_chained and packet.fixed.qos > 0:
+            # ADR 020: the upstream sent this leg QoS1 and its barrier
+            # counts OUR PUBACK — plant the chain future maybe_forward
+            # settles once the onward forwards are acked/parked, and
+            # hold the upstream ack (bounded) on it below. Dedup'd
+            # duplicates returned above already acked immediately, so
+            # a cyclic mesh cannot chain waits into a loop.
+            relay_fut = asyncio.get_running_loop().create_future()
+            out._relay_chain = relay_fut
         tr = self._adopt_trace(sender, origin, trace_ctx, out, hops)
         try:
             # re-enters the normal local fan-out (order-preserving
@@ -705,6 +885,48 @@ class ClusterManager:
                 self.broker.tracer.finish(tr)
             raise
         self._finish_adopted(tr)
+        if relay_fut is not None:
+            await self._await_relay_chain(relay_fut)
+
+    async def _await_route_sync(self) -> None:
+        """ADR 020: hold an inbound forward until this node's FIRST
+        route convergence — every configured peer advertised once —
+        so a relay restarted mid-heal doesn't apply an upstream's
+        parked-forward drain against an empty route table (fan out to
+        nobody, ack upstream, PUBACKed message gone). Bounded like the
+        relay chain itself; a peer that never comes up degrades the
+        gate once, permanently, counted — never a wedge."""
+        self.route_sync_waits += 1
+        try:
+            await asyncio.wait_for(self._routes_ready.wait(),
+                                   self.fwd_timeout * 2)
+        except asyncio.TimeoutError:
+            self.route_sync_timeouts += 1
+            self._routes_ready.set()
+
+    def _note_route_sync(self, node: str) -> None:
+        if self._routes_ready.is_set():
+            return
+        self._route_synced.add(node)
+        if self._route_synced >= set(self.links):
+            self._routes_ready.set()
+
+    async def _await_relay_chain(self, relay_fut) -> None:
+        """ADR 020: hold the upstream PUBACK for this relayed forward
+        until the onward chain settles — bounded by ``fwd_timeout`` on
+        top of the barrier's own timeout (pipeline mode fans out from
+        the consumer task, so the barrier may not even EXIST yet when
+        the inbound handler gets here). A timeout releases the ack
+        degraded + counted: the upstream's publisher sees bounded
+        latency, never a wedge, and the parked/journaled copies keep
+        the retry-after-heal promise."""
+        self.relay_chain_waits += 1
+        try:
+            await asyncio.wait_for(asyncio.shield(relay_fut),
+                                   self.fwd_timeout * 2)
+        except asyncio.TimeoutError:
+            self.relay_chain_timeouts += 1
+            self.fwd_barrier_degraded += 1
 
     def _admit_fwd(self, origin: str, epoch: int, msgid: int) -> bool:
         """Epoch-scoped per-origin dedup (ADR 013): a fresh incarnation
@@ -797,6 +1019,7 @@ class ClusterManager:
             return
         if self.routes.apply_snapshot(node, epoch, seq, filters):
             self.snapshots_applied += 1
+            self._note_route_sync(node)
             self.membership.note_alive(node)
             st = self.membership.get(node)
             if st is not None:
@@ -811,6 +1034,7 @@ class ClusterManager:
             return
         if self.routes.apply_delta(node, epoch, seq, add, rem):
             self.deltas_applied += 1
+            self._note_route_sync(node)
             self.membership.note_alive(node)
             self._schedule_refresh()
         else:

@@ -143,8 +143,12 @@ class SessionEntry:
         # delay_s] while the owner's client is connected with a will —
         # or, once disconnected, while the will sits in the owner's
         # _will_delays countdown with delay_s the REMAINING delay
-        # (ADR 019 satellite) — else None. A replica can fire it if
-        # the owner node dies.
+        # (ADR 019 satellite) plus a 6th element: the absolute
+        # wall-clock DEADLINE (ADR 020 satellite), so a judge that
+        # applied the entry cold (restart, late resync — no local
+        # disconnect observation) still fires on the owner's schedule
+        # instead of re-charging the full delay — else None. A replica
+        # can fire it if the owner node dies.
         self.will = list(will) if will else None
         self.inflight: dict[int, str] = {}
         self.pubrec: list[int] = []
@@ -242,6 +246,9 @@ class SessionFederation(Hook):
         self._share_counts: dict[str, dict[tuple[str, str], int]] = {}
         self._started = False
         self._started_mono = 0.0
+        # wall clock, swappable so scripted-clock tests can drive the
+        # replicated will-DEADLINE comparison (ADR 020 satellite)
+        self._wall = time.time
         self._expiry_task: asyncio.Task | None = None
 
         # counters (read tear-free by the metrics scrape thread)
@@ -625,9 +632,12 @@ class SessionFederation(Hook):
             parked = self.broker._will_delays.get(client.id)
             if parked is not None:
                 due, wp = parked
+                # 6th element (ADR 020 satellite): the ABSOLUTE
+                # wall-clock deadline, so a cold-applied replica fires
+                # on schedule instead of re-charging the duration
                 will = [wp.topic, wp.payload.hex(), int(wp.fixed.qos),
                         int(wp.fixed.retain),
-                        max(due - time.time(), 0.0)]
+                        max(due - self._wall(), 0.0), float(due)]
         return SessionEntry(
             client.id, self.node_id, epoch, self.broker.boot_epoch,
             p.session_expiry, p.session_expiry_set, p.protocol_version,
@@ -1203,10 +1213,26 @@ class SessionFederation(Hook):
                         and now - entry.disconnected_seen
                         >= delay + self.will_grace * rank):
                     self._fire_replica_will(entry)
-            elif down_for >= stagger + delay:
-                # no observed disconnect instant (entry applied cold,
-                # e.g. judge joined later): fall back to owner death
-                self._fire_replica_will(entry)
+            else:
+                # no observed disconnect instant (entry applied cold:
+                # judge restarted or joined late). ADR 020 satellite —
+                # prefer the replicated wall-clock DEADLINE (6th
+                # element) so the fire stays on the owner's original
+                # schedule; restarting the countdown at owner death
+                # double-charged the delay. 5-element entries from
+                # older peers keep the duration fallback.
+                wd = None
+                if len(entry.will) > 5:
+                    try:
+                        wd = float(entry.will[5])
+                    except (TypeError, ValueError):
+                        wd = None
+                if wd is not None:
+                    if (down_for >= stagger and self._wall()
+                            >= wd + self.will_grace * rank):
+                        self._fire_replica_will(entry)
+                elif down_for >= stagger + delay:
+                    self._fire_replica_will(entry)
         self._maybe_expire(entry, now, down_for, stagger)
 
     def _maybe_expire(self, entry: SessionEntry, now: float,

@@ -42,7 +42,8 @@ from ..metrics import _fmt, _lbl    # the shared exposition formatters
 
 # what this build can parse; announced on $cluster/hello at link-up.
 # A peer that never announced "fwd-trace" receives pre-017 envelopes.
-WIRE_CAPS = ("fwd-trace", "telemetry", "clock", "trace-return")
+WIRE_CAPS = ("fwd-trace", "telemetry", "clock", "trace-return",
+             "blip-hb")
 
 TELEMETRY_MAX_KEYS = 48     # snapshot cardinality bound (per node)
 TRACE_SPANS_MAX = 16        # spans carried per returned report

@@ -564,7 +564,26 @@ def _register_cluster_metrics(registry: Registry, broker) -> None:
              "dropped in flight (ADR 018 chaos harness)"),
             ("partition_drops_out",
              "Outbound bridge wire items the cluster.partition fault "
-             "blackholed (ADR 018 chaos harness)")):
+             "blackholed (ADR 018 chaos harness)"),
+            ("relay_chain_waits",
+             "Relayed forwards whose upstream PUBACK waited on the "
+             "ADR-020 hop-chained downstream barrier"),
+            ("relay_chain_timeouts",
+             "Relay-chain waits released degraded by the bounded "
+             "timeout"),
+            ("blips_detected",
+             "Sub-keepalive loss blips detected on inbound links "
+             "(ADR 020 heartbeat seq gap / item deficit)"),
+            ("blip_resyncs",
+             "Debounced link resyncs triggered by a peer's blip "
+             "notice (routes + sessions resync, parked-forward "
+             "resend)"),
+            ("route_sync_waits",
+             "Inbound forwards held for this node's initial route "
+             "convergence (ADR 020 restarted-relay gate)"),
+            ("route_sync_timeouts",
+             "Route-sync holds released degraded by the bounded "
+             "timeout (a configured peer never advertised)")):
         registry.counter_func(f"maxmq_cluster_{name}_total", help_,
                               lambda n=name: getattr(mgr, n))
     registry.gauge_func(

@@ -16,11 +16,26 @@ trajectory handed to each round was empty. This tool:
    stanza's ``p99_ms`` tails participate);
 3. prints a per-config/per-metric delta table between the two rounds;
 4. exits non-zero when a **headline throughput** metric (``*per_sec*``,
-   higher-better) or a **p99 latency** metric (``*p99*``,
-   lower-better) regressed by more than ``--threshold`` (default 15%).
+   higher-better), a **p99 latency** metric (``*p99*``, lower-better),
+   or (ADR 020) a macroday **SLO-sheet** field — ``*loss*``,
+   ``*recover*``/``*convergence*`` times, ``*violation*`` counts, all
+   lower-better — regressed by more than ``--threshold``
+   (default 15%).
 
-CI runs it as a *report* step with ``--warn-only`` (exit 0 always);
-the blocking knob is removing that flag — see docs/observability.md.
+Latency (``*_ms``) metrics additionally carry an **absolute noise
+floor** (``--abs-floor-ms``, default 1.0): the trace stanzas' p99s
+come from one fully-sampled tail round, so on sub-millisecond stages
+the quantile is effectively the max of a handful of samples and
+run-to-run swings of 2-5x are scheduler noise, not regressions. A
+``*_ms`` move only gates when it exceeds the threshold *and* moved by
+at least the floor in absolute terms — real regressions in the gated
+recovery-time fields (hundreds of ms) clear a 1 ms floor trivially;
+0.1 -> 0.3 ms tail wobble does not. Sub-floor bad moves still print
+as ``worse`` in the table.
+
+CI runs the gate BLOCKING (since ADR 018); the
+``BENCH_COMPARE_WARN_ONLY`` env var falls back to report-only — see
+docs/observability.md.
 """
 
 from __future__ import annotations
@@ -133,18 +148,30 @@ def _direction(metric: str) -> int:
         return 1
     if m.endswith("_ms") or m.endswith("_s") or "latency" in m:
         return -1
+    # ADR 020: SLO-sheet counters — loss windows, recovery /
+    # convergence times, violation counts — are all lower-better
+    if "loss" in m or "recover" in m or "convergence" in m \
+            or "violation" in m:
+        return -1
     return 0
 
 
 def _gated(metric: str) -> bool:
-    """Only headline throughput and p99 tails gate the exit code."""
+    """Headline throughput, p99 tails, and (ADR 020) the macroday SLO
+    sheet's loss / recovery-time fields gate the exit code."""
     m = metric.lower()
-    return "per_sec" in m or "p99" in m
+    return ("per_sec" in m or "p99" in m or "loss" in m
+            or "recover" in m or "convergence" in m
+            or "violation" in m)
 
 
-def compare(old: dict, new: dict, threshold: float):
+def compare(old: dict, new: dict, threshold: float,
+            abs_floor_ms: float = 1.0):
     """-> (table_rows, regressions). A regression is a gated metric
-    moving >threshold in its bad direction."""
+    moving >threshold in its bad direction — and, for ``*_ms``
+    latencies, by at least ``abs_floor_ms`` in absolute terms (the
+    tail-round p99s are max-of-few-samples on sub-ms stages; see the
+    module docstring). Sub-floor bad moves flag ``worse`` only."""
     table, regressions = [], []
     for cfg in sorted(set(old) & set(new)):
         for metric in sorted(set(old[cfg]) & set(new[cfg])):
@@ -158,10 +185,14 @@ def compare(old: dict, new: dict, threshold: float):
                 delta = (b - a) / abs(a)
             bad = (d > 0 and delta < -threshold) or \
                   (d < 0 and delta > threshold)
+            gates = bad and _gated(metric)
+            if gates and metric.lower().endswith("_ms") \
+                    and (b - a) < abs_floor_ms:
+                gates = False
             flag = ""
             if bad:
-                flag = "REGRESSION" if _gated(metric) else "worse"
-                if _gated(metric):
+                flag = "REGRESSION" if gates else "worse"
+                if gates:
                     regressions.append((cfg, metric, a, b, delta))
             table.append((cfg, metric, a, b, delta, flag))
     return table, regressions
@@ -203,6 +234,11 @@ def main(argv=None) -> int:
     ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
                     help="regression threshold as a fraction "
                          "(default 0.15)")
+    ap.add_argument("--abs-floor-ms", type=float, default=1.0,
+                    help="*_ms metrics only gate when they also moved "
+                         "by at least this many ms (default 1.0) — "
+                         "sub-ms tail-round p99s are max-of-few-samples "
+                         "noise")
     ap.add_argument("--warn-only", action="store_true",
                     default=bool(os.environ.get("BENCH_COMPARE_WARN_ONLY")),
                     help="always exit 0 (report mode). CI runs the gate "
@@ -227,7 +263,8 @@ def main(argv=None) -> int:
               f"(old={len(old_rows)} cfgs, new={len(new_rows)} cfgs); "
               f"skipping", file=sys.stderr)
         return 0
-    table, regressions = compare(old_rows, new_rows, args.threshold)
+    table, regressions = compare(old_rows, new_rows, args.threshold,
+                                 args.abs_floor_ms)
     print(render(table, os.path.basename(old_path),
                  os.path.basename(new_path)))
 
@@ -236,7 +273,8 @@ def main(argv=None) -> int:
         good_doc = load_round(good_path)
         good_rows = extract_rows(good_doc) if good_doc else {}
         if good_rows:
-            ref_table, _ = compare(good_rows, new_rows, args.threshold)
+            ref_table, _ = compare(good_rows, new_rows, args.threshold,
+                                   args.abs_floor_ms)
             print()
             print(render(ref_table, "BENCH_TPU_LAST_GOOD.json",
                          os.path.basename(new_path)))

@@ -1,0 +1,95 @@
+"""ADR 020: macroday composed-fault harness smoke.
+
+One tiny-knob production day end to end — the full phase ladder
+(storm, fan-in/out, shed, churn, partition+heal, node kill) on a live
+3-node mesh with ``cluster_fwd_durability=chained`` — scored against
+the SLO sheet. The bench config runs the same harness at full knobs;
+this lane proves the scheduler, the fault arming, and the scoring stay
+healthy in under a minute (it also runs under the asyncio-debug CI
+lane, so a leaked task or un-retrieved future fails here first).
+
+Plus pure-arithmetic checks that scripts/bench_compare.py actually
+gates the sheet's loss / recovery fields (a rename there would
+silently un-gate the SLO row).
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from harness import MacroDay
+from maxmq_tpu import faults
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+async def test_macroday_smoke_slo_sheet_passes():
+    day = MacroDay(storm_clients=9, telemetry_msgs=6, command_msgs=5,
+                   cut_msgs=6, parked_msgs=8, keepalive=0.5,
+                   will_grace=1.0, settle_s=10.0)
+    sheet = await day.run()
+    assert sheet["pass"], f"SLO violations: {sheet['violations']}"
+    assert sheet["pubacked_loss"] == 0
+    assert sheet["pubacked_total"] > 0
+    assert sheet["wills_fired"] == 1
+    assert sheet["wills_delivered"] == 1
+    assert sheet["takeover_session_present"]
+    assert sheet["takeover_recovery_ms"] >= 0
+    assert sheet["heal_convergence_ms"] >= 0
+    assert sheet["shed_entered"] and sheet["shed_recovered"]
+    assert sheet["relay_chain_waits"] >= 1
+    # every phase ran, in order, and the fault-arming ones recorded
+    # their sites (the replayability contract: armed_sites + fired
+    # deltas make a failing day reproducible phase by phase)
+    names = [p["name"] for p in sheet["phases"]]
+    assert names == ["connect_storm", "fanin_fanout", "slow_consumer",
+                     "sub_churn", "partition_heal", "node_kill"]
+    by_name = {p["name"]: p for p in sheet["phases"]}
+    assert by_name["slow_consumer"]["armed_sites"]
+    assert by_name["partition_heal"]["armed_sites"]
+    assert any(p["fired"] for p in sheet["phases"])
+    # the sheet IS the bench row: it must survive the JSON round trip
+    json.loads(json.dumps(sheet))
+    # nothing left armed for the next test
+    assert not faults.REGISTRY.any_armed()
+
+
+def test_bench_compare_gates_slo_fields():
+    """The SLO sheet's loss / recovery / violation fields must be
+    lower-better AND gated, or the macroday row stops blocking."""
+    path = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "scripts", "bench_compare.py")
+    spec = importlib.util.spec_from_file_location("bench_compare_mod",
+                                                  path)
+    bc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bc)
+    _direction, _gated, compare = bc._direction, bc._gated, bc.compare
+
+    for metric in ("pubacked_loss", "takeover_recovery_ms",
+                   "heal_convergence_ms", "violations_count"):
+        assert _direction(metric) == -1, metric
+        assert _gated(metric), metric
+    # a zero-loss baseline regressing to ANY loss is inf delta -> gate
+    old = {"macroday": {"pubacked_loss": 0.0,
+                        "takeover_recovery_ms": 1000.0}}
+    new = {"macroday": {"pubacked_loss": 1.0,
+                        "takeover_recovery_ms": 1050.0}}
+    _table, regressions = compare(old, new, threshold=0.15)
+    assert [(c, m) for c, m, *_ in regressions] == \
+        [("macroday", "pubacked_loss")]
+    # the *_ms noise floor: a sub-ms tail tripling is sample noise
+    # (flagged worse, not gated); a recovery time regressing by real
+    # milliseconds still gates
+    old = {"x": {"trace.p99_ms": 0.1, "takeover_recovery_ms": 1000.0}}
+    new = {"x": {"trace.p99_ms": 0.3, "takeover_recovery_ms": 1400.0}}
+    table, regressions = compare(old, new, threshold=0.15)
+    assert [(c, m) for c, m, *_ in regressions] == \
+        [("x", "takeover_recovery_ms")]
+    assert [r for r in table if r[1] == "trace.p99_ms"][0][-1] == "worse"

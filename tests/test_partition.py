@@ -972,3 +972,280 @@ async def test_weighted_share_exactly_once_and_balanced():
         assert per_node["B"] > per_node["C"], per_node
         for m in list(members.values()) + [pub]:
             await m.close()
+
+
+# ----------------------------------------------------------------------
+# ADR 020: multi-hop chained forward durability + sub-keepalive blips
+# ----------------------------------------------------------------------
+
+
+async def test_chained_relay_kill_middle_zero_pubacked_loss():
+    """ADR 020 tentpole: on a 3-node line A-B-C with
+    ``cluster_fwd_durability=chained`` the relay (B) defers its
+    upstream fwd-PUBACK until its own downstream forward is acked or
+    journaled — so the publisher's PUBACK at A means the FAR node
+    holds the message, and killing the middle node after the PUBACK
+    loses nothing. A dark downstream leg must degrade bounded (the
+    ``fwd_barrier_*``/``relay_chain_*`` counters), never wedge the
+    publisher."""
+    async with cluster(LINE, fwd_durability="chained") as (brokers,
+                                                           mgrs):
+        await links_converged(mgrs, LINE)
+        sub = await connect(brokers["C"], "rl-sub")
+        await sub.subscribe(("rl/#", 1))
+        pub = await connect(brokers["A"], "rl-pub")
+        await wait_for(lambda: bool(mgrs["A"].routes.nodes_for("rl/m")),
+                       what="A learned the 2-hop route")
+        sent = []
+        for i in range(5):              # healthy leg, PUBACK-paced
+            await pub.publish("rl/m", f"h-{i}".encode(), qos=1)
+            sent.append(f"h-{i}".encode())
+        # hop-chained: by PUBACK time the relay has already collected
+        # its downstream ack — C holds every message NOW
+        assert mgrs["B"].relay_chain_waits >= 5
+        assert mgrs["B"].relay_chain_timeouts == 0
+        got = set(await drain(sub))
+        assert set(sent) <= got, "PUBACKed => already at the far node"
+
+        # dark downstream leg: B parks the relayed copies; the chain
+        # settles immediately (parked == journal-bound), PUBACK stays
+        # bounded and the degrade is counted — never a wedge
+        faults.partition("B", "C")
+        await wait_for(lambda: not mgrs["B"].links["C"].connected,
+                       what="B-C leg dark")
+        t0 = time.monotonic()
+        for i in range(3):
+            await pub.publish("rl/m", f"d-{i}".encode(), qos=1)
+            sent.append(f"d-{i}".encode())
+        assert time.monotonic() - t0 < 10.0, "PUBACK wedged"
+        assert (mgrs["B"].forwards_parked >= 1
+                or mgrs["B"].relay_chain_timeouts >= 1)
+        # CONNECT never wedges either while the leg is dark
+        probe = await connect(brokers["A"], "rl-probe")
+        await probe.close()
+
+        faults.heal("B", "C")
+        await wait_for(lambda: mgrs["B"].links["C"].connected,
+                       timeout=15, what="B-C healed")
+        await wait_for(lambda: mgrs["B"].fwd_parked_now == 0,
+                       timeout=15, what="relay drained its park")
+        # NOW kill the middle node: every PUBACKed message already
+        # crossed to C, so the kill cannot un-deliver anything
+        await brokers["B"].close()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not set(sent) <= got:
+            got.update(await drain(sub, timeout=1.0))
+        assert set(sent) <= got, \
+            f"lost after relay kill: {set(sent) - got}"
+        for c in (sub, pub):
+            await c.close()
+
+
+async def test_sub_keepalive_blip_detected_and_resynced():
+    """ADR 020 satellite: a drop window healed before any keepalive
+    flap (counted arming: EXACTLY the next 3 A->B writer items vanish,
+    then the path is clean) is caught by the next audit heartbeat's
+    item deficit — the receiver notices, the sender resyncs (pending
+    fwd acks fail -> re-park -> drain), and every PUBACKed payload is
+    delivered with the link never flapping."""
+    pair = {"A": ["B"], "B": ["A"]}
+    async with cluster(pair, keepalive=1.0) as (brokers, mgrs):
+        await links_converged(mgrs, pair)
+        sub = await connect(brokers["B"], "bl-sub")
+        await sub.subscribe(("bl/#", 1))
+        pubs = [await connect(brokers["A"], f"bl-pub{i}")
+                for i in range(3)]
+        await wait_for(lambda: bool(mgrs["A"].routes.nodes_for("bl/m")),
+                       what="route to B")
+        await pubs[0].publish("bl/m", b"pre", qos=1)
+        assert await drain(sub) == [b"pre"]
+        flaps0 = mgrs["A"].link_flaps + mgrs["B"].link_flaps
+        blip_site = (f"{faults.CLUSTER_PARTITION}#"
+                     f"{faults.partition_key('A', 'B')}")
+        # phase-align to the audit heartbeat: arm right AFTER a beat so
+        # the drop window sits mid-interval — the liveness fire in the
+        # keepalive loop hits the same site and would flap the link
+        hb0 = mgrs["A"].links["B"].hb_seq
+        await wait_for(lambda: mgrs["A"].links["B"].hb_seq > hb0,
+                       what="beat boundary")
+        faults.arm(blip_site, "drop", count=3)
+        sent = [f"b-{i}".encode() for i in range(3)]
+        # one publisher per payload: the broker serializes a single
+        # connection's pipelined QoS1 publishes behind the fwd barrier,
+        # which would stretch the armed window past the next beat —
+        # separate connections enqueue (and blackhole) all 3 forwards
+        # within milliseconds, so the next beat crosses a clean path
+        # and the link NEVER flaps
+        await asyncio.gather(
+            *(p.publish("bl/m", m, qos=1)
+              for p, m in zip(pubs, sent)))
+        assert not faults.armed(blip_site), "drop window self-healed"
+        await wait_for(lambda: mgrs["B"].blips_detected >= 1,
+                       timeout=8, what="deficit caught by next hb")
+        await wait_for(lambda: mgrs["A"].blip_resyncs >= 1,
+                       timeout=8, what="sender resynced")
+        got = set()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not set(sent) <= got:
+            got.update(await drain(sub, timeout=1.0))
+        assert set(sent) <= got, f"blip lost {set(sent) - got}"
+        assert mgrs["A"].fwd_parked_resent >= 1
+        assert mgrs["A"].link_flaps + mgrs["B"].link_flaps == flaps0, \
+            "recovery must not have come from a link flap"
+        for c in (sub, *pubs):
+            await c.close()
+
+
+async def test_scripted_clock_will_wall_deadline_cold_entry():
+    """ADR 020 satellite: a 6-element transferred will carries the
+    ABSOLUTE wall-clock deadline, so a judge that applied the entry
+    cold (restart / late join: no local ``disconnected_seen``) fires
+    on the owner's original schedule instead of re-charging the full
+    delay from owner death. 5-element (older-peer) and malformed
+    entries keep the legacy duration fallback; the rank stagger
+    applies at the fire instant."""
+    pair = {"A": ["B"], "B": ["A"]}
+    async with cluster(pair) as (brokers, mgrs):
+        fed = mgrs["B"].sessions
+        fed.will_grace = 0.3
+        fed._started_mono = 1000.0      # owner "Z" death observed here
+        wall = [5000.0]
+        fed._wall = lambda: wall[0]
+        # cold entry, deadline 4s out: stagger elapsed, deadline not
+        e = _scripted_entry("wd-c", "Z", will_delay=600.0,
+                            connected=False)
+        e.will.append(5004.0)
+        fed.ledger["wd-c"] = e
+        fed._sweep_entry(e, 1000.4, rank=0)
+        assert e.will is not None and fed.wills_fired == 0
+        # deadline reached -> fires NOW (the duration fallback would
+        # have re-charged 600s from owner death)
+        wall[0] = 5004.1
+        fed._sweep_entry(e, 1000.5, rank=0)
+        assert e.will is None and fed.wills_fired == 1
+        # rank-1 judge staggers the FIRE instant one grace past the
+        # deadline, leaving the rank-0 stand-down its window
+        e2 = _scripted_entry("wd-r", "Z", will_delay=600.0,
+                             connected=False)
+        e2.will.append(5004.0)
+        fed.ledger["wd-r"] = e2
+        wall[0] = 5004.2                # 0.2 past deadline < 0.3 grace
+        fed._sweep_entry(e2, 1000.7, rank=1)
+        assert e2.will is not None and fed.wills_fired == 1
+        wall[0] = 5004.4
+        fed._sweep_entry(e2, 1000.8, rank=1)
+        assert e2.will is None and fed.wills_fired == 2
+        # the death stagger still gates a long-overdue deadline
+        e3 = _scripted_entry("wd-s", "Z", will_delay=0.0,
+                             connected=False)
+        e3.will.append(4000.0)          # long past due
+        fed.ledger["wd-s"] = e3
+        fed._sweep_entry(e3, 1000.1, rank=0)    # down 0.1 < 0.3
+        assert e3.will is not None and fed.wills_fired == 2
+        fed._sweep_entry(e3, 1000.4, rank=0)
+        assert e3.will is None and fed.wills_fired == 3
+        # malformed 6th element: duration fallback, never a crash
+        e4 = _scripted_entry("wd-m", "Z", will_delay=0.2,
+                             connected=False)
+        e4.will.append("junk")
+        fed.ledger["wd-m"] = e4
+        fed._sweep_entry(e4, 1000.4, rank=0)    # 0.4 < 0.3 + 0.2
+        assert e4.will is not None and fed.wills_fired == 3
+        fed._sweep_entry(e4, 1000.6, rank=0)    # 0.6 >= 0.5 -> fires
+        assert e4.will is None and fed.wills_fired == 4
+        for cid in ("wd-c", "wd-r", "wd-s", "wd-m"):
+            fed.ledger.pop(cid, None)
+
+
+async def test_hop_capped_relay_drop_attributed_to_bridge_stage():
+    """ADR 020 small fix: a relay dropping an onward forward at the
+    hop cap is EXPLAINED cross-node loss — it must show up on the
+    relay's ADR-015 stage-error counter (stage=bridge, reason=hop_cap)
+    next to the aggregate ``hops_dropped``, so a macroday loss
+    investigation lands on the right node and reason."""
+    async with cluster(LINE, max_hops=1) as (brokers, mgrs):
+        await links_converged(mgrs, LINE)
+        sub = await connect(brokers["C"], "hc-sub")
+        await sub.subscribe(("hc/#", 1))
+        pub = await connect(brokers["A"], "hc-pub")
+        await wait_for(lambda: bool(mgrs["A"].routes.nodes_for("hc/m")),
+                       what="A learned the transitive route")
+        await pub.publish("hc/m", b"capped", qos=1)
+        # hop 1 (A->B) lands; the onward B->C hop sits AT the cap
+        await wait_for(lambda: mgrs["B"].hops_dropped >= 1,
+                       what="relay dropped at the hop cap")
+        errs = dict(brokers["B"].tracer.stage_errors)
+        assert errs.get(("bridge", "hop_cap"), 0) >= 1
+        assert await drain(sub, timeout=0.5) == []
+        for c in (sub, pub):
+            await c.close()
+
+
+async def test_restarted_relay_holds_fwds_until_route_sync():
+    """ADR 020 (found by the live 3-node verify drive): a relay that
+    restarts mid-heal can receive the upstream's parked-forward drain
+    BEFORE the downstream peer's route snapshot arrives — pre-fix it
+    fanned out against an empty route table, relayed nothing onward,
+    acked upstream anyway, and a PUBACKed message was gone for good.
+    The route-sync gate holds inbound forwards (bounded) until every
+    configured peer advertised once, so the drain lands on a
+    converged table."""
+    async with cluster(LINE, fwd_durability="chained",
+                       session_sync_timeout_ms=1500) as (brokers, mgrs):
+        await links_converged(mgrs, LINE)
+        sub = await connect(brokers["C"], "rs-sub", version=5,
+                            clean_start=False, session_expiry=600)
+        await sub.subscribe(("rs/#", 1))
+        pub = await connect(brokers["A"], "rs-pub")
+        await wait_for(lambda: bool(mgrs["A"].routes.nodes_for("rs/m")),
+                       what="A learned the 2-hop route")
+        sent = []
+        for i in range(2):
+            await pub.publish("rs/m", f"h-{i}".encode(), qos=1)
+            sent.append(f"h-{i}".encode())
+        got = set(await drain(sub))
+        assert set(sent) <= got
+
+        # kill the relay; publishes still PUBACK (parked at A)
+        port_b = brokers["B"].test_port
+        await brokers["B"].close()
+        await wait_for(lambda: not mgrs["A"].links["B"].connected,
+                       what="A saw the relay die")
+        for i in range(3):
+            await pub.publish("rs/m", f"d-{i}".encode(), qos=1,
+                              timeout=10)
+            sent.append(f"d-{i}".encode())
+        assert mgrs["A"].forwards_parked >= 3
+
+        # keep C's advertisements away from the restarted B: only the
+        # C->B direction is dark, so A's drain reaches B while B's
+        # route table still has no idea C subscribed anything
+        cb_site = f"{faults.CLUSTER_PARTITION}#" \
+                  f"{faults.partition_key('C', 'B')}"
+        faults.arm(cb_site, "drop", count=-1)
+        b2 = Broker(BrokerOptions(
+            capabilities=Capabilities(sys_topic_interval=0)))
+        b2.add_hook(AllowHook())
+        b2.add_listener(TCPListener("t", f"127.0.0.1:{port_b}"))
+        await b2.serve()
+        b2.test_port = port_b
+        mgr_b2 = make_manager(
+            b2, "B", [PeerSpec("A", "127.0.0.1", brokers["A"].test_port),
+                      PeerSpec("C", "127.0.0.1", brokers["C"].test_port)],
+            fwd_durability="chained", session_sync_timeout_ms=1500)
+        await mgr_b2.start()
+        brokers["B"] = b2
+        mgrs["B"] = mgr_b2
+
+        # A drains its park into B; the gate must HOLD (C unsynced)
+        await wait_for(lambda: mgr_b2.route_sync_waits >= 1,
+                       what="restarted relay held the drained fwds")
+        faults.disarm(cb_site)              # heal: C's snapshot lands
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and not set(sent) <= got:
+            got.update(await drain(sub, timeout=1.0))
+        assert set(sent) <= got, \
+            f"PUBACKed loss through restarted relay: {set(sent) - got}"
+        assert mgr_b2.route_sync_timeouts == 0
+        for c in (sub, pub):
+            await c.close()
