@@ -1992,6 +1992,153 @@ def bench_macroday(scale: float = 1.0) -> dict:
     return d
 
 
+def bench_cshard(storm: int = 200, msgs: int = 300,
+                 pairs: int = 4) -> dict:
+    """ADR-021 in-box cluster scaling (MAXMQ_BENCH_CONFIGS=cshard):
+    the SO_REUSEPORT worker pool as REAL subprocesses sharing one TCP
+    port (loopback federation over unix bridge links), measured at
+    workers=1/2/4 — connect-storm accept rate plus aggregate QoS0 and
+    QoS1 delivered throughput over independent pub/sub pairs. The
+    *_per_sec keys are what bench_compare gates; the speedup ratios
+    ride along informationally because a single-core CI box cannot
+    show scaling (tests/test_worker_shard.py owns the semantics
+    there; docs/adr/021 records the multi-core curve)."""
+    import asyncio
+    import contextlib
+    import shutil
+    import socket
+    import tempfile
+
+    from maxmq_tpu.broker.workers import run_pool, worker_sock
+    from maxmq_tpu.mqtt_client import MQTTClient
+    from maxmq_tpu.utils.config import Config
+    from maxmq_tpu.utils.logger import new_logger
+
+    payload = b"c" * 96
+
+    async def measure(workers: int) -> dict:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        tmp = tempfile.mkdtemp(prefix="maxmq-cshard-")
+        pool_dir = os.path.join(tmp, "mesh")
+        conf = Config(workers=workers,
+                      mqtt_tcp_address=f"127.0.0.1:{port}",
+                      mqtt_unix_socket="", mqtt_sys_http_address="",
+                      mqtt_sys_topic_interval=0, metrics_enabled=False,
+                      matcher="trie", worker_link_dir=pool_dir,
+                      log_format="json", log_level="error")
+        ready, stop = asyncio.Event(), asyncio.Event()
+        task = asyncio.ensure_future(run_pool(
+            conf, new_logger(fmt="json", level="error"),
+            ready=ready, stop=stop))
+        out: dict = {}
+        try:
+            await asyncio.wait_for(ready.wait(), 60)
+            deadline = time.monotonic() + 30
+            while not all(os.path.exists(worker_sock(pool_dir, i))
+                          for i in range(workers)):
+                if time.monotonic() >= deadline:
+                    raise RuntimeError("cshard: pool never booted")
+                await asyncio.sleep(0.05)
+
+            # connect storm: accept rate through the one shared port
+            clients: list = []
+
+            async def one(i: int) -> None:
+                c = MQTTClient(client_id=f"cs{workers}-{i}")
+                await c.connect("127.0.0.1", port, timeout=20.0)
+                clients.append(c)
+
+            t0 = time.perf_counter()
+            for base in range(0, storm, 50):
+                await asyncio.gather(
+                    *(one(i)
+                      for i in range(base, min(base + 50, storm))))
+            out["accepts_per_sec"] = round(
+                storm / (time.perf_counter() - t0), 1)
+            for c in clients:
+                with contextlib.suppress(Exception):
+                    await c.disconnect()
+
+            # aggregate delivered throughput, independent pairs: each
+            # pair warms until its (possibly cross-worker) route is
+            # live, then drains to idle, so the timed window counts
+            # exactly msgs deliveries
+            async def setup(i: int, qos: int):
+                topic = f"cs/{qos}/{i}"
+                sub = MQTTClient(client_id=f"cp{qos}s-{i}")
+                await sub.connect("127.0.0.1", port)
+                await sub.subscribe((topic, qos))
+                pub = MQTTClient(client_id=f"cp{qos}p-{i}")
+                await pub.connect("127.0.0.1", port)
+                for _ in range(200):
+                    await pub.publish(topic, b"w", qos=qos)
+                    try:
+                        await sub.next_message(timeout=0.5)
+                        break
+                    except asyncio.TimeoutError:
+                        continue
+                else:
+                    raise RuntimeError(f"cshard: {topic} never live")
+                while True:     # drain straggling warm deliveries
+                    try:
+                        await sub.next_message(timeout=0.3)
+                    except asyncio.TimeoutError:
+                        break
+                return sub, pub, topic
+
+            async def pump(sub, pub, topic: str, qos: int) -> None:
+                for _ in range(msgs):
+                    await pub.publish(topic, payload, qos=qos)
+                for _ in range(msgs):
+                    await sub.next_message(timeout=60)
+
+            for qos in (0, 1):
+                duo = [await setup(i, qos) for i in range(pairs)]
+                t0 = time.perf_counter()
+                await asyncio.gather(
+                    *(pump(sub, pub, topic, qos)
+                      for sub, pub, topic in duo))
+                out[f"qos{qos}_delivered_per_sec"] = round(
+                    pairs * msgs / (time.perf_counter() - t0), 1)
+                for sub, pub, _topic in duo:
+                    with contextlib.suppress(Exception):
+                        await sub.disconnect()
+                    with contextlib.suppress(Exception):
+                        await pub.disconnect()
+        finally:
+            stop.set()
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(task, 30)
+            shutil.rmtree(tmp, ignore_errors=True)
+        return out
+
+    d: dict = {"config": "cshard", "cores": os.cpu_count() or 1,
+               "storm_clients": storm, "pairs": pairs,
+               "msgs_per_pair": msgs}
+    for w in (1, 2, 4):
+        r = asyncio.run(measure(w))
+        for k, v in r.items():
+            d[f"w{w}_{k}"] = v
+    for q in ("qos0", "qos1"):
+        base = d.get(f"w1_{q}_delivered_per_sec") or 0.0
+        for w in (2, 4):
+            d[f"{q}_speedup_w{w}"] = round(
+                d[f"w{w}_{q}_delivered_per_sec"] / base, 2) \
+                if base else -1.0
+    log(f"[cshard] cores={d['cores']} "
+        f"accepts/s w1={d['w1_accepts_per_sec']} "
+        f"w2={d['w2_accepts_per_sec']} w4={d['w4_accepts_per_sec']} "
+        f"qos1/s w1={d['w1_qos1_delivered_per_sec']} "
+        f"w2={d['w2_qos1_delivered_per_sec']} "
+        f"w4={d['w4_qos1_delivered_per_sec']} "
+        f"speedup(q1) w2={d['qos1_speedup_w2']} "
+        f"w4={d['qos1_speedup_w4']}")
+    return d
+
+
 def bench_failover(parked: int = 50, share_msgs: int = 60) -> dict:
     """ADR-016 session-federation measurement (MAXMQ_BENCH_CONFIGS=
     failover): a 3-node line A-B-C with cluster_session_sync=always.
@@ -2553,6 +2700,14 @@ def main() -> None:
         # armed concurrently on a 3-node mesh, scored against one SLO
         # sheet (loss=0, will exactly-once, recovery times)
         runs.append(("macroday", lambda: bench_macroday(scale=scale)))
+    if "cshard" in which:
+        # ADR-021 in-box cluster: subprocess worker pool on one
+        # SO_REUSEPORT port — accept rate + aggregate QoS0/QoS1
+        # delivered throughput at workers=1/2/4
+        runs.append(("cshard",
+                     lambda: bench_cshard(
+                         storm=max(60, int(200 * scale)),
+                         msgs=max(60, int(300 * scale)))))
     if "5" in which:
         runs.append(("cluster", lambda: bench_cluster(subs=s(100_000))))
     if "e2e" in which:
@@ -2638,7 +2793,7 @@ CONFIG_DEADLINES = {"1": 900, "2": 900, "3": 1200, "4": 2400,
                     "latdo": 1200, "5": 2400, "e2e": 4200,
                     "widthab": 1200, "degraded": 1200, "overload": 900,
                     "cluster": 900, "durable": 900, "failover": 900,
-                    "fanout": 900, "macroday": 900}
+                    "fanout": 900, "macroday": 900, "cshard": 900}
 
 
 def run_supervised(which: list[str]) -> None:

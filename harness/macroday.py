@@ -30,6 +30,14 @@ ride along from the ADR-015 tracer. ``bench.py`` config ``macroday``
 emits the sheet as a BENCH_r*.json row that scripts/bench_compare.py
 gates on (loss and recovery fields block alongside throughput/p99).
 
+Since ADR 021 the same day can replay against a SHARDED BOX:
+``MacroDay(workers=N)`` boots the three mesh roles as in-box pool
+workers over unix-domain bridge links (the local link flavor —
+skew≈0, budget-exempt) instead of a TCP mesh, and the ``node_kill``
+phase runs as ``worker_kill`` against the same scorer. The
+``ConnectionSoak`` scenario reuses the phase scheduler for the
+ramped connect-flood soak (tests/test_worker_shard.py, slow lane).
+
 What this harness deliberately does NOT compose is listed in the ADR
 (device faults, storage-commit faults, WS listeners, >3 nodes).
 """
@@ -37,11 +45,16 @@ What this harness deliberately does NOT compose is listed in the ADR
 from __future__ import annotations
 
 import asyncio
+import os
+import resource
+import shutil
+import tempfile
 import time
 
 from maxmq_tpu import faults
 from maxmq_tpu.broker import (Broker, BrokerOptions, Capabilities,
-                              TCPListener)
+                              TCPListener, UnixListener)
+from maxmq_tpu.broker.workers import worker_sock
 from maxmq_tpu.cluster import ClusterManager, PeerSpec
 from maxmq_tpu.hooks import AllowHook
 from maxmq_tpu.mqtt_client import MQTTClient
@@ -52,49 +65,23 @@ PAYLOAD = b"m" * 96
 NOISE = b"n" * 512
 
 
-class MacroDay:
-    """One scripted production day; ``await MacroDay(...).run()``
-    returns the SLO sheet dict (``sheet["pass"]`` + violations)."""
+class Scenario:
+    """The ADR-020 phase scheduler, scenario-agnostic: deterministic
+    fault arming with per-phase fired-site accounting, PUBACKed
+    stream ledgers (sent <= got is the zero-loss SLO), and ONE
+    machine-checkable sheet. MacroDay scripts the production day on
+    top of it; ConnectionSoak (ADR 021) scripts the sharded-box
+    connect flood."""
 
-    def __init__(self, *, storm_clients: int = 24,
-                 telemetry_msgs: int = 30, command_msgs: int = 20,
-                 cut_msgs: int = 20, parked_msgs: int = 30,
-                 keepalive: float = 1.0,
-                 sync_timeout_ms: int = 1000,
-                 # the rank stagger only suppresses the second judge
-                 # when the grace exceeds the judges' death-detection
-                 # skew (~one keepalive of jitter): keep grace >= 2x
-                 # keepalive or both judges fire before the rank-0
-                 # stand-down broadcast lands
-                 will_grace: float = 2.0,
-                 require_relay: bool = True,
-                 settle_s: float = 20.0) -> None:
-        self.storm_clients = storm_clients
-        self.telemetry_msgs = telemetry_msgs
-        self.command_msgs = command_msgs
-        self.cut_msgs = cut_msgs
-        self.parked_msgs = parked_msgs
-        self.keepalive = keepalive
-        self.sync_timeout_ms = sync_timeout_ms
-        self.will_grace = will_grace
-        self.require_relay = require_relay
-        self.settle_s = settle_s
+    def __init__(self) -> None:
         self.brokers: dict[str, Broker] = {}
-        self.mgrs: dict[str, ClusterManager] = {}
-        self.sheet: dict = {"config": "macroday", "nodes": 3,
-                            "topology": "mesh A-B-C",
-                            "fwd_durability": "chained",
-                            "phases": []}
+        self.sheet: dict = {"phases": []}
         # stream -> (sent payload set, got payload set): every payload
         # in a sent set was PUBACKed to its publisher, so the zero-loss
-        # SLO is sent <= got at the end of the day, per stream
+        # SLO is sent <= got at the end of the run, per stream
         self.streams: dict[str, tuple[set, set]] = {}
         self._armed_now: list[str] = []
-        self._churn_stop = asyncio.Event()
-        self._churn_rounds = 0
         self._clients: list[MQTTClient] = []
-
-    # -- plumbing ------------------------------------------------------
 
     def _arm(self, site: str, mode: str, count: int,
              delay_s: float = 0.05) -> None:
@@ -163,10 +150,78 @@ class MacroDay:
             await self._drain_into(client, got)
         return (time.perf_counter() - t0) if sent <= got else -1.0
 
+    async def _close_clients(self) -> None:
+        for c in self._clients:
+            try:
+                await c.close()
+            except Exception:
+                pass
+
+
+class MacroDay(Scenario):
+    """One scripted production day; ``await MacroDay(...).run()``
+    returns the SLO sheet dict (``sheet["pass"]`` + violations).
+
+    ``workers=N`` replays the SAME day against a sharded box: the
+    three mesh roles become in-box pool workers linked over
+    unix-domain bridges (ADR 021), extra workers beyond three join
+    the mesh as plain members, and the kill phase scores as
+    ``worker_kill``. N below 3 is clamped to 3 — the day's script
+    needs its three roles."""
+
+    def __init__(self, *, storm_clients: int = 24,
+                 telemetry_msgs: int = 30, command_msgs: int = 20,
+                 cut_msgs: int = 20, parked_msgs: int = 30,
+                 keepalive: float = 1.0,
+                 sync_timeout_ms: int = 1000,
+                 # the rank stagger only suppresses the second judge
+                 # when the grace exceeds the judges' death-detection
+                 # skew (~one keepalive of jitter): keep grace >= 2x
+                 # keepalive or both judges fire before the rank-0
+                 # stand-down broadcast lands
+                 will_grace: float = 2.0,
+                 require_relay: bool = True,
+                 settle_s: float = 20.0,
+                 workers: int = 0) -> None:
+        super().__init__()
+        self.workers = max(3, workers) if workers else 0
+        self.storm_clients = storm_clients
+        self.telemetry_msgs = telemetry_msgs
+        self.command_msgs = command_msgs
+        self.cut_msgs = cut_msgs
+        self.parked_msgs = parked_msgs
+        self.keepalive = keepalive
+        self.sync_timeout_ms = sync_timeout_ms
+        self.will_grace = will_grace
+        self.require_relay = require_relay
+        self.settle_s = settle_s
+        self.mgrs: dict[str, ClusterManager] = {}
+        self._pool_dir: str | None = None
+        self.sheet.update({
+            "config": "macroday",
+            "nodes": self.workers or 3,
+            "topology": (f"in-box pool x{self.workers} (unix mesh)"
+                         if self.workers else "mesh A-B-C"),
+            "fwd_durability": "chained"})
+        if self.workers:
+            self.sheet["workers"] = self.workers
+        self._churn_stop = asyncio.Event()
+        self._churn_rounds = 0
+
     # -- cluster lifecycle ---------------------------------------------
 
     async def _boot(self) -> None:
-        for name in MESH:
+        sharded = self.workers > 0
+        members = list(MESH)
+        if sharded:
+            self._pool_dir = tempfile.mkdtemp(prefix="maxmq-md-pool-")
+            members += [f"w{i}" for i in range(3, self.workers)]
+        slots = {n: i for i, n in enumerate(members)}
+        # sharded: every worker peers with every sibling (the pool is
+        # one box); classic: the scripted 3-node mesh
+        self._peers = {n: ([p for p in members if p != n] if sharded
+                           else MESH[n]) for n in members}
+        for name in members:
             caps = Capabilities(
                 sys_topic_interval=0, trace_sample_n=1,
                 client_byte_budget=1 << 20,
@@ -176,14 +231,24 @@ class MacroDay:
             b = Broker(BrokerOptions(capabilities=caps))
             b.add_hook(AllowHook())
             lst = b.add_listener(TCPListener("t", "127.0.0.1:0"))
+            if sharded:
+                b.add_listener(UnixListener(
+                    "peer-bridge",
+                    worker_sock(self._pool_dir, slots[name])))
             await b.serve()
             b.test_port = lst._server.sockets[0].getsockname()[1]
             self.brokers[name] = b
-        for name, peers in MESH.items():
+        for name in members:
+            if sharded:
+                specs = [PeerSpec(p, "", 0, path=worker_sock(
+                    self._pool_dir, slots[p]))
+                    for p in self._peers[name]]
+            else:
+                specs = [PeerSpec(p, "127.0.0.1",
+                                  self.brokers[p].test_port)
+                         for p in self._peers[name]]
             mgr = ClusterManager(
-                self.brokers[name], name,
-                [PeerSpec(p, "127.0.0.1", self.brokers[p].test_port)
-                 for p in peers],
+                self.brokers[name], name, specs,
                 keepalive=self.keepalive, backoff_initial_s=0.1,
                 backoff_max_s=0.5,
                 session_sync="always",
@@ -191,12 +256,15 @@ class MacroDay:
                 session_takeover_timeout_ms=self.sync_timeout_ms,
                 fwd_durability="chained")
             self.brokers[name].attach_cluster(mgr)
+            for link in mgr.links.values():
+                if link.local:
+                    link.byte_budget = 0    # ADR 021: budget-exempt
             await mgr.start()
             if mgr.sessions is not None:
                 mgr.sessions.will_grace = self.will_grace
             self.mgrs[name] = mgr
         up = await self._poll(
-            lambda: all(m.links_up == len(MESH[n])
+            lambda: all(m.links_up == len(self._peers[n])
                         for n, m in self.mgrs.items()), 30.0)
         if up < 0:
             raise RuntimeError("macroday: cluster never converged")
@@ -210,16 +278,14 @@ class MacroDay:
             except Exception:
                 task.cancel()
                 await asyncio.gather(task, return_exceptions=True)
-        for c in self._clients:
-            try:
-                await c.close()
-            except Exception:
-                pass
+        await self._close_clients()
         for b in self.brokers.values():
             try:
                 await b.close()
             except Exception:
                 pass
+        if self._pool_dir is not None:
+            shutil.rmtree(self._pool_dir, ignore_errors=True)
 
     # -- phases --------------------------------------------------------
 
@@ -357,7 +423,10 @@ class MacroDay:
         # a fresh shed is active while the edge is cut: composed
         # shed x partition x churn is the point of the macro-scenario
         await self._wedge("A", "md-slow2", "fleet/noise2")
-        relay0 = self.mgrs["B"].relay_chain_waits
+        # any member outside the cut edge can carry the relay (B in
+        # the classic mesh; B or an extra worker on a sharded box)
+        relays = [n for n in self.mgrs if n not in ("A", "C")]
+        relay0 = {n: self.mgrs[n].relay_chain_waits for n in relays}
         self._partition("A", "C")
         down = await self._poll(
             lambda: not self.mgrs["A"].links["C"].connected, 20.0)
@@ -376,15 +445,16 @@ class MacroDay:
         faults.heal("A", "C")
         t_heal = time.perf_counter()
         up = await self._poll(
-            lambda: all(m.links_up == len(MESH[n])
+            lambda: all(m.links_up == len(self._peers[n])
                         for n, m in self.mgrs.items()), 30.0)
         settle = await self._settle(self.collector, "telemetry",
                                     self.settle_s)
         self.sheet["heal_convergence_ms"] = round(
             (time.perf_counter() - t_heal) * 1e3, 1) \
             if up >= 0 and settle >= 0 else -1.0
-        self.sheet["relay_chain_waits"] = (
-            self.mgrs["B"].relay_chain_waits - relay0)
+        self.sheet["relay_chain_waits"] = sum(
+            self.mgrs[n].relay_chain_waits - relay0[n]
+            for n in relays)
         faults.disarm(f"{faults.CLIENT_WRITE}#md-slow2")
         rec = await self._poll(
             lambda: not self.brokers["A"].overload.shedding, 15.0)
@@ -398,7 +468,7 @@ class MacroDay:
                 "fwd_barrier_timeouts": a.fwd_barrier_timeouts,
                 "fwd_barrier_degraded": a.fwd_barrier_degraded,
                 "relay_chain_waits_b":
-                    self.mgrs["B"].relay_chain_waits - relay0,
+                    self.mgrs["B"].relay_chain_waits - relay0["B"],
                 "relay_chain_timeouts_b":
                     self.mgrs["B"].relay_chain_timeouts}
 
@@ -555,7 +625,11 @@ class MacroDay:
             await self._phase("partition_heal",
                               self._phase_partition_heal)
             self._churn_stop.set()
-            await self._phase("node_kill", self._phase_node_kill)
+            # sharded box: B *is* a worker, so the same phase + scorer
+            # report the pool's crash story under its own name
+            await self._phase(
+                "worker_kill" if self.workers else "node_kill",
+                self._phase_node_kill)
             # final settle: the collector at C must hold every
             # PUBACKed telemetry payload, including the cut-edge leg
             await self._settle(self.collector, "telemetry",
@@ -566,4 +640,225 @@ class MacroDay:
             await self._teardown()
             faults.clear()
         self.sheet["day_s"] = round(time.perf_counter() - t0, 2)
+        return self.sheet
+
+
+class ConnectionSoak(Scenario):
+    """ADR-021 connection soak on the macroday scheduler: ramp a
+    connect flood against an in-box worker pool with the ADR-012
+    connect-refusal and stall ladders ENGAGED, hold the fleet, then
+    stream a tracked QoS1 sample across the worker mesh.
+
+    The SLO is EXPLAINABILITY, not a perfect score: a refused connect
+    is fine iff an overload counter accounts for it, and a wedged
+    consumer's disconnect is fine iff the stall ladder fired — zero
+    UNEXPLAINED connect failures, zero unexplained PUBACKed loss.
+
+    Targets ``connections`` (default 100K) where the fd budget
+    allows; the fleet is clamped to RLIMIT_NOFILE (each held
+    connection costs ~4 fds with the clients in-process) so the soak
+    runs truthfully on small boxes. ``MAXMQ_SOAK_CONNECTIONS`` pins
+    the target explicitly."""
+
+    def __init__(self, *, workers: int = 2,
+                 connections: int | None = None,
+                 ramp_batch: int = 256, hold_s: float = 5.0,
+                 tracked_msgs: int = 40,
+                 settle_s: float = 20.0) -> None:
+        super().__init__()
+        self.workers = workers
+        env = os.environ.get("MAXMQ_SOAK_CONNECTIONS")
+        target = int(env) if env else (connections or 100_000)
+        soft = resource.getrlimit(resource.RLIMIT_NOFILE)[0]
+        self.connections = max(64, min(target, (soft - 512) // 4))
+        self.ramp_batch = ramp_batch
+        self.hold_s = hold_s
+        self.tracked_msgs = tracked_msgs
+        self.settle_s = settle_s
+        self.sheet.update({"config": "soak", "workers": workers,
+                           "target_connections": target,
+                           "fleet": self.connections})
+        self._fleet: list[MQTTClient] = []
+        self._pool: list[Broker] = []
+        self._ports: list[int] = []
+
+    def _refusals(self) -> int:
+        return sum(b.overload.connects_refused
+                   + b.overload.half_open_refused for b in self._pool)
+
+    # -- phases --------------------------------------------------------
+
+    async def _phase_ramp(self) -> dict:
+        """Batched connect flood, round-robin across the workers. The
+        token bucket (connect_rate) and half-open cap WILL refuse
+        spikes — each refusal is retried, and the broker-side refusal
+        counters must explain every client-side failure."""
+        failures = 0
+
+        async def one(i: int) -> None:
+            nonlocal failures
+            port = self._ports[i % len(self._ports)]
+            for attempt in range(40):
+                c = MQTTClient(client_id=f"soak-{i}", keepalive=600)
+                try:
+                    await c.connect("127.0.0.1", port, timeout=10.0)
+                    self._fleet.append(c)
+                    return
+                except Exception:
+                    failures += 1
+                    try:
+                        await c.close()
+                    except Exception:
+                        pass
+                    await asyncio.sleep(0.05 * min(attempt + 1, 8))
+
+        t0 = time.perf_counter()
+        for base in range(0, self.connections, self.ramp_batch):
+            batch = range(base, min(base + self.ramp_batch,
+                                    self.connections))
+            await asyncio.gather(*(one(i) for i in batch))
+        ramp_s = time.perf_counter() - t0
+        refused = self._refusals()
+        self.sheet["connected"] = len(self._fleet)
+        self.sheet["connect_failures"] = failures
+        self.sheet["connect_refused"] = refused
+        self.sheet["unexplained_connect_failures"] = max(
+            0, failures - refused)
+        self.sheet["ramp_connects_per_sec"] = round(
+            len(self._fleet) / ramp_s, 1) if ramp_s > 0 else -1.0
+        return {"connected": len(self._fleet), "refused": refused,
+                "failures": failures}
+
+    async def _phase_hold(self) -> dict:
+        """Hold the fleet; a ping sample proves the box still serves
+        under the standing-connection load, and nobody held may drop."""
+        await asyncio.sleep(self.hold_s)
+        step = max(1, len(self._fleet) // 64)
+        sample, ok = self._fleet[::step], 0
+        for c in sample:
+            try:
+                await c.ping(timeout=5.0)
+                ok += 1
+            except Exception:
+                pass
+        dropped = sum(1 for c in self._fleet
+                      if c.writer is None or c.writer.is_closing())
+        self.sheet["hold_dropped"] = dropped
+        self.sheet["held"] = len(self._fleet) - dropped
+        return {"sample": len(sample), "sample_pings_ok": ok,
+                "dropped": dropped}
+
+    async def _phase_stall(self) -> dict:
+        """One wedged consumer under QoS1 noise drives the ADR-012
+        shed ladder into a stall disconnect — the EXPLAINED way to
+        lose a client mid-soak."""
+        b = self._pool[0]
+        slow = MQTTClient(client_id="soak-slow")
+        await slow.connect("127.0.0.1", self._ports[0])
+        self._clients.append(slow)
+        await slow.subscribe(("soak/noise/#", 0))
+        self._arm(f"{faults.CLIENT_WRITE}#soak-slow", "hang",
+                  count=-1, delay_s=30.0)
+        pub = MQTTClient(client_id="soak-noise")
+        await pub.connect("127.0.0.1", self._ports[0])
+        self._clients.append(pub)
+        for _ in range(4000):
+            if b.overload.shedding:
+                break
+            await pub.publish("soak/noise/x", NOISE, qos=1)
+        stalled = await self._poll(
+            lambda: b.overload.stalled_disconnects > 0, 15.0)
+        faults.disarm(f"{faults.CLIENT_WRITE}#soak-slow")
+        rec = await self._poll(lambda: not b.overload.shedding, 15.0)
+        self.sheet["stall_engaged"] = stalled >= 0
+        self.sheet["stalled_disconnects"] = \
+            b.overload.stalled_disconnects
+        return {"engaged": stalled >= 0, "recovered": rec >= 0,
+                "sheds": b.overload.sheds}
+
+    async def _phase_tracked(self) -> dict:
+        """A tracked QoS1 stream crossing the worker mesh while the
+        fleet is still attached: sent <= got or the soak fails."""
+        sent, got = self._stream("tracked")
+        sub = MQTTClient(client_id="soak-track-sub")
+        await sub.connect("127.0.0.1", self._ports[0])
+        self._clients.append(sub)
+        await sub.subscribe(("soak/track", 1))
+        pub = MQTTClient(client_id="soak-track-pub")
+        await pub.connect("127.0.0.1", self._ports[-1])
+        self._clients.append(pub)
+        ok = await self._poll(
+            lambda: bool(self._pool[-1].cluster.routes.nodes_for(
+                "soak/track")) or len(self._ports) == 1, 15.0)
+        if ok < 0:
+            raise RuntimeError("soak: tracked route never converged")
+        for i in range(self.tracked_msgs):
+            payload = f"trk-{i}-".encode() + PAYLOAD
+            await pub.publish("soak/track", payload, qos=1)
+            sent.add(payload)
+        settle = await self._settle(sub, "tracked", self.settle_s)
+        self.sheet["tracked_pubacked"] = len(sent)
+        self.sheet["unexplained_loss"] = len(sent - got)
+        return {"pubacked": len(sent), "settle_s": round(settle, 3),
+                "loss": len(sent - got)}
+
+    # -- scoring / entry point -----------------------------------------
+
+    def _score(self) -> None:
+        violations: list[str] = []
+
+        def check(cond: bool, what: str) -> None:
+            if not cond:
+                violations.append(what)
+
+        check(self.sheet.get("connected", 0) >= self.connections,
+              f"fleet never fully connected "
+              f"({self.sheet.get('connected')}/{self.connections})")
+        check(self.sheet.get("connect_refused", 0) >= 1,
+              "connect-refusal ladder never engaged")
+        check(self.sheet.get("unexplained_connect_failures", 1) == 0,
+              "connect failures the refusal counters cannot explain")
+        check(self.sheet.get("hold_dropped", 1) == 0,
+              "held connections dropped mid-soak")
+        check(bool(self.sheet.get("stall_engaged")),
+              "stall ladder never engaged")
+        check(self.sheet.get("unexplained_loss", 1) == 0,
+              "tracked QoS1 stream lost PUBACKed payloads")
+        self.sheet["violations"] = violations
+        self.sheet["pass"] = not violations
+
+    async def run(self) -> dict:
+        from maxmq_tpu.broker.workers import inprocess_pool
+        from maxmq_tpu.utils.config import Config
+
+        conf = Config(
+            connect_rate=800.0, connect_burst=64,
+            connect_half_open_max=512,
+            broker_client_byte_budget=1 << 20,
+            broker_byte_budget=128 * 1024,
+            broker_overload_high_water=0.5,
+            broker_overload_low_water=0.1,
+            stall_deadline_ms=2500)
+        link_dir = tempfile.mkdtemp(prefix="maxmq-soak-")
+        t0 = time.perf_counter()
+        try:
+            async with inprocess_pool(self.workers, link_dir=link_dir,
+                                      conf=conf) as (brokers, ports):
+                self._pool, self._ports = brokers, ports
+                await self._phase("connect_ramp", self._phase_ramp)
+                await self._phase("hold", self._phase_hold)
+                await self._phase("stall_ladder", self._phase_stall)
+                await self._phase("tracked_stream",
+                                  self._phase_tracked)
+                self._score()
+                await self._close_clients()
+                for c in self._fleet:
+                    try:
+                        await c.close()
+                    except Exception:
+                        pass
+        finally:
+            faults.clear()
+            shutil.rmtree(link_dir, ignore_errors=True)
+        self.sheet["soak_s"] = round(time.perf_counter() - t0, 2)
         return self.sheet

@@ -352,18 +352,20 @@ async def run_server(conf: Config, logger: Logger,
 
 
 async def _maybe_run_pool(conf: Config, logger, ready, stop) -> bool:
-    """Delivery-worker pool (ADR 005): the parent runs the fan-out bus
-    and spawns SO_REUSEPORT workers; a worker subprocess re-enters
-    run_server with MAXMQ_WORKER_ID set and takes the worker branch."""
+    """Delivery-worker pool (ADR 005/021): the parent runs the shared
+    matcher sidecar and spawns SO_REUSEPORT workers, which mesh as an
+    in-box cluster over unix bridge links; a worker subprocess
+    re-enters run_server with MAXMQ_WORKER_ID set and takes the worker
+    branch."""
     worker_id = os.environ.get("MAXMQ_WORKER_ID")
     if worker_id is not None:
-        from .broker.workers import run_worker
+        from .broker.workers import POOL_DIR_ENV, run_worker
         pool_conf = os.environ.get("MAXMQ_POOL_CONF")
         if pool_conf:
             import json
             conf = Config(**json.loads(pool_conf))
         await run_worker(conf, logger, int(worker_id),
-                         os.environ["MAXMQ_BUS"], ready=ready, stop=stop)
+                         os.environ[POOL_DIR_ENV], ready=ready, stop=stop)
         return True
     if conf.workers > 1:
         from .broker.workers import run_pool

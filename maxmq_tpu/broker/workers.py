@@ -1,36 +1,59 @@
-"""Multi-core delivery: a pool of broker worker processes on one port.
+"""Multi-core delivery: SO_REUSEPORT workers federated as an in-box
+cluster (ADR 021, superseding the ADR-005 fan-out bus).
 
 The reference gets per-connection parallelism for free — one goroutine
 per client spread over every host core (vendor/github.com/mochi-co/
 mqtt/v2/clients.go:190-202, server.go:221). An asyncio broker caps
 per-message work (decode, QoS bookkeeping, encode, socket writes) on a
-single core. This module is the goroutine answer (ADR 005):
+single core, so N worker processes each run the FULL broker for the
+connections the kernel hands them (``SO_REUSEPORT`` shards accepts with
+no parent in the accept path).
 
-* N worker processes each run the FULL broker (codec, QoS state, fan-
-  out, matcher) for the connections the kernel hands them —
-  ``SO_REUSEPORT`` shards accepts across workers with no parent in the
-  accept path.
-* A loopback fan-out bus (unix domain stream hub, length-prefixed
-  frames) broadcasts every locally-published message to the other
-  workers, which deliver to THEIR local subscribers through their own
-  matcher. Retained messages ride the same frames, so every worker's
-  retained store converges (same-origin ordering is preserved by the
-  per-connection serialization, as in the single-process broker).
-* ``$share`` groups spanning workers stay exactly-once via membership
-  gossip: each worker broadcasts its (group, filter) local-member
-  counts on change; for every publish, the lowest-numbered worker with
-  members owns the pick (documented fairness trade in ADR 005).
+What changed in ADR 021: the workers no longer talk over a bespoke
+fan-out bus with its own gossip/takeover frames. Each worker IS a
+cluster node — ``w0..wN-1`` — meshed over unix-domain bridge links
+(the ``local`` link flavor: connect-by-path, budget-exempt, skew
+pinned to zero), so cross-worker publish forwarding, route-table
+aggregation, retained convergence, epoch-fenced session takeover,
+cluster-wide ``$share`` through the ShareLedger, and the ADR-018
+``cluster_fwd_durability`` barriers are all the EXISTING ADR-013/016/
+018 machinery, not a parallel implementation. What this module still
+owns is process supervision (spawn, respawn-with-throttle, pool
+metrics) and the per-worker config derivation.
+
+Shared singletons per box (the perf point of ADR 021):
+
+* ONE matcher sidecar — when the box config asks for a device engine
+  (``sig``/``nfa``/``dense``), the pool parent runs a
+  :class:`~..matching.service.MatcherService` on a pool socket and
+  every worker attaches as a ``matcher=service`` client behind its own
+  ADR-011 supervisor. Table compiles happen once per box, and match
+  requests from all workers coalesce into the same device
+  micro-batches.
+* ONE write-behind journal — only ``worker_journal_owner`` (default 0)
+  keeps ``storage_backend``; the owner restores the cluster session
+  buckets at boot and the ADR-016 claim path routes each session to
+  whichever worker its client reconnects to. One fsync cadence per
+  box, never N processes contending on one SQLite file.
+
+Mixed pool+cluster composition: ``cluster_peers`` entries are appended
+to EVERY worker's peer list (full peering), so an external node that
+lists each worker as a peer composes with the mesh under one set of
+``cluster_share_balance`` ownership rules. A remote box that only
+knows a single node id cannot receive from workers it never listed —
+see ADR 021's topology notes.
 
 Scaling expectation: near-linear in delivery-bound workloads up to the
 host's core count (this dev box has ONE core, so the functional tests
-assert cross-worker semantics, not speedup — see ADR 005's measured
-section).
+assert cross-worker semantics, not speedup — the ``cshard`` bench
+config measures the real curve on multi-core hosts).
 """
 
 from __future__ import annotations
 
 import asyncio
 import contextlib
+import dataclasses
 import json
 import os
 import subprocess
@@ -38,412 +61,114 @@ import sys
 import time
 
 from .. import faults
-from ..hooks.base import Hook
-from ..protocol.packets import Packet, parse_stream
 
-FRAME_PUBLISH = 1       # worker_id u8 + encoded v5 PUBLISH wire
-FRAME_MEMBERSHIP = 2    # json {w, members: [[group, filter, n], ...]}
-FRAME_TAKEOVER = 3      # json {w, cid}: session established elsewhere
+POOL_DIR_ENV = "MAXMQ_POOL_DIR"
 
-BUS_CLIENT_ID = "@bus"  # origin id carried by bus-injected publishes
-
-
-from ..utils.framing import frame as _frame, read_frame as _read_frame
+# matcher engines the pool parent hoists into the shared sidecar; a
+# box already on ``service`` points at an external sidecar, and the
+# CPU trie stays per-worker (no chip to share)
+_SIDECAR_MATCHERS = ("sig", "nfa", "dense")
 
 
-class FanoutBus:
-    """The hub: accepts worker connections on a unix socket and
-    broadcasts every frame to all OTHER workers. The hub carries only
-    already-encoded bytes — it never parses MQTT.
-
-    A peer whose transport buffer exceeds ``high_water`` is evicted — a
-    wedged worker must not grow the hub's memory by the whole publish
-    stream. The evicted worker sees bus EOF, exits (split-brain guard),
-    and the pool parent's supervision loop respawns it."""
-
-    def __init__(self, path: str, high_water: int = 8 << 20) -> None:
-        self.path = path
-        self.high_water = high_water
-        self._server = None
-        self._peers: dict[object, asyncio.StreamWriter] = {}
-
-    async def start(self) -> None:
-        try:
-            os.unlink(self.path)
-        except FileNotFoundError:
-            pass
-        self._server = await asyncio.start_unix_server(self._serve,
-                                                       self.path)
-
-    async def _serve(self, reader, writer) -> None:
-        key = object()
-        self._peers[key] = writer
-        try:
-            while True:
-                frame = await _read_frame(reader)
-                if frame is None:
-                    break
-                ftype, payload = frame
-                data = _frame(ftype, payload)
-                for k, w in list(self._peers.items()):
-                    if k is key:
-                        continue
-                    try:
-                        if (w.transport.get_write_buffer_size()
-                                > self.high_water):
-                            raise BufferError("peer stalled")
-                        w.write(data)
-                    except Exception:
-                        self._peers.pop(k, None)
-                        try:
-                            w.close()
-                        except Exception:
-                            pass
-        finally:
-            self._peers.pop(key, None)
-            try:
-                writer.close()
-            except Exception:
-                pass
-
-    async def close(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
-        for w in self._peers.values():
-            try:
-                w.close()
-            except Exception:
-                pass
-        self._peers.clear()
+def worker_sock(pool_dir: str, worker_id: int) -> str:
+    """The unix-domain socket worker ``worker_id`` accepts sibling
+    bridge links on."""
+    return os.path.join(pool_dir, f"w{worker_id}.sock")
 
 
-class BusHook(Hook):
-    """Worker-side bus endpoint, wired into the broker's hook chain.
+def matcher_sock(pool_dir: str) -> str:
+    return os.path.join(pool_dir, "matcher.sock")
 
-    Outbound: every locally-published message (and every will/retained
-    publish, which flow through the same fan-out) is forwarded once.
-    Inbound: frames are injected through the broker's inline-client
-    path, so retained storage, expiry, and local fan-out behave exactly
-    as for a locally received publish.
+
+def worker_node_id(conf, worker_id: int) -> str:
+    """Cluster node id of one worker: ``w<i>``, prefixed with the box's
+    own cluster identity when it has one (so a mixed pool+cluster mesh
+    stays globally unambiguous)."""
+    base = conf.cluster_node_id
+    return f"{base}.w{worker_id}" if base else f"w{worker_id}"
+
+
+def worker_conf(conf, worker_id: int, pool_dir: str):
+    """Derive worker ``worker_id``'s Config from the box config.
+
+    The worker mesh is expressed entirely through the existing
+    ``cluster_*`` surface: node id ``w<i>``, peers = every sibling over
+    ``unix:`` links plus the box's external ``cluster_peers`` verbatim,
+    session sync per ``worker_session_sync`` (default ``always`` — a
+    SIGKILLed worker's sibling must redeliver every PUBACKed message).
+    Singleton ownership: only ``worker_journal_owner`` keeps the
+    storage backend, only worker 0 keeps the unshareable listeners
+    (unix socket, $SYS HTTP) and the metrics address, and device
+    matchers are rewritten to ``service`` against the shared sidecar.
     """
-
-    id = "bus"
-
-    def __init__(self, worker_id: int, bus_path: str) -> None:
-        from ..cluster.routes import ShareLedger
-        self.worker_id = worker_id
-        self.bus_path = bus_path
-        self.broker = None
-        self._writer: asyncio.StreamWriter | None = None
-        self._reader_task: asyncio.Task | None = None
-        # $share group-membership ledger — the SAME class the cluster
-        # session federation feeds (ADR 016), so a filter shared across
-        # both a pool and a peer node resolves ownership through one
-        # set of rules (lowest live member id owns the pick). Member
-        # ids here are worker ids; gossip wire format is unchanged.
-        self.shares = ShareLedger(worker_id)
-        self._local: dict[tuple[str, str], int] = {}
-        # client id -> its live $share keys (incremental maintenance)
-        self._contrib: dict[str, set[tuple[str, str]]] = {}
-        self.on_bus_lost = None      # callback: bus EOF -> shut down
-        self.bus_lost = False        # latched for pre-wiring EOFs
-
-    # -- lifecycle ----------------------------------------------------
-
-    async def attach(self, broker) -> None:
-        self.broker = broker
-        reader, self._writer = await asyncio.open_unix_connection(
-            self.bus_path)
-        self._bus_client = broker.new_inline_client(BUS_CLIENT_ID)
-        self._reader_task = asyncio.get_running_loop().create_task(
-            self._drain(reader))
-
-    def announce(self) -> None:
-        """Initial gossip after the broker is serving (storage restore
-        may have loaded sessions): peers learn our state — possibly
-        empty, which clears anything stale from a previous incarnation
-        of this worker id."""
-        for client in self.broker.clients.connected():
-            self._update_contrib(client)
-        self._gossip()
-
-    def stop(self) -> None:
-        if self._reader_task is not None:
-            self._reader_task.cancel()
-        if self._writer is not None:
-            try:
-                self._writer.close()
-            except Exception:
-                pass
-
-    async def _drain(self, reader) -> None:
-        while True:
-            frame = await _read_frame(reader)
-            if frame is None:
-                # bus gone (parent died or evicted us): a worker serving
-                # without the bus is split-brained — shut down so the
-                # parent restarts us coherently. Latched so an EOF that
-                # lands before run_worker wires the callback still stops
-                # the worker.
-                self.bus_lost = True
-                if self.on_bus_lost is not None:
-                    self.on_bus_lost()
-                return
-            ftype, payload = frame
-            try:
-                if ftype == FRAME_PUBLISH:
-                    await self._inject_publish(payload)
-                elif ftype == FRAME_MEMBERSHIP:
-                    self._absorb_membership(payload)
-                elif ftype == FRAME_TAKEOVER:
-                    await self._absorb_takeover(payload)
-            except asyncio.CancelledError:
-                raise
-            except Exception as exc:  # one bad frame must not kill the bus
-                log = getattr(self.broker, "log", None)
-                if log is not None:
-                    log.with_prefix("bus").error("bus frame failed",
-                                                 error=repr(exc))
-
-    # -- publish forwarding -------------------------------------------
-
-    def on_published(self, client, packet: Packet) -> None:
-        if client is not None and client.id == BUS_CLIENT_ID:
-            return                       # arrived from the bus: no loop
-        self._forward(packet)
-
-    def on_will_sent(self, client, packet: Packet) -> None:
-        self._forward(packet)            # wills fan out pool-wide too
-
-    def _forward(self, packet: Packet) -> None:
-        if self._writer is None or packet.topic.startswith("$"):
-            return                       # $SYS stays per-worker (ADR 005)
-        wire = self._encode_for_bus(packet, self._bus_trace(packet))
-        self._writer.write(_frame(
-            FRAME_PUBLISH, bytes([self.worker_id]) + wire))
-
-    def _bus_trace(self, packet: Packet) -> str:
-        """ADR 017: a sampled publish's trace identity crosses the
-        pool bus as an ``mq-trace`` user property — identity only, no
-        clock frame (worker monotonic clocks have per-process epochs),
-        so receiving workers open correlated child traces from their
-        own arrival time. Empty (and allocation-free) when untraced."""
-        tracer = getattr(self.broker, "tracer", None)
-        if tracer is None or not (tracer.sample_n
-                                  or tracer.adopted_open):
-            return ""
-        tr = packet.__dict__.get("_trace")
-        if tr is None:
-            return ""
-        return f"{tr.origin or tracer.node_id or 'w%d' % self.worker_id}:{tr.id}"
-
-    @staticmethod
-    def _encode_for_bus(packet: Packet, trace_ref: str = "") -> bytes:
-        out = packet.copy()
-        out.protocol_version = 5
-        # a qos>0 wire needs a nonzero pid; the receiving workers
-        # allocate real per-client pids at delivery, this one is unused
-        out.packet_id = 1 if packet.fixed.qos else 0
-        out.fixed.dup = False
-        if trace_ref:
-            out.properties.user_properties.append(("mq-trace",
-                                                   trace_ref))
-        return out.encode()
-
-    async def _inject_publish(self, payload: bytes) -> None:
-        buf = bytearray(payload[1:])
-        for fh, body in parse_stream(buf):
-            packet = Packet.decode(fh, body, 5)
-            # inline clients skip the per-client QoS inbound machinery;
-            # delivery QoS still derives from min(sub.qos, msg qos)
-            packet.origin = BUS_CLIENT_ID
-            packet.created = time.time()
-            tr = self._adopt_bus_trace(packet)
-            try:
-                if packet.fixed.retain:
-                    self.broker.retain_message(self._bus_client, packet)
-                await self.broker.publish_to_subscribers(packet)
-            except BaseException:
-                # a raising fan-out/enqueue must still settle the
-                # adopted trace or tracer.adopted_open leaks the
-                # stamping gates open (finish is idempotent)
-                if tr is not None:
-                    self.broker.tracer.finish(tr)
-                raise
-            if tr is not None and (self.broker.matcher is None
-                                   or self.broker._pub_consumer is None):
-                self.broker.tracer.finish(tr)
-
-    def _adopt_bus_trace(self, packet: Packet):
-        """Open a correlated child trace for a bus injection carrying
-        ``mq-trace`` (ADR 017). Identity-only adoption: start is local
-        arrival, so the e2e reads bus-arrival -> local-terminal."""
-        up = packet.properties.user_properties
-        if not up:
-            return None
-        ref = next((v for k, v in up if k == "mq-trace"), None)
-        if ref is None:
-            return None
-        tracer = getattr(self.broker, "tracer", None)
-        if tracer is None:
-            return None
-        try:
-            origin, _sep, tid = ref.rpartition(":")
-            now = tracer.clock()
-            tr = tracer.adopt(origin or "bus", int(tid), packet.topic,
-                              packet.fixed.qos, 1, now)
-        except ValueError:
-            return None
-        tr.span("bridge_in", now, tracer.clock())
-        packet._trace = tr
-        return tr
-
-    # -- $share ownership gossip --------------------------------------
-    #
-    # counts track LIVE members only (a worker whose members are all
-    # offline must not own the pick — the alive-filter would drop the
-    # message pool-wide), maintained incrementally per client event:
-    # each event re-derives only THAT client's contribution, O(its
-    # subscriptions), never a full index scan.
-
-    def on_subscribed(self, client, packet, reason_codes, counts) -> None:
-        self._update_contrib(client)
-
-    def on_unsubscribed(self, client, packet) -> None:
-        self._update_contrib(client)
-
-    def on_disconnect(self, client, err, expire: bool) -> None:
-        self._update_contrib(client, live=False)
-
-    def on_session_established(self, client, packet) -> None:
-        # resumed sessions restore their subscriptions (live again); a
-        # fresh session contributes nothing yet, but the takeover frame
-        # must fire either way so no other worker keeps the old live
-        # session for this id
-        self._update_contrib(client)
-        if self._writer is not None:
-            self._writer.write(_frame(FRAME_TAKEOVER, json.dumps({
-                "w": self.worker_id, "cid": client.id}).encode()))
-
-    @staticmethod
-    def _client_shared(client) -> set[tuple[str, str]]:
-        out = set()
-        for filt in client.subscriptions:
-            if filt.startswith("$share/"):
-                _, group, _ = (filt.split("/", 2) + [""])[:3]
-                out.add((group, filt))
-        return out
-
-    def _update_contrib(self, client, live: bool = True) -> None:
-        if client is None or client.id == BUS_CLIENT_ID:
-            return
-        new = self._client_shared(client) if live else set()
-        old = self._contrib.get(client.id, set())
-        if new == old:
-            return
-        if new:
-            self._contrib[client.id] = new
-        else:
-            self._contrib.pop(client.id, None)
-        for key in old - new:
-            n = self._local.get(key, 0) - 1
-            if n > 0:
-                self._local[key] = n
-            else:
-                self._local.pop(key, None)
-        for key in new - old:
-            self._local[key] = self._local.get(key, 0) + 1
-        self._gossip()
-
-    def _gossip(self) -> None:
-        if self._writer is None:
-            return
-        # keep our own view coherent too (we never hear our own gossip)
-        self.shares.replace_member(self.worker_id, self._local)
-        self._writer.write(_frame(FRAME_MEMBERSHIP, json.dumps({
-            "w": self.worker_id,
-            "members": [[g, f, n] for (g, f), n in self._local.items()],
-        }).encode()))
-
-    async def _absorb_takeover(self, payload: bytes) -> None:
-        """Another worker established a session for this client id: any
-        live local session with that id is taken over [MQTT-3.1.4-2]."""
-        from ..protocol import codes
-        from ..protocol.packets import ProtocolError
-
-        msg = json.loads(payload)
-        client = self.broker.clients.get(msg["cid"])
-        if client is None or client.closed:
-            return
-        client.taken_over = True
-        self.broker.disconnect_client(client, codes.ErrSessionTakenOver)
-        await client.stop(ProtocolError(codes.ErrSessionTakenOver))
-
-    def _absorb_membership(self, payload: bytes) -> None:
-        msg = json.loads(payload)
-        w = int(msg["w"])
-        self.shares.replace_member(
-            w, {(g, f): int(n) for g, f, n in msg["members"]})
-
-    def _owns(self, group: str, filt: str) -> bool:
-        # no gossip yet: the ledger answers True (origin delivers) —
-        # at worst a short double-delivery window at startup
-        return self.shares.owns((group, filt))
-
-    # declares that on_select_subscribers only drops keys from the
-    # outer ``shared`` dict, letting the broker skip the per-record
-    # deep copy on shared-free publishes (the hot path)
-    select_subscribers_shared_only = True
-
-    def on_select_subscribers(self, subscribers, packet):
-        if not subscribers.shared:
-            return subscribers
-        drop = [key for key in subscribers.shared
-                if not self._owns(*key)]
-        if drop:
-            for key in drop:
-                del subscribers.shared[key]
-        return subscribers
-
-
-async def run_worker(conf, logger, worker_id: int, bus_path: str,
-                     ready: asyncio.Event | None = None,
-                     stop: asyncio.Event | None = None) -> None:
-    """One pool worker: the standard bootstrap broker + BusHook, with
-    the TCP listener bound SO_REUSEPORT (build_broker does that when
-    conf.workers > 1)."""
-    import dataclasses
-
-    from ..bootstrap import build_broker, build_metrics
-
+    siblings = ",".join(
+        f"{worker_node_id(conf, j)}@unix:{worker_sock(pool_dir, j)}"
+        for j in range(conf.workers) if j != worker_id)
+    peers = ",".join(p for p in (siblings, conf.cluster_peers.strip(", "))
+                     if p)
+    kw = dict(cluster_node_id=worker_node_id(conf, worker_id),
+              cluster_peers=peers,
+              cluster_session_sync=conf.worker_session_sync)
+    if worker_id != conf.worker_journal_owner:
+        kw["storage_backend"] = ""
     if worker_id != 0:
         # SO_REUSEPORT shards the TCP/WS listeners; the unix-socket and
-        # $SYS-HTTP listeners (and metrics) cannot share an address, so
-        # worker 0 owns them
-        conf = dataclasses.replace(conf, mqtt_unix_socket="",
-                                   mqtt_sys_http_address="")
-    broker = build_broker(conf, logger)
-    hook = BusHook(worker_id, bus_path)
-    broker.add_hook(hook)
-    if conf.matcher == "service":
-        # pool workers share ONE chip-owning matcher service (ADR 005):
-        # every worker forwards its own clients' subscription ops and
-        # all workers' match requests coalesce on the service's batcher
-        # — each behind its own ADR-011 supervisor unless opted out
-        # (same wiring as the single-process boot, one source of truth)
-        from ..bootstrap import _maybe_attach_service
-        await _maybe_attach_service(conf, broker)
-    metrics = build_metrics(conf, broker, logger) if worker_id == 0 else None
-    # bus first, listeners second: a client accepted before the bus is
-    # connected would publish into a void
-    await hook.attach(broker)
+        # $SYS-HTTP listeners (and metrics) cannot share an address
+        kw["mqtt_unix_socket"] = ""
+        kw["mqtt_sys_http_address"] = ""
+    if conf.matcher in _SIDECAR_MATCHERS:
+        kw["matcher"] = "service"
+        kw["matcher_socket"] = matcher_sock(pool_dir)
+    return dataclasses.replace(conf, **kw)
+
+
+def _tune_local_links(manager, conf) -> None:
+    """Apply the ``worker_link_*`` knobs to the loopback links ONLY —
+    a mixed box's external TCP links keep the ``cluster_link_*``
+    budget/keepalive they were built with."""
+    if manager is None:
+        return
+    for link in manager.links.values():
+        if link.local:
+            link.byte_budget = conf.worker_link_byte_budget
+            link.keepalive = float(conf.worker_link_keepalive)
+
+
+def build_worker_broker(wconf, logger, worker_id: int, pool_dir: str):
+    """One worker's broker: the standard bootstrap build (so cluster,
+    storage, tracing, and overload wiring are production-parity) plus
+    the sibling-bridge unix listener every peer worker dials."""
+    from ..bootstrap import build_broker
+    from .listeners import UnixListener
+
+    broker = build_broker(wconf, logger)
+    path = worker_sock(pool_dir, worker_id)
+    with contextlib.suppress(OSError):
+        os.unlink(path)     # stale socket from a crashed incarnation
+    broker.add_listener(UnixListener("peer-bridge", path))
+    _tune_local_links(broker.cluster, wconf)
+    return broker
+
+
+async def run_worker(conf, logger, worker_id: int, pool_dir: str,
+                     ready: asyncio.Event | None = None,
+                     stop: asyncio.Event | None = None) -> None:
+    """One pool worker process: derive the worker config, run the full
+    broker with its sibling mesh, serve until stopped."""
+    from ..bootstrap import _maybe_attach_service, build_metrics
+
+    wconf = worker_conf(conf, worker_id, pool_dir)
+    broker = build_worker_broker(wconf, logger, worker_id, pool_dir)
+    # service matcher attaches BEFORE metrics so the matcher families
+    # register (same ordering contract as run_server)
+    await _maybe_attach_service(wconf, broker)
+    metrics = build_metrics(wconf, broker, logger) if worker_id == 0 else None
     await broker.serve()
-    hook.announce()
     if metrics is not None:
         metrics.start()
     logger.with_prefix("worker").info("pool worker started",
-                                      worker=worker_id)
+                                      worker=worker_id,
+                                      node=wconf.cluster_node_id)
     if ready is not None:
         ready.set()
     if stop is None:
@@ -455,9 +180,6 @@ async def run_worker(conf, logger, worker_id: int, bus_path: str,
                 loop.add_signal_handler(sig, stop.set)
             except NotImplementedError:
                 pass
-    hook.on_bus_lost = stop.set      # parent died: don't serve split-brained
-    if hook.bus_lost:
-        stop.set()                   # EOF landed before the wiring
     if faults.fire(faults.POOL_WORKER):
         # injected worker death (ADR 011 fault suite; armed through the
         # MAXMQ_FAULTS env the pool parent propagates): exit now so the
@@ -466,10 +188,14 @@ async def run_worker(conf, logger, worker_id: int, bus_path: str,
     try:
         await stop.wait()
     finally:
-        hook.stop()
         await broker.close()
         if metrics is not None:
             metrics.stop()
+        matcher = broker.matcher
+        if matcher is not None and hasattr(matcher, "close"):
+            await matcher.close()
+        with contextlib.suppress(OSError):
+            os.unlink(worker_sock(pool_dir, worker_id))
 
 
 class PoolStats:
@@ -487,10 +213,14 @@ POOL_STATS = PoolStats()
 
 async def _supervise_workers(procs, spawn, boot, stats: PoolStats = None,
                              interval: float = 2.0) -> None:
-    """A worker that dies (crash, bus eviction, OOM kill) is logged,
+    """A worker that dies (crash, OOM kill, injected fault) is logged,
     counted (stats.worker_restarts -> maxmq_pool_worker_restarts_total),
     and respawned — the pool must not silently degrade to N-1.
-    Throttled per slot so a crash loop can't fork-bomb the host."""
+    Throttled per slot so a crash loop can't fork-bomb the host. The
+    respawned incarnation re-binds its SO_REUSEPORT share and its
+    sibling bridge socket; peers reconnect through the local links'
+    fast backoff and re-exchange routes/sessions (epoch-fenced, so the
+    dead incarnation's state flushes on arrival)."""
     stats = stats if stats is not None else POOL_STATS
     last_spawn = [0.0] * len(procs)
     while True:
@@ -509,43 +239,130 @@ async def _supervise_workers(procs, spawn, boot, stats: PoolStats = None,
             stats.worker_restarts += 1
 
 
+async def await_mesh(brokers, timeout: float = 10.0) -> None:
+    """Wait until every worker's link to every sibling is connected —
+    the pool's "serving" point. Route/session exchange starts at each
+    link-up, so callers that need a specific filter visible on a
+    specific worker poll :func:`await_routes` after subscribing."""
+    deadline = time.monotonic() + timeout
+    while True:
+        down = [(b.cluster.node_id, peer)
+                for b in brokers
+                for peer, link in b.cluster.links.items()
+                if link.local and not link.connected]
+        if not down:
+            return
+        if time.monotonic() >= deadline:
+            raise TimeoutError(f"worker mesh not converged: {down}")
+        await asyncio.sleep(0.01)
+
+
+async def await_routes(broker, topic: str, n: int = 1,
+                       timeout: float = 5.0) -> None:
+    """Poll until ``broker``'s route table forwards ``topic`` to at
+    least ``n`` peers. Publish forwarding is route-driven (unlike the
+    ADR-005 bus, which broadcast blindly), so a subscribe on one worker
+    is visible to a publisher on another only after the route
+    advertisement lands — tests hop this barrier explicitly instead of
+    sleeping."""
+    deadline = time.monotonic() + timeout
+    while len(broker.cluster.routes.nodes_for(topic)) < n:
+        if time.monotonic() >= deadline:
+            raise TimeoutError(f"route for {topic!r} never reached "
+                               f"{broker.cluster.node_id}")
+        await asyncio.sleep(0.01)
+
+
 @contextlib.asynccontextmanager
-async def inprocess_pool(n: int = 2, bus_path: str | None = None):
-    """N pool workers in ONE process: the same Broker/BusHook/FanoutBus
-    objects the subprocess pool runs, minus the process boundary (which
-    only the kernel's SO_REUSEPORT accept sharding cares about). Yields
-    (brokers, ports). Used by the cross-worker test suite and the
-    overhead measurement harness (tools/measure_pool.py); also the
-    embedding surface for hosts that want a pool without subprocesses."""
-    bus_path = bus_path or f"/tmp/maxmq-bus-inproc-{os.getpid()}.sock"
-    bus = FanoutBus(bus_path)
-    await bus.start()
-    brokers, hooks, ports = [], [], []
+async def inprocess_pool(n: int = 2, link_dir: str | None = None,
+                         conf=None, converge: bool = True):
+    """N pool workers in ONE process: the same build_worker_broker
+    wiring the subprocess pool runs — per-worker ClusterManager, unix
+    mesh links, shared-singleton config derivation — minus the process
+    boundary (which only the kernel's SO_REUSEPORT accept sharding
+    cares about; here each worker binds its own ephemeral port so
+    tests can target a specific worker). Yields (brokers, ports).
+    Used by the cross-worker test suite and the overhead measurement
+    harness (tools/measure_pool.py); also the embedding surface for
+    hosts that want a pool without subprocesses."""
+    from ..utils.config import Config
+    from ..utils.logger import new_logger
+
+    link_dir = link_dir or f"/tmp/maxmq-pool-inproc-{os.getpid()}"
+    os.makedirs(link_dir, exist_ok=True)
+    base = dataclasses.replace(
+        conf or Config(), workers=n,
+        mqtt_tcp_address="127.0.0.1:0", mqtt_unix_socket="",
+        mqtt_sys_http_address="", mqtt_sys_topic_interval=0,
+        metrics_enabled=False)
+    logger = new_logger(fmt="json", level="error")
+    brokers, ports = [], []
     try:
         for i in range(n):
-            from ..hooks import AllowHook
-            from .listeners import TCPListener
-            from .server import Broker, BrokerOptions, Capabilities
-            b = Broker(BrokerOptions(capabilities=Capabilities(
-                sys_topic_interval=0)))
-            b.add_hook(AllowHook())
-            hook = BusHook(i, bus_path)
-            b.add_hook(hook)
-            lst = b.add_listener(TCPListener(f"tcp{i}", "127.0.0.1:0"))
+            wconf = worker_conf(base, i, link_dir)
+            b = build_worker_broker(wconf, logger, i, link_dir)
             await b.serve()
-            await hook.attach(b)
             brokers.append(b)
-            hooks.append(hook)
+            lst = b.listeners.get("tcp")
             ports.append(lst._server.sockets[0].getsockname()[1])
+        if converge:
+            await await_mesh(brokers)
         yield brokers, ports
     finally:
-        for h in hooks:
-            h.stop()
         for b in brokers:
             await b.close()
-        await bus.close()
-        with contextlib.suppress(FileNotFoundError):
-            os.unlink(bus_path)
+        for i in range(n):
+            with contextlib.suppress(OSError):
+                os.unlink(worker_sock(link_dir, i))
+
+
+def _engine_factory(conf):
+    """The sidecar's engine build, mirroring bootstrap.build_matcher's
+    device branches (sig/nfa/dense, mesh-sharded when configured) —
+    the ONE table compile per box the workers share."""
+    def factory(index):
+        from ..matching.batcher import MicroBatcher
+        if conf.matcher_mesh:
+            from ..parallel.sharded import (ShardedNFAEngine,
+                                            ShardedSigEngine, make_mesh)
+            rows, _, cols = conf.matcher_mesh.partition("x")
+            mesh = make_mesh(shape=(int(rows), int(cols or 1)))
+            if conf.matcher == "nfa":
+                engine = ShardedNFAEngine(index, mesh=mesh,
+                                          max_levels=conf.matcher_max_levels)
+            else:
+                engine = ShardedSigEngine(index, mesh=mesh)
+                engine.emit_intents = conf.matcher_intents
+        elif conf.matcher == "nfa":
+            from ..matching.engine import NFAEngine
+            engine = NFAEngine(index, max_levels=conf.matcher_max_levels)
+        elif conf.matcher == "dense":
+            from ..matching.dense import DenseEngine
+            engine = DenseEngine(index, max_levels=conf.matcher_max_levels)
+        else:
+            from ..matching.sig import SigEngine
+            engine = SigEngine(index, max_levels=conf.matcher_max_levels)
+            engine.emit_intents = conf.matcher_intents
+        return MicroBatcher(engine,
+                            window_us=conf.matcher_batch_window_us,
+                            max_batch=conf.matcher_max_batch)
+    return factory
+
+
+async def _maybe_pool_matcher_service(conf, pool_dir: str):
+    """ADR 021: one chip-owning matcher sidecar per box. The parent
+    owns it (accelerator runtimes are single-claim — N workers cannot
+    each hold the device), workers attach as ``matcher=service``
+    clients behind their own ADR-011 supervisors, so a sidecar crash
+    degrades every worker to its CPU trie and the reconnect ladder
+    reseeds — never a pool-wide wedge."""
+    if conf.matcher not in _SIDECAR_MATCHERS:
+        return None
+    from ..matching.service import MatcherService
+    svc = MatcherService(matcher_sock(pool_dir),
+                         engine_factory=_engine_factory(conf))
+    await svc.start()
+    return svc
 
 
 def _worker_spawner(env: dict):
@@ -582,18 +399,20 @@ def _worker_spawner(env: dict):
 
 async def run_pool(conf, logger, ready: asyncio.Event | None = None,
                    stop: asyncio.Event | None = None) -> None:
-    """The pool parent: fan-out bus + N worker subprocesses. The parent
-    never touches a client socket — the kernel (SO_REUSEPORT) shards
-    accepts directly onto the workers."""
+    """The pool parent: shared matcher sidecar + N worker subprocesses
+    + supervision. The parent never touches a client socket — the
+    kernel (SO_REUSEPORT) shards accepts directly onto the workers —
+    and (since ADR 021) never relays a message either: the workers
+    mesh directly over their unix bridge sockets."""
     from ..utils.config import config_as_dict
 
     boot = logger.with_prefix("pool")
-    bus_path = f"/tmp/maxmq-bus-{os.getpid()}.sock"
-    bus = FanoutBus(bus_path)
-    await bus.start()
+    pool_dir = conf.worker_link_dir or f"/tmp/maxmq-pool-{os.getpid()}"
+    os.makedirs(pool_dir, exist_ok=True)
+    service = await _maybe_pool_matcher_service(conf, pool_dir)
 
     env = dict(os.environ)
-    env["MAXMQ_BUS"] = bus_path
+    env[POOL_DIR_ENV] = pool_dir
     env["MAXMQ_POOL_CONF"] = json.dumps(config_as_dict(conf))
     spawn = _worker_spawner(env)
 
@@ -611,7 +430,8 @@ async def run_pool(conf, logger, ready: asyncio.Event | None = None,
                                 logger=logger.with_prefix("pool-metrics"))
         metrics.start()
     boot.info("worker pool started", workers=conf.workers,
-              bus=bus_path, tcp=conf.mqtt_tcp_address)
+              pool_dir=pool_dir, tcp=conf.mqtt_tcp_address,
+              matcher_sidecar=bool(service))
     if ready is not None:
         ready.set()
     if stop is None:
@@ -640,8 +460,10 @@ async def run_pool(conf, logger, ready: asyncio.Event | None = None,
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
-        await bus.close()
-        try:
-            os.unlink(bus_path)
-        except FileNotFoundError:
-            pass
+        if service is not None:
+            await service.close()
+        for i in range(conf.workers):
+            with contextlib.suppress(OSError):
+                os.unlink(worker_sock(pool_dir, i))
+        with contextlib.suppress(OSError):
+            os.rmdir(pool_dir)

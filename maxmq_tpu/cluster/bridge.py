@@ -85,6 +85,15 @@ class BridgeLink:
         self.backoff_initial_s = backoff_initial_s
         self.backoff_max_s = backoff_max_s
         self.connect_timeout = connect_timeout
+        # ADR 021: unix-domain loopback link (worker mesh) — connects
+        # by path, skips clock-skew probes (one host, one clock), and
+        # the pool wiring passes byte_budget=0 (budget-exempt)
+        self.local = spec.local
+        if self.local:
+            # a sibling's socket appears within milliseconds of its
+            # boot/respawn; the TCP backoff floor would dominate pool
+            # start and post-crash reconvergence
+            self.backoff_initial_s = min(self.backoff_initial_s, 0.05)
 
         broker = manager.broker
         self.outbound = OutboundQueue(
@@ -196,8 +205,15 @@ class BridgeLink:
         client = MQTTClient(
             client_id=BRIDGE_ID_PREFIX + self.node_id,
             keepalive=max(int(self.keepalive * 3), 1))
-        await client.connect(self.spec.host, self.spec.port,
-                             timeout=self.connect_timeout)
+        if self.local:
+            # ADR 021 local flavor: unix-domain transport to a sibling
+            # worker on this box — no TCP handshake, no network in the
+            # failure model (the peer process dying IS the link dying)
+            await client.connect(path=self.spec.path,
+                                 timeout=self.connect_timeout)
+        else:
+            await client.connect(self.spec.host, self.spec.port,
+                                 timeout=self.connect_timeout)
         self.client = client
         self.hb_seq = 0             # fresh connection, fresh audit frame
         self.items_sent = 0

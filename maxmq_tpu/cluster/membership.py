@@ -35,11 +35,21 @@ class PeerSpec:
     node_id: str
     host: str
     port: int
+    # ADR 021 local link flavor: a non-empty unix-socket path replaces
+    # host:port — the bridge connects over the loopback filesystem
+    # (no TCP handshake, budget-exempt, clock skew pinned to zero)
+    path: str = ""
+
+    @property
+    def local(self) -> bool:
+        return bool(self.path)
 
 
 def parse_peers(spec: str) -> list[PeerSpec]:
     """Parse ``cluster_peers``: comma-separated ``node@host:port``
-    entries (``nodeB@10.0.0.2:1883,nodeC@10.0.0.3:1883``)."""
+    entries (``nodeB@10.0.0.2:1883,nodeC@10.0.0.3:1883``). An
+    ``node@unix:/path.sock`` entry is an ADR-021 local (unix-domain)
+    peer — the in-box worker mesh rides these."""
     peers: list[PeerSpec] = []
     seen: set[str] = set()
     for entry in spec.split(","):
@@ -47,19 +57,29 @@ def parse_peers(spec: str) -> list[PeerSpec]:
         if not entry:
             continue
         node_id, at, addr = entry.partition("@")
-        host, colon, port_s = addr.rpartition(":")
-        if not at or not colon or not host:
+        if not at:
             raise PeerSpecError(
                 f"bad peer {entry!r} (want node@host:port)")
         if not valid_node_id(node_id):
             raise PeerSpecError(f"bad peer node id {node_id!r}")
         if node_id in seen:
             raise PeerSpecError(f"duplicate peer node id {node_id!r}")
+        seen.add(node_id)
+        if addr.startswith("unix:"):
+            path = addr[len("unix:"):]
+            if not path:
+                raise PeerSpecError(f"bad peer {entry!r} "
+                                    f"(want node@unix:/path.sock)")
+            peers.append(PeerSpec(node_id, "", 0, path=path))
+            continue
+        host, colon, port_s = addr.rpartition(":")
+        if not colon or not host:
+            raise PeerSpecError(
+                f"bad peer {entry!r} (want node@host:port)")
         try:
             port = int(port_s)
         except ValueError:
             raise PeerSpecError(f"bad peer port {port_s!r}") from None
-        seen.add(node_id)
         peers.append(PeerSpec(node_id, host, port))
     return peers
 

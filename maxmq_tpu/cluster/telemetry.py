@@ -320,6 +320,17 @@ class ClusterTelemetry:
     def probe_peer(self, link) -> None:
         if not link.connected:
             return
+        if getattr(link, "local", False):
+            # ADR 021: a loopback (unix-domain) worker link shares this
+            # host's monotonic clock — skew is zero by construction.
+            # Pin the estimate instead of probing so the correlated-
+            # trace math and /cluster/metrics read the truth at zero
+            # wire cost.
+            st = self.manager.membership.get(link.peer)
+            if st is not None:
+                st.skew_ns, st.rtt_ns = 0.0, 0.0
+                st.skew_samples += 1
+            return
         payload = json.dumps({"t0": self._clock()}).encode()
         if link.send_control(f"$cluster/clock/{self.node_id}", payload):
             self.probes_sent += 1

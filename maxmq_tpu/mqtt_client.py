@@ -78,12 +78,18 @@ class MQTTClient:
     # ------------------------------------------------------------------
 
     async def connect(self, host: str = "127.0.0.1", port: int = 1883,
-                      timeout: float = 5.0, reader=None, writer=None) -> Packet:
+                      timeout: float = 5.0, reader=None, writer=None,
+                      path: str | None = None) -> Packet:
         """Open the transport (or adopt a provided stream pair) and perform
-        the CONNECT/CONNACK handshake."""
+        the CONNECT/CONNACK handshake. ``path`` connects over a unix
+        domain socket instead of TCP (the ADR-021 local bridge flavor)."""
         if reader is None:
-            self.reader, self.writer = await asyncio.wait_for(
-                asyncio.open_connection(host, port), timeout)
+            if path is not None:
+                self.reader, self.writer = await asyncio.wait_for(
+                    asyncio.open_unix_connection(path), timeout)
+            else:
+                self.reader, self.writer = await asyncio.wait_for(
+                    asyncio.open_connection(host, port), timeout)
         else:
             self.reader, self.writer = reader, writer
         self.writer.write(self._connect_packet().encode())

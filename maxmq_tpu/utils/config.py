@@ -191,6 +191,24 @@ class Config:
     # conf.metrics_address): exposes maxmq_pool_* supervision counters
     pool_metrics_address: str = ""
 
+    # -- in-box worker mesh (ADR 021) -----------------------------------------
+    # workers > 1 federates the SO_REUSEPORT workers as cluster nodes
+    # over unix-domain bridge links (the `local` link flavor); these
+    # knobs tune ONLY the loopback links — the box's external cluster_*
+    # knobs are untouched and compose (worker 0 carries cluster_peers)
+    worker_link_keepalive: float = 1.0  # loopback ping interval, seconds
+    worker_link_byte_budget: int = 0    # per-link queued bytes; 0 =
+                                        # budget-exempt (loopback default;
+                                        # LINK_QUEUE_MAX still bounds)
+    # session replication policy on the worker mesh: always = QoS acks
+    # ride the loopback replication barrier, so a SIGKILLed worker's
+    # sibling redelivers every PUBACKed message (cheap on one box)
+    worker_session_sync: str = "always"
+    worker_link_dir: str = ""           # socket dir; "" = /tmp/maxmq-
+                                        # pool-<pid>
+    worker_journal_owner: int = 0       # which worker owns the ONE
+                                        # ADR-014 journal writer
+
     # -- profiling ----------------------------------------------------------
     profile: bool = False
     profile_path: str = "."

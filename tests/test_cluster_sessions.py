@@ -24,14 +24,11 @@ import pytest
 
 from maxmq_tpu import faults
 from maxmq_tpu.broker import Broker, BrokerOptions, Capabilities, TCPListener
-from maxmq_tpu.broker.workers import BusHook
 from maxmq_tpu.cluster import (ClusterManager, IncrementalCover, PeerSpec,
                                SessionEntry, ShareLedger, minimal_cover)
 from maxmq_tpu.hooks import AllowHook
-from maxmq_tpu.matching.trie import SubscriberSet
 from maxmq_tpu.mqtt_client import MQTTClient
 from maxmq_tpu.protocol import codes
-from maxmq_tpu.protocol.packets import Subscription
 
 
 @pytest.fixture(autouse=True)
@@ -358,62 +355,34 @@ async def test_cluster_wide_share_exactly_once_on_line():
 
 
 async def test_share_pool_and_cluster_ledgers_compose():
-    """The in-process worker pool and the cluster federation route
-    $share ownership through the SAME ledger interface — a filter
-    shared across both a pool and a peer node delivers at most once:
-    the pool hook drops non-owned groups from the select set, the
-    cluster guard skips groups a peer node owns."""
-    async with cluster({"A": ["B"], "B": ["A"]},
-                       session_sync="batched") as (brokers, mgrs):
+    """$share ownership on a box is ONE ledger per node (ADR 021: the
+    worker pool rides the federation's ShareLedger — the ADR-005 bus
+    hook with its private worker ledger is gone). A member id from a
+    foreign mesh segment (a pool worker's node id, a peer box) claims
+    ownership through the same set_member surface the session
+    federation feeds, and the select-time guard honors it."""
+    async with cluster({"A": ["B"], "B": ["A"]}, session_sync="batched",
+                       share_balance="pin") as (brokers, mgrs):
         A = brokers["A"]
-        hook = BusHook(worker_id=1, bus_path="/tmp/unused")
-        hook.broker = A
-        A.hooks.add(hook)
         member = await connect(A, "pc-member")
         await member.subscribe(("$share/g/s/t", 0))
         key = ("g", "$share/g/s/t")
         pub = await connect(A, "pc-pub")
 
-        # pool gossip: worker 0 (lower id) also has members -> worker 1
-        # does not own the pick; no local delivery even though the
-        # cluster side would deliver here
-        hook.shares.replace_member(0, {key: 1})
-        await pub.publish("s/t", b"pool-owned-elsewhere")
+        # a pool-worker node id with live members pins below "A" ->
+        # this node does not own the pick; no local delivery
+        mgrs["A"].routes.shares.set_member("0.w0", key, 1)
+        await pub.publish("s/t", b"worker-owned-elsewhere")
         with pytest.raises(asyncio.TimeoutError):
             await member.next_message(timeout=0.4)
 
-        # pool owns, but a lower-id CLUSTER node has live members ->
-        # the cluster guard skips the group
-        hook.shares.replace_member(0, {})
-        mgrs["A"].routes.shares.set_member("0-node", key, 1)
-        await pub.publish("s/t", b"cluster-owned-elsewhere")
-        with pytest.raises(asyncio.TimeoutError):
-            await member.next_message(timeout=0.4)
-
-        # both ledgers agree this instance owns -> exactly one delivery
-        mgrs["A"].routes.shares.set_member("0-node", key, 0)
+        # the worker ceded (all its members offline) -> local delivery
+        mgrs["A"].routes.shares.set_member("0.w0", key, 0)
         await pub.publish("s/t", b"owned-here")
         m = await member.next_message(timeout=5)
         assert m.payload == b"owned-here"
         await member.close()
         await pub.close()
-
-
-def test_bus_hook_select_routes_through_ledger():
-    """BusHook.on_select_subscribers consults the ShareLedger (the
-    satellite regression: pool membership no longer lives in a private
-    dict with its own ownership rules)."""
-    hook = BusHook(worker_id=2, bus_path="/tmp/unused")
-    key = ("g", "$share/g/a/b")
-    sset = SubscriberSet()
-    sset.add_shared("g", "$share/g/a/b", "c1",
-                    Subscription(filter="$share/g/a/b"))
-    hook.shares.replace_member(0, {key: 1})
-    out = hook.on_select_subscribers(sset.select_copy(), None)
-    assert key not in out.shared            # worker 0 owns
-    hook.shares.replace_member(0, {})
-    out = hook.on_select_subscribers(sset.select_copy(), None)
-    assert key in out.shared                # unclaimed: we deliver
 
 
 # ----------------------------------------------------------------------

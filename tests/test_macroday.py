@@ -61,6 +61,29 @@ async def test_macroday_smoke_slo_sheet_passes():
     assert not faults.REGISTRY.any_armed()
 
 
+async def test_macroday_sharded_box_same_slo_sheet():
+    """ADR 021: the SAME day replays against a sharded box — the
+    three roles become pool workers over unix bridge links (plus one
+    extra mesh member at workers=4) and the kill phase scores as
+    ``worker_kill`` through the unchanged scorer."""
+    day = MacroDay(storm_clients=9, telemetry_msgs=6, command_msgs=5,
+                   cut_msgs=6, parked_msgs=8, keepalive=0.5,
+                   will_grace=1.0, settle_s=10.0, workers=4)
+    sheet = await day.run()
+    assert sheet["pass"], f"SLO violations: {sheet['violations']}"
+    assert sheet["pubacked_loss"] == 0
+    assert sheet["workers"] == 4 and sheet["nodes"] == 4
+    assert sheet["takeover_session_present"]
+    assert sheet["wills_fired"] == 1
+    names = [p["name"] for p in sheet["phases"]]
+    assert names[-1] == "worker_kill" and "node_kill" not in names
+    # every link in the in-box mesh is a local (unix) one
+    assert all(ln.local for n in ("A", "C")
+               for ln in day.mgrs[n].links.values())
+
+test_macroday_sharded_box_same_slo_sheet._async_timeout = 120
+
+
 def test_bench_compare_gates_slo_fields():
     """The SLO sheet's loss / recovery / violation fields must be
     lower-better AND gated, or the macroday row stops blocking."""
@@ -76,6 +99,14 @@ def test_bench_compare_gates_slo_fields():
                    "heal_convergence_ms", "violations_count"):
         assert _direction(metric) == -1, metric
         assert _gated(metric), metric
+    # ADR 021: the cshard scaling row's throughput keys are
+    # higher-better AND gated; the speedup ratios stay informational
+    # (a single-core box cannot promise >1x)
+    for metric in ("w4_accepts_per_sec", "w2_qos0_delivered_per_sec",
+                   "w4_qos1_delivered_per_sec"):
+        assert _direction(metric) == 1, metric
+        assert _gated(metric), metric
+    assert _direction("qos1_speedup_w4") == 0
     # a zero-loss baseline regressing to ANY loss is inf delta -> gate
     old = {"macroday": {"pubacked_loss": 0.0,
                         "takeover_recovery_ms": 1000.0}}

@@ -1,35 +1,56 @@
-"""Delivery-worker pool (ADR 005): cross-worker semantics.
+"""Worker-pool cross-worker semantics (ADR 005 pool, ADR 021 wiring).
 
 This box has one core, so these tests assert CORRECTNESS of the
-SO_REUSEPORT pool + fan-out bus (cross-worker delivery, retained
-convergence, $share exactly-once), not speedup. The pool runs
-in-process here: two Broker instances on distinct loopback ports wired
-to one FanoutBus — the same objects the subprocess pool runs, minus the
-process boundary, which only the kernel's accept sharding cares about.
+SO_REUSEPORT pool (cross-worker delivery, retained convergence, $share
+exactly-once, takeover), not speedup. Since ADR 021 the workers are
+cluster nodes meshed over unix-domain bridge links — the pool runs
+in-process here: N Broker instances built by the same
+build_worker_broker wiring the subprocess pool uses, on distinct
+loopback ports so each test can target a specific worker.
+
+Publish forwarding is route-driven now (the ADR-005 bus broadcast
+blindly), so tests hop the route/ledger convergence barriers
+explicitly (await_routes / poll_until) instead of sleeping.
 """
 
 import asyncio
 import contextlib
 import os
+import time
 
-from maxmq_tpu.broker.workers import inprocess_pool
+from maxmq_tpu.broker.workers import (await_routes, inprocess_pool,
+                                      worker_sock)
 from maxmq_tpu.mqtt_client import MQTTClient
 
 
 @contextlib.asynccontextmanager
 async def running_pool(n: int = 2):
     async with inprocess_pool(
-            n, bus_path=f"/tmp/maxmq-test-bus-{os.getpid()}.sock") as out:
+            n, link_dir=f"/tmp/maxmq-test-pool-{os.getpid()}") as out:
         yield out
 
 
+async def poll_until(pred, timeout: float = 5.0,
+                     what: str = "condition") -> None:
+    deadline = time.monotonic() + timeout
+    while not pred():
+        if time.monotonic() >= deadline:
+            raise TimeoutError(f"{what} never converged")
+        await asyncio.sleep(0.01)
+
+
+def share_members(broker, key):
+    return broker.cluster.routes.shares.members_for(key)
+
+
 async def test_cross_worker_delivery():
-    async with running_pool(2) as (_brokers, ports):
+    async with running_pool(2) as (brokers, ports):
         sub = MQTTClient("w-sub")
         await sub.connect("127.0.0.1", ports[0])
         await sub.subscribe("pool/+/x")
         pub = MQTTClient("w-pub")
         await pub.connect("127.0.0.1", ports[1])   # OTHER worker
+        await await_routes(brokers[1], "pool/a/x")
         await pub.publish("pool/a/x", b"crossed")
         m = await sub.next_message(5)
         assert m.payload == b"crossed"
@@ -39,6 +60,7 @@ async def test_cross_worker_delivery():
         await sub2.subscribe("pool/#")
         pub2 = MQTTClient("w-pub2")
         await pub2.connect("127.0.0.1", ports[0])
+        await await_routes(brokers[0], "pool/b/x")
         await pub2.publish("pool/b/x", b"back")
         m = await sub2.next_message(5)
         assert m.payload == b"back"
@@ -47,11 +69,14 @@ async def test_cross_worker_delivery():
 
 
 async def test_retained_converges_across_workers():
-    async with running_pool(2) as (_brokers, ports):
+    async with running_pool(2) as (brokers, ports):
         pub = MQTTClient("r-pub")
         await pub.connect("127.0.0.1", ports[0])
         await pub.publish("pool/ret/x", b"kept", retain=True)
-        await asyncio.sleep(0.1)       # bus propagation
+        # retained publishes flood every link; wait for the fan-in
+        await poll_until(
+            lambda: brokers[1].cluster.forwards_delivered >= 1,
+            what="retained forward")
         fresh = MQTTClient("r-fresh")
         await fresh.connect("127.0.0.1", ports[1])   # OTHER worker
         await fresh.subscribe("pool/ret/#")
@@ -62,14 +87,21 @@ async def test_retained_converges_across_workers():
 
 
 async def test_shared_group_exactly_once_across_workers():
-    async with running_pool(2) as (_brokers, ports):
+    async with running_pool(2) as (brokers, ports):
+        key = ("g", "$share/g/pool/sh")
         m0 = MQTTClient("s-m0")
         await m0.connect("127.0.0.1", ports[0])
         await m0.subscribe("$share/g/pool/sh", qos=0)
         m1 = MQTTClient("s-m1")
         await m1.connect("127.0.0.1", ports[1])
         await m1.subscribe("$share/g/pool/sh", qos=0)
-        await asyncio.sleep(0.15)      # membership gossip settles
+        # both workers' ledgers must agree on the membership before
+        # publishing, or the divergence window double/zero-delivers
+        await poll_until(
+            lambda: set(share_members(brokers[0], key)) == {"w0", "w1"}
+            and set(share_members(brokers[1], key)) == {"w0", "w1"},
+            what="share ledger")
+        await await_routes(brokers[1], "pool/sh")
         pub = MQTTClient("s-pub")
         await pub.connect("127.0.0.1", ports[1])
         n = 10
@@ -102,7 +134,8 @@ async def test_cross_worker_takeover():
 async def test_shared_owner_skips_offline_members():
     # a worker whose only group member went offline must cede ownership
     # so the live member on the other worker still receives
-    async with running_pool(2) as (_brokers, ports):
+    async with running_pool(2) as (brokers, ports):
+        key = ("g", "$share/g/pool/so")
         m0 = MQTTClient("so-m0", clean_start=False, session_expiry=300,
                         version=5)
         await m0.connect("127.0.0.1", ports[0])
@@ -110,9 +143,16 @@ async def test_shared_owner_skips_offline_members():
         m1 = MQTTClient("so-m1")
         await m1.connect("127.0.0.1", ports[1])
         await m1.subscribe("$share/g/pool/so", qos=0)
-        await asyncio.sleep(0.15)
+        await poll_until(
+            lambda: set(share_members(brokers[0], key)) == {"w0", "w1"},
+            what="share ledger")
         await m0.close()                     # offline; session persists
-        await asyncio.sleep(0.15)            # liveness gossip settles
+        # the ledger counts LIVE members only: w0 must cede everywhere
+        await poll_until(
+            lambda: share_members(brokers[0], key) == ["w1"]
+            and share_members(brokers[1], key) == ["w1"],
+            what="offline member ceding ownership")
+        await await_routes(brokers[0], "pool/so")
         pub = MQTTClient("so-pub")
         await pub.connect("127.0.0.1", ports[0])
         for i in range(5):
@@ -127,12 +167,13 @@ async def test_shared_owner_skips_offline_members():
 
 
 async def test_qos1_delivery_across_workers():
-    async with running_pool(2) as (_brokers, ports):
+    async with running_pool(2) as (brokers, ports):
         sub = MQTTClient("q-sub")
         await sub.connect("127.0.0.1", ports[0])
         await sub.subscribe(("pool/q1", 1))
         pub = MQTTClient("q-pub")
         await pub.connect("127.0.0.1", ports[1])
+        await await_routes(brokers[1], "pool/q1")
         await pub.publish("pool/q1", b"ackd", qos=1)
         m = await sub.next_message(5)
         assert m.payload == b"ackd"
@@ -141,11 +182,18 @@ async def test_qos1_delivery_across_workers():
         await pub.disconnect()
 
 
+async def test_worker_sock_layout():
+    """The mesh sockets live inside the pool dir, one per worker —
+    the layout the subprocess pool, the in-process pool, and the
+    sibling peer specs must all agree on."""
+    assert worker_sock("/tmp/p", 3) == "/tmp/p/w3.sock"
+
+
 async def test_pool_workers_share_one_matcher_service(tmp_path):
-    """The flagship composition (ADR 005 + 006): N pool workers, ONE
-    chip-owning matcher service. Each worker forwards its own clients'
-    subscription ops; cross-worker publishes ride the fan-out bus and
-    each worker's matches route through the shared service."""
+    """The flagship composition (ADR 005 + 006 + 021): N pool workers,
+    ONE chip-owning matcher service. Each worker forwards its own
+    clients' subscription ops; cross-worker publishes ride the bridge
+    mesh and each worker's matches route through the shared service."""
     from maxmq_tpu.matching.service import (MatcherService,
                                             attach_matcher_service)
 
@@ -161,6 +209,7 @@ async def test_pool_workers_share_one_matcher_service(tmp_path):
             await sub.subscribe("svcpool/+/x")
             pub = MQTTClient("ps-pub")
             await pub.connect("127.0.0.1", ports[1])   # OTHER worker
+            await await_routes(brokers[1], "svcpool/a/x")
             await pub.publish("svcpool/a/x", b"via-svc")
             m = await sub.next_message(5)
             assert m.payload == b"via-svc"

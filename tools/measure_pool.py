@@ -2,9 +2,9 @@
 (VERDICT r03 #8): the costs that BOUND the pool's scaling claim are all
 measurable here even though speedup is not —
 
-  bus_forward   per-message cost of the fan-out bus forwarding every
+  mesh_forward  per-message cost of the loopback-bridge mesh carrying a
                 publish (pool same-worker delivery vs single broker)
-  bus_hop       added cost when delivery crosses workers (pool
+  mesh_hop      added cost when delivery crosses workers (pool
                 cross-worker vs pool same-worker)
   gossip        per-membership-change cost of $share ownership gossip
                 (shared subscribe/unsubscribe rate vs plain, on-pool)
@@ -54,8 +54,7 @@ async def single_broker():
 @contextlib.asynccontextmanager
 async def pool(n: int = 2):
     async with inprocess_pool(
-            n,
-            bus_path=f"/tmp/maxmq-measure-bus-{os.getpid()}.sock") \
+            n, link_dir=f"/tmp/maxmq-measure-pool-{os.getpid()}") \
             as (_brokers, ports):
         yield ports
 
@@ -91,8 +90,8 @@ async def measure_bus() -> dict:
         "single_broker_msgs_per_sec": round(base, 1),
         "pool_same_worker_msgs_per_sec": round(same, 1),
         "pool_cross_worker_msgs_per_sec": round(cross, 1),
-        "bus_forward_us_per_msg": round(us(same) - us(base), 1),
-        "bus_hop_us_per_msg": round(us(cross) - us(same), 1),
+        "mesh_forward_us_per_msg": round(us(same) - us(base), 1),
+        "mesh_hop_us_per_msg": round(us(cross) - us(same), 1),
     }
 
 
@@ -123,7 +122,7 @@ async def measure_gossip() -> dict:
 
 async def measure_takeover() -> dict:
     """Cross-worker takeover PROPAGATION latency: CONNECT on worker B
-    with an id live on worker A -> A's connection killed over the bus
+    with an id live on worker A -> A's connection killed over the mesh
     ([MQTT-3.1.4-2] across the pool; session state is per-worker, so
     what propagates is the termination)."""
     lats = []
