@@ -1992,6 +1992,45 @@ def bench_macroday(scale: float = 1.0) -> dict:
     return d
 
 
+def bench_geoday(scale: float = 1.0) -> dict:
+    """ADR-022 WAN-shaped geo-federation day (MAXMQ_BENCH_CONFIGS=
+    geoday): harness/geoday.py runs a 3-region mesh whose links are
+    shaped at real WAN round trips (30/80/150ms, asymmetric bandwidth
+    on the ap legs, loss on the eu->us data path) — regional QoS1
+    fan-in to a global aggregator, a cross-region $share group, a
+    full region outage with the stranded session taken over at a
+    survivor (parked forwards rehomed off the dead link) + heal on
+    the old address, and a client roaming between regions mid-stream.
+    Scored against one SLO sheet: zero PUBACKed loss, will
+    exactly-once, ZERO false flaps on the 150ms link, heal + takeover
+    bounded relative to the configured RTT (bench_compare scales the
+    *_ms floors by the row's rtt_ms)."""
+    import asyncio
+
+    from maxmq_tpu import faults
+
+    from harness.geoday import GeoDay
+
+    def n(base: int, floor: int) -> int:
+        return max(floor, int(base * scale))
+
+    try:
+        d = asyncio.run(GeoDay(
+            fanin_msgs=n(20, 6), share_msgs=n(18, 6),
+            outage_msgs=n(20, 6), roam_msgs=n(12, 6)).run())
+    finally:
+        faults.clear()      # a leaked armed shape must not outlive this
+    log(f"[geoday] pass={d['pass']} "
+        f"loss={d['pubacked_loss']}/{d['pubacked_total']} "
+        f"wills={d['wills_fired']} "
+        f"false-flaps={d['false_link_flaps']} "
+        f"rehomed={d['fwd_parked_rehomed']} "
+        f"heal={d['heal_convergence_ms']}ms "
+        f"roam={d['takeover_recovery_ms']}ms "
+        f"violations={d['violations']}")
+    return d
+
+
 def bench_cshard(storm: int = 200, msgs: int = 300,
                  pairs: int = 4) -> dict:
     """ADR-021 in-box cluster scaling (MAXMQ_BENCH_CONFIGS=cshard):
@@ -2700,6 +2739,11 @@ def main() -> None:
         # armed concurrently on a 3-node mesh, scored against one SLO
         # sheet (loss=0, will exactly-once, recovery times)
         runs.append(("macroday", lambda: bench_macroday(scale=scale)))
+    if "geoday" in which:
+        # ADR-022 WAN-shaped geo-federation: 3 regions at 30/80/150ms
+        # RTT with asymmetric bandwidth + loss, scored for zero loss,
+        # zero false flaps, RTT-relative heal/takeover bounds
+        runs.append(("geoday", lambda: bench_geoday(scale=scale)))
     if "cshard" in which:
         # ADR-021 in-box cluster: subprocess worker pool on one
         # SO_REUSEPORT port — accept rate + aggregate QoS0/QoS1
@@ -2793,7 +2837,8 @@ CONFIG_DEADLINES = {"1": 900, "2": 900, "3": 1200, "4": 2400,
                     "latdo": 1200, "5": 2400, "e2e": 4200,
                     "widthab": 1200, "degraded": 1200, "overload": 900,
                     "cluster": 900, "durable": 900, "failover": 900,
-                    "fanout": 900, "macroday": 900, "cshard": 900}
+                    "fanout": 900, "macroday": 900, "cshard": 900,
+                    "geoday": 900}
 
 
 def run_supervised(which: list[str]) -> None:

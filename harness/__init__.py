@@ -7,6 +7,7 @@ on a live multi-node cluster — and score the run against one
 machine-checkable SLO sheet.
 """
 
+from .geoday import GeoDay
 from .macroday import MacroDay
 
-__all__ = ["MacroDay"]
+__all__ = ["GeoDay", "MacroDay"]

@@ -179,6 +179,7 @@ def build_cluster(conf: Config, broker: Broker, logger: Logger | None = None):
         trace_return=conf.cluster_trace_return,
         telemetry_interval_s=float(conf.cluster_telemetry_interval_s),
         telemetry_full_every=conf.cluster_telemetry_full_every,
+        rtt_deadline_k=float(conf.cluster_rtt_deadline_k),
         logger=logger.with_prefix("cluster") if logger else None)
     broker.attach_cluster(manager)
     return manager

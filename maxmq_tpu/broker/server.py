@@ -855,6 +855,20 @@ class Broker:
             else:
                 mgr.partition_drops_in += 1
                 return      # lost in flight: no ack, no apply
+        # ADR 022: the WAN shape's receive-side loss draw — same
+        # in-flight semantics as a partition drop (no ack, no apply),
+        # so the sender's blip audit / parked retry machinery sees it
+        # as real path loss rather than a link flap. Delay/jitter/rate
+        # were already applied on the SENDER's writer; applying only
+        # loss here keeps a one-process harness (one fault registry
+        # serving both link ends) from shaping the same hop twice.
+        shp = faults.REGISTRY.get_shape(
+            faults.partition_key(sender, mgr.node_id))
+        if shp is not None and shp.lose():
+            mgr.shape_drops_in += 1
+            faults.REGISTRY.count_fired(
+                f"{faults.CLUSTER_SHAPE}#{sender}->{mgr.node_id}")
+            return      # shaped loss: no ack, no apply
         if not self._check_publish_qos(client, packet):
             return  # repeated QoS2 id: already re-acked
         self.info.messages_received += 1

@@ -36,12 +36,20 @@ ledger:
   and re-send on link-up — the receiver's per-(origin, epoch) msgid
   dedup makes the retry at-most-once-delivered, so a PUBACKed publish
   survives the partition instead of vanishing with the link.
+* **WAN shaping (ADR 022)** — the directed ``cluster.shape`` spec
+  (delay/jitter/token-bucket rate/loss) rides the same boundaries:
+  connect and keepalive pay the emulated round trip (with the
+  RTT-adaptive ping deadline keeping a healthy slow link alive), and
+  the writer releases items through a non-blocking reorder-preserving
+  deferral queue, so a shaped link throttles without wedging the
+  event loop or reordering the FIFO stream the blip audit relies on.
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
+import time
 from collections import deque
 
 from .. import faults
@@ -65,6 +73,13 @@ PARKED_MAX = 2048
 # journal bucket for parked forwards (survives the PARKING node's own
 # crash; restored by ClusterManager.start)
 FWD_BUCKET = "cluster_fwd"
+
+# ADR 022: cap on wire items stamped into the writer's deferral queue
+# ("in flight on the shaped link"). Items past the cap stay in the
+# outbound queue — still byte-accounted on the ADR-012 ledger — so a
+# slow shaped link back-pressures instead of un-accounting unboundedly,
+# exactly like a full egress ring on a real NIC
+DEFER_MAX = 512
 
 
 class BridgeLink:
@@ -124,6 +139,14 @@ class BridgeLink:
         self.parked_dropped = 0     # oldest shed past PARKED_MAX
         self.parked_resent = 0
         self.partition_drops = 0    # writer items the fault blackholed
+        # ADR 022 WAN shaping: the writer-side deferral queue —
+        # [(depart_ns, wire item), ...] FIFO, release times monotonic
+        # by construction (ShapeSpec clamps) — plus its counters and
+        # the persistent outbound getter (never cancelled mid-get: a
+        # cancelled get can lose an already-popped, de-accounted item)
+        self._deferred: deque[tuple[int, bytes]] = deque()
+        self._pending_get: asyncio.Future | None = None
+        self.shape_deferrals = 0    # writer items the shape delayed
         # ADR 020 sub-keepalive blip detection: per-connection monotonic
         # heartbeat seq + cumulative data-item enqueue count (both reset
         # at connect — the peer's fresh server-side client resets its
@@ -200,8 +223,54 @@ class BridgeLink:
             raise ConnectionError(
                 f"partitioned: {self.node_id}->{self.peer}")
 
+    def _shape(self):
+        """This link's outbound-direction WAN shape, or None (ADR 022;
+        the common case is one dict get on an empty dict)."""
+        return faults.REGISTRY.get_shape(
+            faults.partition_key(self.node_id, self.peer))
+
+    def _shape_rtt_s(self) -> float:
+        """The emulated ping round trip on a shaped link: this
+        direction's one-way propagation plus the reverse direction's
+        when armed (asymmetric shapes yield asymmetric RTT halves)."""
+        out = self._shape()
+        if out is None:
+            return 0.0
+        back = faults.REGISTRY.get_shape(
+            faults.partition_key(self.peer, self.node_id))
+        return out.oneway_s + (back.oneway_s if back is not None else 0.0)
+
+    async def _fire_shape_liveness(self) -> None:
+        """ADR 022 liveness half of the shape site: a connect/ping
+        probe crossing a shaped link pays the round trip in real time,
+        and each loss draw (either direction) costs one RETRANSMIT
+        round trip on top — TCP loss recovery never kills a healthy
+        connection outright, it makes the probe slower, so sustained
+        loss shows up as a blown deadline budget (the caller's ping
+        timeout), not as an instant flap. Bounded at 8 retransmits so
+        a pathological loss setting cannot wedge the keepalive loop
+        past its own deadline check."""
+        out = self._shape()
+        if out is None:
+            return
+        rtt_s = self._shape_rtt_s()
+        if rtt_s > 0:
+            await asyncio.sleep(rtt_s)
+        back = faults.REGISTRY.get_shape(
+            faults.partition_key(self.peer, self.node_id))
+        retransmits = 0
+        while retransmits < 8 and (
+                out.lose() or (back is not None and back.lose())):
+            faults.REGISTRY.count_fired(
+                f"{faults.CLUSTER_SHAPE}#"
+                f"{faults.partition_key(self.node_id, self.peer)}")
+            retransmits += 1
+            if rtt_s > 0:
+                await asyncio.sleep(rtt_s)
+
     async def _connect_once(self) -> None:
         await self._fire_partition(liveness=True)
+        await self._fire_shape_liveness()
         client = MQTTClient(
             client_id=BRIDGE_ID_PREFIX + self.node_id,
             keepalive=max(int(self.keepalive * 3), 1))
@@ -225,6 +294,13 @@ class BridgeLink:
         was_up = self.connected
         self.connected = False
         self.outbound.release_all()     # settle the ADR-012 ledger
+        # deferred items were "in flight" on the shaped link: they die
+        # with the connection like bytes in a dead TCP window (QoS1
+        # forwards re-park through their failed ack futures below)
+        self._deferred.clear()
+        if self._closed and self._pending_get is not None:
+            self._pending_get.cancel()
+            self._pending_get = None
         client, self.client = self.client, None
         if client is not None:
             await client.close()
@@ -275,7 +351,7 @@ class BridgeLink:
 
     async def _writer_loop(self, client: MQTTClient) -> None:
         while True:
-            item = await self.outbound.get()
+            item = await self._next_item()
             burst = 0
             while True:
                 await self._fire_link_fault()
@@ -286,12 +362,94 @@ class BridgeLink:
                     burst += len(item)
                 if burst >= BURST_BYTES:
                     break
-                try:
-                    item = self.outbound.get_nowait()
-                except asyncio.QueueEmpty:
+                item = self._next_item_nowait()
+                if item is None:
                     break
             await client.writer.drain()
             self.manager.membership.note_alive(self.peer)
+
+    # -- WAN-shape deferral queue (ADR 022) ----------------------------
+
+    def _stamp(self, item: bytes) -> None:
+        """Stamp one item's departure time into the deferral queue (or
+        behind the current tail when the shape was disarmed mid-drain —
+        FIFO order survives an unshape)."""
+        reg = faults.REGISTRY
+        shp = self._shape()
+        if shp is None:
+            t = self._deferred[-1][0] if self._deferred else 0
+        else:
+            now = reg.clock_ns()
+            t = shp.depart_ns(now, len(item))
+            if t > now:
+                self.shape_deferrals += 1
+            if self._deferred and t < self._deferred[-1][0]:
+                t = self._deferred[-1][0]
+        self._deferred.append((t, item))
+
+    def _stamp_available(self) -> None:
+        """While the head of the deferral queue ripens, pull every
+        immediately-available outbound item and stamp it NOW — the
+        configured delay is pipeline latency (all items in a burst are
+        in flight concurrently), not a per-item serial sleep. Bounded
+        by DEFER_MAX so a slow link back-pressures on the ledger."""
+        while len(self._deferred) < DEFER_MAX:
+            try:
+                item = self.outbound.get_nowait()
+            except asyncio.QueueEmpty:
+                return
+            self._stamp(item)
+
+    async def _next_item(self) -> bytes:
+        """The writer's item source: the unshaped fast path is the old
+        bare ``outbound.get()``; with a shape armed, items flow through
+        the deferral queue and are released at their stamped departure
+        times, in order, without ever blocking the event loop. The
+        pending getter is NEVER cancelled between iterations (a
+        cancelled get can drop an already-popped item — the same
+        pre-3.12 hazard ``_pump`` documents); it persists across
+        reconnects on the instance and is only cancelled at close."""
+        reg = faults.REGISTRY
+        while True:
+            timeout = None
+            if self._deferred:
+                self._stamp_available()
+                now = reg.clock_ns()
+                head = self._deferred[0][0]
+                if head <= now:
+                    return self._deferred.popleft()[1]
+                timeout = (head - now) / 1e9
+            if self._pending_get is None:
+                self._pending_get = asyncio.ensure_future(
+                    self.outbound.get())
+            done, _pending = await asyncio.wait({self._pending_get},
+                                                timeout=timeout)
+            if self._pending_get not in done:
+                continue            # head came due; release it FIFO
+            fut, self._pending_get = self._pending_get, None
+            item = fut.result()
+            if not self._deferred and self._shape() is None:
+                return item         # unshaped fast path
+            self._stamp(item)
+
+    def _next_item_nowait(self) -> bytes | None:
+        """Burst refill: the next item that may hit the wire right now,
+        or None (queue empty, or the shaped head is still in flight)."""
+        if self._deferred:
+            self._stamp_available()
+            if self._deferred[0][0] <= faults.REGISTRY.clock_ns():
+                return self._deferred.popleft()[1]
+            return None
+        try:
+            item = self.outbound.get_nowait()
+        except asyncio.QueueEmpty:
+            return None
+        if self._shape() is None:
+            return item
+        self._stamp(item)
+        if self._deferred[0][0] <= faults.REGISTRY.clock_ns():
+            return self._deferred.popleft()[1]
+        return None
 
     async def _partition_drops_item(self) -> bool:
         """ADR 018: one writer item crossing the partitioned direction
@@ -314,7 +472,28 @@ class BridgeLink:
             self._send_hb()
             await self._fire_link_fault()
             await self._fire_partition(liveness=True)
-            await client.ping(timeout=self.connect_timeout)
+            # ADR 022: the ping budget is the RTT-adaptive deadline
+            # (floor + k x measured RTT); the emulated WAN round trip
+            # spends part of it, and a shaped RTT the unstretched floor
+            # cannot cover is exactly the false flap the adaptation
+            # exists to prevent
+            deadline = self.manager.link_deadline(self.peer,
+                                                  self.connect_timeout)
+            rtt_s = self._shape_rtt_s()
+            if rtt_s >= deadline:
+                raise ConnectionError(
+                    f"keepalive past deadline: {self.node_id}->"
+                    f"{self.peer} rtt {rtt_s:.3f}s >= {deadline:.3f}s")
+            t0 = time.monotonic()
+            await self._fire_shape_liveness()
+            spent = time.monotonic() - t0
+            if spent >= deadline:
+                # emulated retransmits ate the whole budget: the link
+                # is lossy past what the deadline tolerates
+                raise ConnectionError(
+                    f"keepalive past deadline: {self.node_id}->"
+                    f"{self.peer} probe {spent:.3f}s >= {deadline:.3f}s")
+            await client.ping(timeout=deadline - spent)
             self.manager.membership.note_alive(self.peer)
             # ADR 017: the proved-alive link refreshes its clock-skew
             # estimate at the keepalive cadence

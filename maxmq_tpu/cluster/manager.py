@@ -42,7 +42,8 @@ import time
 from collections import deque
 
 from .. import faults
-from ..matching.topics import parse_share, valid_topic_name
+from ..matching.topics import (filter_matches_topic, parse_share,
+                               valid_topic_name)
 from ..protocol.codec import FixedHeader, PacketType as PT
 from ..protocol.packets import Packet
 from .bridge import BRIDGE_ID_PREFIX, BridgeLink
@@ -51,6 +52,8 @@ from .routes import (RouteTable, RouteWireError, decode_delta,
                      decode_snapshot, encode_delta, encode_snapshot)
 
 DEDUP_WINDOW = 8192     # per-origin forwarded-message-id memory
+REHOME_INTENT_TTL_S = 60.0   # how long a deferred takeover-rehome waits
+                             # for the winner to advertise the route
 
 
 class DedupWindow:
@@ -96,7 +99,8 @@ class ClusterManager:
                  trace_propagation: bool = True,
                  trace_return: bool = True,
                  telemetry_interval_s: float = 5.0,
-                 telemetry_full_every: int = 10) -> None:
+                 telemetry_full_every: int = 10,
+                 rtt_deadline_k: float = 4.0) -> None:
         if not valid_node_id(node_id):
             raise ValueError(f"bad cluster node id {node_id!r}")
         if any(p.node_id == node_id for p in peers):
@@ -119,6 +123,12 @@ class ClusterManager:
         # waits on the peers' forward PUBACKs
         self.fwd_durability = fwd_durability
         self.fwd_timeout = max(session_sync_timeout_ms, 1) / 1000.0
+        # ADR 022: per-link deadline stretch — every liveness/barrier
+        # timeout becomes floor + k x measured RTT (the PeerState EWMA
+        # the keepalive-driven clock probes maintain), so a healthy
+        # 150ms link never flaps as dead while a truly dead link is
+        # still detected at the floor
+        self.rtt_deadline_k = max(float(rtt_deadline_k), 0.0)
         self.routes = RouteTable(
             node_id, epoch if epoch is not None
             else int(time.time() * 1000))
@@ -181,6 +191,18 @@ class ClusterManager:
                                         # that failed to parse at boot
         self.partition_drops_in = 0     # inbound $cluster messages the
                                         # partition site dropped in flight
+        # WAN shaping + RTT-adaptive liveness (ADR 022)
+        self.shape_drops_in = 0         # inbound $cluster messages the
+                                        # shape's loss draw ate in flight
+        self.rtt_adaptive_extended = 0  # deadline computations stretched
+                                        # past their floor by k x RTT
+        self.fwd_parked_rehomed = 0     # parked forwards re-routed off a
+                                        # dead owner's link after an
+                                        # epoch-fenced takeover moved the
+                                        # subscription (closes the ADR-021
+                                        # dead-owner blackhole)
+        self._rehome_pending = False
+        self._pending_rehomes: list = []  # [dead, winner, filters, deadline]
         # chained multi-hop durability (ADR 020): relay-side upstream
         # PUBACKs held for the downstream forward chain
         self.relay_chain_waits = 0      # relayed fwds whose upstream ack
@@ -417,6 +439,40 @@ class ClusterManager:
         ADR-017 clock-skew estimate at the keepalive cadence."""
         self.telemetry.on_link_alive(link)
 
+    # ------------------------------------------------------------------
+    # RTT-adaptive deadlines (ADR 022)
+    # ------------------------------------------------------------------
+
+    def peer_rtt_s(self, peer: str) -> float:
+        """The peer's measured round trip (ADR-017 clock-probe EWMA),
+        seconds; 0 until the first probe lands. A DEAD peer keeps its
+        last estimate — its deadlines stay stretched by the RTT it had,
+        which is exactly the bound a judge should honor."""
+        st = self.membership.get(peer)
+        if st is None or not st.skew_samples:
+            return 0.0
+        return st.rtt_ns / 1e9
+
+    def max_rtt_s(self) -> float:
+        """The slowest measured peer RTT — the stretch for barriers
+        that wait on ALL peers at once (fwd/sync/route-sync gates)."""
+        return max((st.rtt_ns for st in self.membership.peers.values()
+                    if st.skew_samples), default=0.0) / 1e9
+
+    def link_deadline(self, peer: str | None, floor_s: float) -> float:
+        """ADR 022: one liveness/barrier deadline, stretched per link —
+        ``floor + k x RTT`` (``peer=None`` takes the slowest peer, for
+        whole-mesh barriers). At loopback RTT the k-term is ~0 and
+        every deadline is exactly its pre-022 floor; on a 150ms link
+        the keepalive ping, blip debounce, willfire grace and barrier
+        waits all stretch together, so "slow" stops reading as
+        "dead"."""
+        rtt = self.max_rtt_s() if peer is None else self.peer_rtt_s(peer)
+        ext = self.rtt_deadline_k * rtt
+        if ext > 0:
+            self.rtt_adaptive_extended += 1
+        return floor_s + ext
+
     def _send_hello(self, link: BridgeLink) -> None:
         """Announce wire capabilities (ADR 017 version negotiation).
         An old peer counts the unknown kind as inbound_rejected and
@@ -593,7 +649,10 @@ class ClusterManager:
 
         for f in pending:
             f.add_done_callback(_one)
-        loop.call_later(self.fwd_timeout, _timeout)
+        # ADR 022: a barrier waits on PUBACKs from every forwarded
+        # peer, so its timeout stretches with the slowest measured RTT
+        loop.call_later(self.link_deadline(None, self.fwd_timeout),
+                        _timeout)
         return fut
 
     def _settle_relay(self, packet: Packet) -> None:
@@ -765,7 +824,12 @@ class ClusterManager:
         if link is None:
             return
         now = time.monotonic()
-        if now - link.last_blip_resync < link.keepalive:
+        # ADR 022: the debounce window stretches with the measured link
+        # RTT — on a 150ms WAN link a resync's own round trips overlap
+        # the next keepalive window, and re-triggering mid-resync reads
+        # healthy slowness as repeated loss
+        if now - link.last_blip_resync < self.link_deadline(
+                sender, link.keepalive):
             return      # debounce: one resync per keepalive window
         link.last_blip_resync = now
         self.blip_resyncs += 1
@@ -898,8 +962,11 @@ class ClusterManager:
         gate once, permanently, counted — never a wedge."""
         self.route_sync_waits += 1
         try:
+            # ADR 022: convergence needs a round trip per peer — the
+            # gate stretches with the slowest measured link RTT
             await asyncio.wait_for(self._routes_ready.wait(),
-                                   self.fwd_timeout * 2)
+                                   self.link_deadline(
+                                       None, self.fwd_timeout * 2))
         except asyncio.TimeoutError:
             self.route_sync_timeouts += 1
             self._routes_ready.set()
@@ -922,8 +989,11 @@ class ClusterManager:
         the retry-after-heal promise."""
         self.relay_chain_waits += 1
         try:
+            # ADR 022: the onward hop's PUBACK rides the slowest shaped
+            # link — stretch by the mesh's max measured RTT
             await asyncio.wait_for(asyncio.shield(relay_fut),
-                                   self.fwd_timeout * 2)
+                                   self.link_deadline(
+                                       None, self.fwd_timeout * 2))
         except asyncio.TimeoutError:
             self.relay_chain_timeouts += 1
             self.fwd_barrier_degraded += 1
@@ -1026,6 +1096,7 @@ class ClusterManager:
                 st.epoch = epoch
             self._retain_observable(node, payload)
             self._schedule_refresh()    # transitive re-advertisement
+            self._schedule_rehome()     # moved subs may strand parks
 
     def _apply_delta(self, node: str, payload: bytes) -> None:
         wnode, epoch, seq, add, rem = decode_delta(payload)
@@ -1037,6 +1108,7 @@ class ClusterManager:
             self._note_route_sync(node)
             self.membership.note_alive(node)
             self._schedule_refresh()
+            self._schedule_rehome()
         else:
             self._desync(node)
 
@@ -1067,8 +1139,211 @@ class ClusterManager:
             origin=f"$cluster/{node}", created=time.time()))
 
     # ------------------------------------------------------------------
+    # Parked-forward rehoming (ADR 022, closes the ADR-021 blackhole)
+    # ------------------------------------------------------------------
+    #
+    # ADR 018 parks a stranded QoS1 forward against the link it was
+    # ROUTED to — and ADR 021 documented the hole: if that owner dies
+    # for good and an epoch-fenced takeover moves the subscription to
+    # a surviving node, the parked copies sit pinned to a link that
+    # will never come up, so "PUBACKed => delivered after heal" broke
+    # across owner death. The takeover is visible to us as a ROUTE
+    # CHANGE (the winner re-advertises the subscription), so every
+    # applied snapshot/delta schedules one debounced rehome pass:
+    # parked forwards on a DOWN link whose inner topic now routes
+    # elsewhere are re-forwarded (or re-parked) against a live routed
+    # link. The receiver's per-(origin, epoch) msgid dedup keeps the
+    # move at-most-once even if the old owner later heals and the
+    # journal had both copies.
+
+    def _schedule_rehome(self) -> None:
+        if self._rehome_pending or not self.fwd_park_active:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return      # unit tests applying routes outside a loop
+        self._rehome_pending = True
+        loop.call_soon(self._rehome_parked)
+
+    def _rehome_parked(self) -> None:
+        self._rehome_pending = False
+        now = time.monotonic()
+        still = []
+        for intent in self._pending_rehomes:
+            dead, winner, filters, deadline = intent
+            if now < deadline and not self._try_rehome(dead, winner,
+                                                       filters):
+                still.append(intent)
+        self._pending_rehomes = still
+        for link in list(self.links.values()):
+            if not link.connected and link.parked:
+                self._rehome_from(link)
+
+    def _rehome_from(self, link) -> None:
+        """Move the dead link's strays: every parked forward whose
+        inner topic no longer routes to that peer goes to the first
+        connected link that IS routed (never the envelope origin — it
+        already holds the message). Still-routed or unroutable copies
+        stay parked; the old owner may yet heal."""
+        kept: deque = deque()
+        for topic, payload, key in link.parked:
+            parsed = self._fwd_inner_topic(topic)
+            target = None
+            if parsed is not None:
+                origin, inner = parsed
+                targets = self.routes.nodes_for(inner)
+                if link.peer not in targets:
+                    target = self._rehome_target(targets,
+                                                 {origin, link.peer})
+            if target is None:
+                kept.append((topic, payload, key))
+                continue
+            link._parked_keys.discard(key)
+            link._journal_delete(key)
+            # the target computes its own peer-prefixed journal key
+            target.forward(topic, payload, qos=1, park=True)
+            self.fwd_parked_rehomed += 1
+        link.parked = kept
+
+    def rehome_for_takeover(self, dead: str, winner: str,
+                            filters: list[str]) -> None:
+        """The precise rehome: an epoch-fenced takeover moved a session
+        off ``dead`` (whose link is down) to ``winner`` — every parked
+        forward on the dead link whose inner topic matches one of the
+        session's filters is re-sent against the winner's link (same
+        envelope, so the receiver's per-(origin, epoch) msgid dedup
+        keeps the move at-most-once), or re-injected into the local
+        fan-out when the winner is THIS node. Non-matching copies stay
+        parked — the dead owner may yet heal and its other subscribers
+        still deserve them.
+
+        The move is GATED on the winner advertising a matching route:
+        a claim lands before the winner's install (its state pull is
+        still in flight), and a copy shipped that early would be
+        admitted into the winner's dedup window, fanned out to nobody,
+        and lost forever. Until the route shows up the intent parks in
+        ``_pending_rehomes`` and retries on every applied route change
+        (bounded — an intent the winner never backs expires)."""
+        if not self.fwd_park_active or not filters:
+            return
+        link = self.links.get(dead)
+        if link is None or link.connected or not link.parked:
+            return
+        if not self._try_rehome(dead, winner, list(filters)):
+            self._pending_rehomes.append(
+                [dead, winner, list(filters),
+                 time.monotonic() + REHOME_INTENT_TTL_S])
+
+    def _try_rehome(self, dead: str, winner: str,
+                    filters: list[str]) -> bool:
+        """One rehome attempt; True = nothing left to wait for (done,
+        or the parked set no longer holds a matching copy)."""
+        link = self.links.get(dead)
+        if link is None or link.connected or not link.parked:
+            return True
+        local = winner == self.node_id
+        target = None
+        if not local:
+            target = self.links.get(winner)
+            if target is None or not target.connected:
+                return False
+        flevels = [f.split("/") for f in filters]
+        kept: deque = deque()
+        waiting = False
+        moved = 0
+        for topic, payload, key in link.parked:
+            parsed = self._fwd_inner_topic(topic)
+            if parsed is None or not any(
+                    filter_matches_topic(fl, parsed[1].split("/"),
+                                         False) for fl in flevels):
+                kept.append((topic, payload, key))
+                continue
+            if not local and winner not in self.routes.nodes_for(
+                    parsed[1]):
+                # the winner has not advertised the subscription yet
+                kept.append((topic, payload, key))
+                waiting = True
+                continue
+            link._parked_keys.discard(key)
+            link._journal_delete(key)
+            if target is not None:
+                target.forward(topic, payload, qos=1, park=True)
+            else:
+                # winner is us: local fan-out reaches the freshly
+                # installed subscription (QoS1 at-least-once — a local
+                # subscriber that already saw the original publish may
+                # see one duplicate; the alternative is PUBACKed loss)
+                self._reinject_fwd(topic, payload)
+            self.fwd_parked_rehomed += 1
+            moved += 1
+        link.parked = kept
+        if moved and self.log is not None:
+            self.log.info("parked forwards rehomed", dead=dead,
+                          winner=winner, moved=moved,
+                          parked_left=len(kept))
+        return not waiting
+
+    def _reinject_fwd(self, envelope: str, payload: bytes) -> None:
+        """Replay one parked forward into OUR local fan-out, keeping
+        its cluster identity (origin/epoch/msgid) so any onward
+        forwarding stays dedup-protected at the receivers."""
+        levels = envelope.split("/")
+        try:
+            origin, epoch, msgid = levels[2], int(levels[3]), \
+                int(levels[4])
+            hops, flags = int(levels[5]), levels[6]
+            qos = min(int(flags[0]), max(self.link_qos, 1))
+        except (ValueError, IndexError):
+            return
+        ti = 8 if "t" in flags else 7
+        topic = "/".join(levels[ti:])
+        out = Packet(fixed=FixedHeader(type=PT.PUBLISH, qos=qos),
+                     topic=topic, payload=payload,
+                     origin=f"$cluster/{origin}", created=time.time())
+        out._cluster_origin = origin
+        out._cluster_epoch = epoch
+        out._cluster_via = self.node_id
+        out._cluster_hops = hops
+        out._cluster_msgid = msgid
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return
+        loop.create_task(self.broker.publish_to_subscribers(out))
+
+    def _rehome_target(self, targets, exclude: set):
+        for node in sorted(targets):
+            if node in exclude:
+                continue
+            lk = self.links.get(node)
+            if lk is not None and lk.connected:
+                return lk
+        return None
+
+    @staticmethod
+    def _fwd_inner_topic(envelope: str) -> tuple[str, str] | None:
+        """``$cluster/fwd/<origin>/<epoch>/<msgid>/<hops>/<flags>/
+        [trace/]<topic>`` -> (origin, topic); None for anything that
+        isn't a well-formed forward envelope."""
+        levels = envelope.split("/")
+        if len(levels) < 8 or levels[0] != "$cluster" \
+                or levels[1] != "fwd":
+            return None
+        ti = 8 if "t" in levels[6] else 7
+        if len(levels) <= ti:
+            return None
+        return levels[2], "/".join(levels[ti:])
+
+    # ------------------------------------------------------------------
     # Aggregates for metrics / $SYS
     # ------------------------------------------------------------------
+
+    @property
+    def shape_deferrals(self) -> int:
+        """ADR 022: outbound items the WAN shape held in a deferral
+        queue before the writer released them."""
+        return sum(lk.shape_deferrals for lk in self.links.values())
 
     @property
     def forwards_sent(self) -> int:

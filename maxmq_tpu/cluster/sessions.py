@@ -477,8 +477,13 @@ class SessionFederation(Hook):
             self._send_claim(cid, new_epoch, pull=True, trace=trace)
             if any(lk.connected for lk in self.manager.links.values()):
                 try:
+                    # ADR 022: the pull round-trips the prior owner's
+                    # link — stretch by the mesh's max measured RTT so
+                    # a WAN roam doesn't absorb stale replica state
                     state = await asyncio.wait_for(
-                        asyncio.shield(fut), self.takeover_timeout)
+                        asyncio.shield(fut),
+                        self.manager.link_deadline(
+                            None, self.takeover_timeout))
                     self._absorb_state_into(entry, state)
                 except (asyncio.TimeoutError, TimeoutError):
                     # dead/partitioned prior owner: the replicated
@@ -492,6 +497,14 @@ class SessionFederation(Hook):
             if not fut.done():
                 fut.cancel()
         self._install(client, entry)
+        # ADR 022: WE are the winner — parked forwards pinned to the
+        # dead prior owner's link for this session's topics re-enter
+        # the local fan-out the install just wired up
+        link = self.manager.links.get(owner)
+        if link is not None and not link.connected and entry.subs:
+            self.manager.rehome_for_takeover(
+                owner, self.node_id,
+                [str(rec[0]) for rec in entry.subs if rec])
         return bool(entry.subs) or bool(entry.inflight)
 
     def _absorb_state_into(self, entry: SessionEntry, d: dict) -> None:
@@ -1005,7 +1018,11 @@ class SessionFederation(Hook):
         fut = loop.create_future()
         self._sync_barriers.append([targets, required, fut])
         self.sync_barrier_waits += 1
-        loop.call_later(self.sync_timeout, self._barrier_timeout, fut)
+        # ADR 022: replication acks ride the slowest shaped link —
+        # the barrier timeout stretches with the mesh's max RTT
+        loop.call_later(
+            self.manager.link_deadline(None, self.sync_timeout),
+            self._barrier_timeout, fut)
         return fut
 
     def _barrier_required(self) -> set[str]:
@@ -1181,7 +1198,14 @@ class SessionFederation(Hook):
         last = st.last_seen if st is not None and st.last_seen \
             else self._started_mono
         down_for = now - last
-        stagger = self.will_grace * (1 + rank)
+        # ADR 022: the grace stretches with the dead owner's measured
+        # link RTT — on a 150ms WAN link the death observation itself
+        # lags by round trips, and a loopback-tuned grace would fire
+        # wills for owners that are merely far away. A truly dead
+        # peer's last RTT estimate is finite, so detection stays
+        # bounded (floor + k x RTT), just WAN-honest.
+        grace = self.manager.link_deadline(entry.owner, self.will_grace)
+        stagger = grace * (1 + rank)
         if entry.will is not None:
             try:
                 delay = float(entry.will[4]) \
@@ -1211,7 +1235,7 @@ class SessionFederation(Hook):
                 # ranks fire together before the stand-down lands
                 if (down_for >= stagger
                         and now - entry.disconnected_seen
-                        >= delay + self.will_grace * rank):
+                        >= delay + grace * rank):
                     self._fire_replica_will(entry)
             else:
                 # no observed disconnect instant (entry applied cold:
@@ -1229,7 +1253,7 @@ class SessionFederation(Hook):
                         wd = None
                 if wd is not None:
                     if (down_for >= stagger and self._wall()
-                            >= wd + self.will_grace * rank):
+                            >= wd + grace * rank):
                         self._fire_replica_will(entry)
                 elif down_for >= stagger + delay:
                     self._fire_replica_will(entry)
@@ -1460,6 +1484,15 @@ class SessionFederation(Hook):
         if cur is not None and token <= cur.token:
             self.claims_rejected += 1
             return
+        # ADR 022 (closes the ADR-021 dead-owner blackhole): this claim
+        # moved the session off a DEAD prior owner — any QoS1 forwards
+        # we parked against that owner's link for the session's topics
+        # now have a live home at the claimant
+        if (cur is not None and not purge and cur.subs
+                and cur.owner not in (origin, self.node_id)):
+            self.manager.rehome_for_takeover(
+                cur.owner, origin, [str(rec[0]) for rec in cur.subs
+                                    if rec])
         entry = self._reowned_entry(cid, cur, token, purge)
         if purge:
             hook = getattr(self.broker, "_storage_hook", None)

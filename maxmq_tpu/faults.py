@@ -45,6 +45,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+import zlib
 
 
 class DeviceMatchError(RuntimeError):
@@ -69,6 +70,10 @@ CLUSTER_LINK = "cluster.link"          # bridge link connect/pump (ADR 013)
 CLUSTER_PARTITION = "cluster.partition"  # directed inter-node network
                                        # partition (ADR 018; keyed per
                                        # link direction "src->dst")
+CLUSTER_SHAPE = "cluster.shape"        # directed inter-node WAN link
+                                       # shape (ADR 022; keyed per link
+                                       # direction "src->dst": delay/
+                                       # jitter/rate/loss, not binary)
 CLUSTER_ROUTE_APPLY = "cluster.route_apply"  # route snapshot/delta apply
 CLUSTER_SESSION_SYNC = "cluster.session_sync"  # session replication send/
                                        # apply (ADR 016; keyed per peer)
@@ -91,6 +96,114 @@ class _Spec:
         self.delay_s = delay_s
 
 
+class ShapeSpec:
+    """One directed link's WAN shape (ADR 022): fixed one-way delay,
+    uniform jitter, a token-bucket rate limit, and probabilistic loss.
+
+    Everything here is pure integer-ns arithmetic over clocks the CALL
+    SITE reads (through ``REGISTRY.clock_ns``), and the only randomness
+    is a private xorshift64* stream seeded from the link key — so a
+    scripted-clock test replays the exact same jitter/loss sequence
+    every run. The spec never sleeps; :meth:`depart_ns` answers "when
+    may this item hit the far end", and the bridge's deferral queue
+    does the (non-blocking) waiting.
+
+    Reorder preservation: a jitter draw that would land an item before
+    its predecessor is clamped to the predecessor's departure — a
+    shaped link is a slow FIFO pipe, never a packet shuffler (the blip
+    audit's FIFO claim, ADR 020, must keep holding on shaped links).
+    """
+
+    __slots__ = ("delay_ns", "jitter_ns", "rate_bps", "loss",
+                 "burst_bytes", "deferrals", "losses", "_rng",
+                 "_last_depart_ns", "_tokens", "_tb_stamp_ns")
+
+    def __init__(self, delay_ms: float = 0.0, jitter_ms: float = 0.0,
+                 rate_bps: int = 0, loss: float = 0.0,
+                 burst_bytes: int = 16384, seed: int = 0) -> None:
+        if delay_ms < 0 or jitter_ms < 0 or rate_bps < 0 \
+                or not 0.0 <= loss <= 1.0:
+            raise ValueError("bad shape (want delay_ms/jitter_ms/"
+                             "rate_bps >= 0, 0 <= loss <= 1)")
+        self.delay_ns = int(delay_ms * 1e6)
+        self.jitter_ns = int(jitter_ms * 1e6)
+        self.rate_bps = int(rate_bps)
+        self.loss = float(loss)
+        self.burst_bytes = max(int(burst_bytes), 1)
+        self.deferrals = 0          # items that actually waited
+        self.losses = 0             # items the loss draw ate
+        self._rng = (seed & 0xFFFFFFFFFFFFFFFF) or 0x9E3779B97F4A7C15
+        self._last_depart_ns = 0    # FIFO fence (reorder preservation)
+        self._tokens: float | None = None   # bucket starts full
+        self._tb_stamp_ns = 0
+
+    # -- deterministic randomness --------------------------------------
+
+    def rand(self) -> float:
+        """Next [0, 1) draw from the spec's private xorshift64* stream
+        (no ``random`` module state: two shaped links never perturb
+        each other's sequences, and a fixed seed replays exactly)."""
+        x = self._rng
+        x ^= (x << 13) & 0xFFFFFFFFFFFFFFFF
+        x ^= x >> 7
+        x ^= (x << 17) & 0xFFFFFFFFFFFFFFFF
+        self._rng = x
+        return ((x * 0x2545F4914F6CDD1D) & 0xFFFFFFFFFFFFFFFF) \
+            / float(1 << 64)
+
+    def lose(self) -> bool:
+        """One loss draw; counted."""
+        if self.loss <= 0.0:
+            return False
+        if self.rand() >= self.loss:
+            return False
+        self.losses += 1
+        return True
+
+    # -- timing math (all ns, caller supplies now) ---------------------
+
+    def _rate_wait_ns(self, now_ns: int, nbytes: int) -> int:
+        """Token bucket: ``burst_bytes`` of credit refilled at
+        ``rate_bps``; a send overdraws the bucket and the debt converts
+        to wait time — burst passes at line rate, sustained traffic
+        paces to the configured bandwidth."""
+        if not self.rate_bps:
+            return 0
+        per_ns = self.rate_bps / 8 / 1e9        # bytes per ns
+        if self._tokens is None:
+            self._tokens = float(self.burst_bytes)
+        else:
+            self._tokens = min(
+                float(self.burst_bytes),
+                self._tokens + (now_ns - self._tb_stamp_ns) * per_ns)
+        self._tb_stamp_ns = now_ns
+        self._tokens -= nbytes
+        if self._tokens >= 0:
+            return 0
+        return int(-self._tokens / per_ns)
+
+    def depart_ns(self, now_ns: int, nbytes: int) -> int:
+        """The instant this item may be released to the wire: now +
+        delay + jitter draw + token-bucket wait, clamped to never
+        precede the previous item's departure (FIFO)."""
+        t = now_ns + self.delay_ns
+        if self.jitter_ns:
+            t += int(self.rand() * self.jitter_ns)
+        t += self._rate_wait_ns(now_ns, nbytes)
+        if t < self._last_depart_ns:
+            t = self._last_depart_ns
+        self._last_depart_ns = t
+        if t > now_ns:
+            self.deferrals += 1
+        return t
+
+    @property
+    def oneway_s(self) -> float:
+        """Expected one-way propagation (delay + mean jitter), seconds
+        — the liveness sites' sleep when emulating a ping round trip."""
+        return (self.delay_ns + self.jitter_ns / 2) / 1e9
+
+
 class FaultRegistry:
     """Thread-safe armed-fault table. One global instance (``REGISTRY``)
     serves the whole process; tests that want isolation construct their
@@ -100,6 +213,10 @@ class FaultRegistry:
         self._lock = threading.Lock()
         # site -> FIFO of specs (so "raise twice then hang once" scripts)
         self._specs: dict[str, list[_Spec]] = {}
+        # directed link key "src->dst" -> ShapeSpec (ADR 022); separate
+        # from _specs because a shape is continuous state (bucket fill,
+        # FIFO fence, PRNG stream), not a countdown of discrete trips
+        self._shapes: dict[str, ShapeSpec] = {}
         self.fired: dict[str, int] = {}
         # swappable monotonic-ns clock (ADR 015): the pipeline tracer
         # reads every span timestamp through this indirection, so a
@@ -127,10 +244,36 @@ class FaultRegistry:
     def clear(self) -> None:
         with self._lock:
             self._specs.clear()
+            self._shapes.clear()
             self.fired.clear()
 
     def armed(self, site: str) -> bool:
         return site in self._specs
+
+    # -- WAN link shapes (ADR 022) -------------------------------------
+
+    def set_shape(self, key: str, spec: ShapeSpec) -> None:
+        with self._lock:
+            self._shapes[key] = spec
+
+    def get_shape(self, key: str) -> ShapeSpec | None:
+        """Racy-but-safe hot-path lookup (one dict get on an almost
+        always empty dict), mirroring the ``fire`` fast path."""
+        if not self._shapes:
+            return None
+        return self._shapes.get(key)
+
+    def del_shape(self, key: str) -> None:
+        with self._lock:
+            self._shapes.pop(key, None)
+
+    def any_shaped(self) -> bool:
+        return bool(self._shapes)
+
+    def count_fired(self, site_key: str) -> None:
+        """Count one shape action under ``fired`` so harness phase
+        records see shaping activity next to partition trips."""
+        self.fired[site_key] = self.fired.get(site_key, 0) + 1
 
     def any_armed(self) -> bool:
         """True when ANY site is armed — the cheap hot-path guard loop
@@ -264,6 +407,54 @@ def heal(a: str, b: str) -> None:
         REGISTRY.disarm(f"{CLUSTER_PARTITION}#{partition_key(src, dst)}")
 
 
+# ----------------------------------------------------------------------
+# WAN link shaping (ADR 022): the ``cluster.shape`` site family
+# ----------------------------------------------------------------------
+#
+# Like ``cluster.partition`` the site is keyed per DIRECTED link
+# (``cluster.shape#A->B``), but a shape is continuous degradation, not
+# a binary fault: one-way delay, jitter, a token-bucket rate limit,
+# and probabilistic loss. The production code consults it at the same
+# three boundaries the partition plumbing hooks, with the aspects
+# split so the in-process harness (one registry serving both ends of
+# every link) never double-applies a direction:
+#
+# * bridge connect / keepalive (liveness, sender side) — the emulated
+#   ping round trip sleeps both directions' one-way delay and a loss
+#   draw fails the probe, so liveness sees the WAN the data sees;
+# * the bridge writer (data, sender side) — delay + jitter + rate,
+#   via a non-blocking reorder-preserving deferral queue;
+# * the receiving broker's ``$cluster`` inbound (data, receiver side)
+#   — the loss draw: a dropped message is in-flight loss (no ack, no
+#   apply), which is what arms the ADR-020 blip audit + parked-retry
+#   machinery rather than a link flap.
+#
+# ``shape(a, b, ...)`` arms ONE direction (asymmetric bandwidth is the
+# point of per-direction arming); ``unshape(a, b)`` clears both.
+
+
+def shape(a: str, b: str, *, delay_ms: float = 0.0,
+          jitter_ms: float = 0.0, rate_bps: int = 0, loss: float = 0.0,
+          burst_bytes: int = 16384, seed: int | None = None) -> ShapeSpec:
+    """Arm the directed WAN shape ``a -> b`` (ADR 022) and return its
+    spec. The PRNG seed defaults to a CRC of the link key — stable
+    across runs, distinct per direction."""
+    key = partition_key(a, b)
+    if seed is None:
+        seed = zlib.crc32(key.encode())
+    spec = ShapeSpec(delay_ms=delay_ms, jitter_ms=jitter_ms,
+                     rate_bps=rate_bps, loss=loss,
+                     burst_bytes=burst_bytes, seed=seed)
+    REGISTRY.set_shape(key, spec)
+    return spec
+
+
+def unshape(a: str, b: str) -> None:
+    """Disarm the WAN shape between ``a`` and ``b`` (both directions)."""
+    for src, dst in ((a, b), (b, a)):
+        REGISTRY.del_shape(partition_key(src, dst))
+
+
 # module-level conveniences bound to the process registry
 arm = REGISTRY.arm
 disarm = REGISTRY.disarm
@@ -273,6 +464,8 @@ any_armed = REGISTRY.any_armed
 fire = REGISTRY.fire
 fire_detail = REGISTRY.fire_detail
 arm_from_spec = REGISTRY.arm_from_spec
+get_shape = REGISTRY.get_shape
+any_shaped = REGISTRY.any_shaped
 
 # env arming: subprocess pool workers and bench's degraded-mode runs
 # inherit MAXMQ_FAULTS through their environment

@@ -583,7 +583,20 @@ def _register_cluster_metrics(registry: Registry, broker) -> None:
              "convergence (ADR 020 restarted-relay gate)"),
             ("route_sync_timeouts",
              "Route-sync holds released degraded by the bounded "
-             "timeout (a configured peer never advertised)")):
+             "timeout (a configured peer never advertised)"),
+            ("shape_deferrals",
+             "Outbound bridge items held by the ADR-022 WAN shape's "
+             "deferral queue before release"),
+            ("shape_drops_in",
+             "Inbound $cluster messages the cluster.shape loss draw "
+             "ate in flight (ADR 022 WAN chaos harness)"),
+            ("rtt_adaptive_extended",
+             "Liveness/barrier deadlines stretched past their floor "
+             "by the ADR-022 k x measured-RTT term"),
+            ("fwd_parked_rehomed",
+             "Parked forwards re-routed off a dead owner's link after "
+             "a takeover moved the subscription (ADR 022, closes the "
+             "ADR-021 dead-owner blackhole)")):
         registry.counter_func(f"maxmq_cluster_{name}_total", help_,
                               lambda n=name: getattr(mgr, n))
     registry.gauge_func(

@@ -113,6 +113,14 @@ class Config:
     # owns every pick (the pre-018 / ADR-005 trade)
     cluster_share_balance: str = "weighted"
 
+    # -- WAN deployments (ADR 022) -------------------------------------------
+    # per-link liveness/barrier deadlines stretch with the measured
+    # peer RTT: deadline = floor + k x RTT (the floors are the knobs
+    # above — link keepalive, sync/takeover timeouts, willfire grace).
+    # 0 pins every deadline to its loopback floor (pre-022 behavior);
+    # at loopback RTT the k-term is ~0 either way
+    cluster_rtt_deadline_k: float = 4.0
+
     # -- cluster observability plane (ADR 017) --------------------------------
     # carry trace context on forwarded publishes to capability-
     # negotiated peers (one correlated trace across the cluster) and

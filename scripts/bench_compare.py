@@ -171,9 +171,19 @@ def compare(old: dict, new: dict, threshold: float,
     moving >threshold in its bad direction — and, for ``*_ms``
     latencies, by at least ``abs_floor_ms`` in absolute terms (the
     tail-round p99s are max-of-few-samples on sub-ms stages; see the
-    module docstring). Sub-floor bad moves flag ``worse`` only."""
+    module docstring). Sub-floor bad moves flag ``worse`` only.
+
+    ADR 022: a config that declares a WAN round trip (an ``rtt_ms``
+    key in its row — the geoday sheet) gets the floor SCALED by that
+    RTT: at 150ms configured RTT a recovery time can legitimately
+    wobble by a whole round trip between runs, so the absolute floor
+    for its ``*_ms`` fields is ``abs_floor_ms x rtt_ms`` — the
+    relative threshold still applies on top."""
     table, regressions = [], []
     for cfg in sorted(set(old) & set(new)):
+        rtt = new[cfg].get("rtt_ms") or old[cfg].get("rtt_ms") or 0.0
+        floor_ms = max(abs_floor_ms, abs_floor_ms * rtt) \
+            if isinstance(rtt, (int, float)) else abs_floor_ms
         for metric in sorted(set(old[cfg]) & set(new[cfg])):
             a, b = old[cfg][metric], new[cfg][metric]
             d = _direction(metric)
@@ -187,7 +197,7 @@ def compare(old: dict, new: dict, threshold: float,
                   (d < 0 and delta > threshold)
             gates = bad and _gated(metric)
             if gates and metric.lower().endswith("_ms") \
-                    and (b - a) < abs_floor_ms:
+                    and (b - a) < floor_ms:
                 gates = False
             flag = ""
             if bad:

@@ -1,0 +1,380 @@
+"""ADR 022: WAN link shaping + RTT-adaptive liveness + the geoday
+harness smoke.
+
+The ShapeSpec's arithmetic is pure integer-ns over a caller-supplied
+clock with a private seeded PRNG, so the math tests here are exact
+replays — no sleeps, no tolerance bands. The cluster-level tests then
+prove the three shape sites behave on a live mesh: a shaped link is a
+slow FIFO pipe (reorder-free), its blip audit never fires a false
+resync, and the RTT-adaptive deadlines keep a 150ms link alive on the
+same mesh where a genuinely dead node still flaps. The rehome test is
+the ADR-021 dead-owner-blackhole regression: QoS1 forwards parked
+against a killed owner must follow the session's epoch-fenced
+takeover to the surviving winner.
+"""
+
+import asyncio
+import time
+from contextlib import asynccontextmanager
+
+import pytest
+
+from harness import GeoDay
+from maxmq_tpu import faults
+from maxmq_tpu.broker import (Broker, BrokerOptions, Capabilities,
+                              TCPListener)
+from maxmq_tpu.cluster import ClusterManager, PeerSpec
+from maxmq_tpu.faults import ShapeSpec
+from maxmq_tpu.hooks import AllowHook
+from maxmq_tpu.mqtt_client import MQTTClient
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ----------------------------------------------------------------------
+# ShapeSpec math (scripted clock, exact)
+# ----------------------------------------------------------------------
+
+
+def test_shape_delay_is_exact_and_fifo():
+    s = ShapeSpec(delay_ms=30.0)
+    assert s.depart_ns(1_000, 100) == 1_000 + 30_000_000
+    # FIFO fence: a later call can never be scheduled earlier
+    first = s.depart_ns(2_000, 100)
+    assert s.depart_ns(2_000, 100) >= first
+    assert s.deferrals == 3
+
+
+def test_shape_jitter_bounded_seeded_and_reorder_free():
+    a = ShapeSpec(delay_ms=10.0, jitter_ms=5.0, seed=42)
+    b = ShapeSpec(delay_ms=10.0, jitter_ms=5.0, seed=42)
+    last = 0
+    for i in range(200):
+        now = i * 1_000_000
+        da = a.depart_ns(now, 64)
+        # same seed -> bit-identical schedule
+        assert da == b.depart_ns(now, 64)
+        # within [delay, delay+jitter] unless the FIFO fence clamps up
+        assert da >= max(now + 10_000_000, last)
+        assert da <= max(now + 15_000_000, last)
+        last = da
+    # distinct seeds diverge (the per-direction CRC seeding matters)
+    c = ShapeSpec(delay_ms=10.0, jitter_ms=5.0, seed=43)
+    assert any(c.depart_ns(i * 1_000_000, 64)
+               != b.depart_ns(i * 1_000_000, 64) for i in range(20))
+
+
+def test_shape_token_bucket_paces_to_rate():
+    # 1 Mbit/s = 125000 bytes/s; burst 10_000 bytes passes at line rate
+    s = ShapeSpec(rate_bps=1_000_000, burst_bytes=10_000)
+    assert s.depart_ns(0, 10_000) == 0          # burst: no wait
+    # next 125000 bytes owe exactly one second of debt
+    t = s.depart_ns(0, 125_000)
+    assert t == pytest.approx(1e9, rel=1e-6)
+    # after the debt drains (clock advances 1s + refill time), a small
+    # item passes again without waiting beyond the fence
+    t2 = s.depart_ns(int(2.1e9), 100)
+    assert t2 == pytest.approx(2.1e9, rel=1e-3)
+
+
+def test_shape_loss_deterministic_and_counted():
+    a = ShapeSpec(loss=0.3, seed=7)
+    b = ShapeSpec(loss=0.3, seed=7)
+    draws = [a.lose() for _ in range(500)]
+    assert draws == [b.lose() for _ in range(500)]
+    assert a.losses == sum(draws)
+    assert 0 < sum(draws) < 500         # neither all nor nothing
+    none = ShapeSpec(loss=0.0, seed=7)
+    assert not any(none.lose() for _ in range(100))
+    assert none.losses == 0
+
+
+def test_shape_helpers_and_validation():
+    spec = faults.shape("a", "b", delay_ms=5.0, loss=0.1)
+    assert faults.get_shape(faults.partition_key("a", "b")) is spec
+    assert faults.REGISTRY.any_shaped()
+    # per-direction CRC seeds differ -> independent streams
+    back = faults.shape("b", "a", delay_ms=5.0, loss=0.1)
+    assert back._rng != spec._rng
+    faults.unshape("a", "b")
+    assert faults.get_shape("a->b") is None
+    assert faults.get_shape("b->a") is None
+    assert not faults.REGISTRY.any_shaped()
+    with pytest.raises(ValueError):
+        ShapeSpec(loss=1.5)
+    with pytest.raises(ValueError):
+        ShapeSpec(delay_ms=-1.0)
+
+
+# ----------------------------------------------------------------------
+# Live-mesh fixtures (mirrors tests/test_partition.py)
+# ----------------------------------------------------------------------
+
+
+async def make_node() -> Broker:
+    b = Broker(BrokerOptions(capabilities=Capabilities(
+        sys_topic_interval=0)))
+    b.add_hook(AllowHook())
+    listener = b.add_listener(TCPListener("t", "127.0.0.1:0"))
+    await b.serve()
+    b.test_port = listener._server.sockets[0].getsockname()[1]
+    return b
+
+
+@asynccontextmanager
+async def cluster(topology: dict[str, list[str]], **kw):
+    brokers: dict[str, Broker] = {}
+    managers: dict[str, ClusterManager] = {}
+    for name in topology:
+        brokers[name] = await make_node()
+    kw.setdefault("keepalive", 0.25)
+    kw.setdefault("backoff_initial_s", 0.05)
+    kw.setdefault("backoff_max_s", 0.3)
+    kw.setdefault("session_sync", "always")
+    kw.setdefault("session_sync_timeout_ms", 400)
+    kw.setdefault("session_takeover_timeout_ms", 400)
+    for name, peers in topology.items():
+        specs = [PeerSpec(p, "127.0.0.1", brokers[p].test_port)
+                 for p in peers]
+        mgr = ClusterManager(brokers[name], name, specs, **kw)
+        brokers[name].attach_cluster(mgr)
+        managers[name] = mgr
+        await mgr.start()
+    try:
+        yield brokers, managers
+    finally:
+        for b in brokers.values():
+            await b.close()
+
+
+async def wait_for(predicate, timeout: float = 10.0, what: str = ""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        await asyncio.sleep(0.02)
+    raise AssertionError(f"condition not reached in {timeout}s: {what}")
+
+
+async def connect(broker: Broker, client_id: str, **kw) -> MQTTClient:
+    c = MQTTClient(client_id=client_id, **kw)
+    await c.connect("127.0.0.1", broker.test_port)
+    return c
+
+
+PAIR = {"A": ["B"], "B": ["A"]}
+MESH = {"A": ["B", "C"], "B": ["A", "C"], "C": ["A", "B"]}
+
+
+# ----------------------------------------------------------------------
+# Shaped data path on a live pair
+# ----------------------------------------------------------------------
+
+
+async def test_shaped_link_delivers_in_order_with_no_loss():
+    """Delay + jitter + rate on A->B: the deferral queue must hold
+    every QoS1 forward to its departure stamp WITHOUT reordering (a
+    shaped link is a slow pipe, not a shuffler) and without losing a
+    single PUBACKed message."""
+    async with cluster(PAIR) as (brokers, mgrs):
+        await wait_for(lambda: mgrs["A"].links_up == 1
+                       and mgrs["B"].links_up == 1)
+        sub = await connect(brokers["B"], "wan-sub")
+        await sub.subscribe(("wan/seq/#", 1))
+        await wait_for(lambda: bool(
+            mgrs["A"].routes.nodes_for("wan/seq/x")))
+        # jitter 2x the gap between publishes: unshaped, this WOULD
+        # reorder; the FIFO fence must prevent it
+        faults.shape("A", "B", delay_ms=20.0, jitter_ms=40.0,
+                     rate_bps=2_000_000)
+        pub = await connect(brokers["A"], "wan-pub")
+        n = 30
+        for i in range(n):
+            await pub.publish(f"wan/seq/{i % 3}", b"%03d" % i, qos=1)
+        got = []
+        deadline = time.monotonic() + 15.0
+        while len(got) < n and time.monotonic() < deadline:
+            try:
+                msg = await sub.next_message(timeout=1.0)
+            except asyncio.TimeoutError:
+                continue
+            got.append(int(msg.payload))
+        assert got == list(range(n)), f"loss or reorder: {got}"
+        link = mgrs["A"].links["B"]
+        assert link.shape_deferrals > 0, "shape never deferred"
+        spec = faults.get_shape("A->B")
+        assert spec is not None and spec.deferrals > 0
+        await pub.close()
+        await sub.close()
+
+
+async def test_shaped_link_blip_audit_no_false_resyncs():
+    """A lossless shaped link slows every hb item down uniformly; the
+    RTT-aware blip debounce must keep the ADR-020 audit from reading
+    that lag as loss — zero resyncs, zero flaps, zero loss."""
+    async with cluster(PAIR) as (brokers, mgrs):
+        await wait_for(lambda: mgrs["A"].links_up == 1
+                       and mgrs["B"].links_up == 1)
+        sub = await connect(brokers["B"], "audit-sub")
+        await sub.subscribe(("wan/audit/#", 1))
+        await wait_for(lambda: bool(
+            mgrs["A"].routes.nodes_for("wan/audit/x")))
+        faults.shape("A", "B", delay_ms=40.0, jitter_ms=5.0)
+        faults.shape("B", "A", delay_ms=40.0, jitter_ms=5.0)
+        flaps0 = mgrs["A"].link_flaps + mgrs["B"].link_flaps
+        pub = await connect(brokers["A"], "audit-pub")
+        got = set()
+        for i in range(12):
+            payload = b"audit-%d" % i
+            await pub.publish("wan/audit/t", payload, qos=1)
+            await asyncio.sleep(0.08)   # spread across keepalives
+        deadline = time.monotonic() + 10.0
+        while len(got) < 12 and time.monotonic() < deadline:
+            try:
+                got.add(bytes((await sub.next_message(
+                    timeout=1.0)).payload))
+            except asyncio.TimeoutError:
+                pass
+        assert len(got) == 12
+        assert mgrs["A"].blip_resyncs == 0
+        assert mgrs["B"].blip_resyncs == 0
+        assert mgrs["A"].link_flaps + mgrs["B"].link_flaps == flaps0
+        await pub.close()
+        await sub.close()
+
+
+async def test_rtt_adaptive_deadline_keeps_slow_link_alive():
+    """The crux of ADR 022's liveness half: with the ping budget
+    floored at 100ms, a 150ms-RTT link survives ONLY because the
+    deadline stretches by k x measured RTT — and on the same mesh a
+    genuinely dead node still flaps. Zeroing k makes the slow link
+    flap too, proving the extension (not luck) carried it."""
+    async with cluster(MESH, keepalive=0.3,
+                       rtt_deadline_k=4.0) as (brokers, mgrs):
+        await wait_for(lambda: all(m.links_up == 2
+                                   for m in mgrs.values()))
+        faults.shape("A", "B", delay_ms=75.0)
+        faults.shape("B", "A", delay_ms=75.0)
+        # seed the EWMA as if the ADR-017 probes already measured the
+        # link (deterministic; the probes would converge there anyway)
+        for name, peer in (("A", "B"), ("B", "A")):
+            st = mgrs[name].membership.peers[peer]
+            st.rtt_ns = 0.15e9
+            st.skew_samples = 1
+            mgrs[name].links[peer].connect_timeout = 0.1
+        assert mgrs["A"].link_deadline("B", 0.1) >= 0.7
+        assert mgrs["A"].rtt_adaptive_extended > 0
+        flaps0 = (mgrs["A"].membership.peers["B"].flaps,
+                  mgrs["B"].membership.peers["A"].flaps)
+        # a genuinely dead node must still flap under shaping
+        dead0 = mgrs["A"].membership.peers["C"].flaps
+        await brokers["C"].close()
+        await wait_for(
+            lambda: mgrs["A"].membership.peers["C"].flaps > dead0,
+            timeout=15.0, what="dead node flapped")
+        # ... several keepalive periods later the slow link is intact
+        await asyncio.sleep(1.2)
+        assert mgrs["A"].membership.peers["B"].flaps == flaps0[0]
+        assert mgrs["B"].membership.peers["A"].flaps == flaps0[1]
+        assert mgrs["A"].links["B"].connected
+        # k=0: the floor alone (100ms) cannot absorb a 150ms RTT
+        mgrs["A"].rtt_deadline_k = 0.0
+        await wait_for(
+            lambda: mgrs["A"].membership.peers["B"].flaps > flaps0[0],
+            timeout=15.0, what="k=0 flapped the slow link")
+
+
+async def test_kill_during_park_rehomes_forwards_to_takeover_winner():
+    """ADR-021 blackhole regression: kill the owner while QoS1
+    forwards sit parked against its link, then reconnect the session
+    at a survivor. The epoch-fenced takeover must pull the parked
+    copies over to the winner's link — no heal, no expiry, no loss."""
+    async with cluster(MESH, fwd_durability="chained") \
+            as (brokers, mgrs):
+        await wait_for(lambda: all(m.links_up == 2
+                                   for m in mgrs.values()))
+        sess = await connect(brokers["C"], "park-sess", version=5,
+                             clean_start=False, session_expiry=3600)
+        await sess.subscribe(("wan/park/#", 1))
+        await wait_for(lambda: bool(
+            mgrs["A"].routes.nodes_for("wan/park/x"))
+            and "park-sess" in mgrs["A"].sessions.ledger
+            and "park-sess" in mgrs["B"].sessions.ledger,
+            what="session replicated")
+        await sess.disconnect()
+        # owner C dies; A's forwards for wan/park/# park on the C link
+        await brokers["C"].close()
+        await wait_for(lambda: not mgrs["A"].links["C"].connected
+                       and not mgrs["B"].links["C"].connected)
+        pub = await connect(brokers["A"], "park-pub")
+        sent = set()
+        for i in range(8):
+            payload = b"park-%d" % i
+            await pub.publish(f"wan/park/{i % 2}", payload, qos=1)
+            sent.add(payload)
+        await wait_for(lambda: mgrs["A"].fwd_parked_now > 0,
+                       what="forwards parked against dead owner")
+        # the client re-attaches at survivor B: takeover + rehome
+        sess_b = await connect(brokers["B"], "park-sess", version=5,
+                               clean_start=False, session_expiry=3600)
+        assert sess_b.session_present
+        got = set()
+        deadline = time.monotonic() + 15.0
+        while not sent <= got and time.monotonic() < deadline:
+            try:
+                got.add(bytes((await sess_b.next_message(
+                    timeout=1.0)).payload))
+            except asyncio.TimeoutError:
+                pass
+        assert sent <= got, f"blackholed: {sent - got}"
+        assert mgrs["A"].fwd_parked_rehomed > 0
+        # the moved copies left the dead link's parked set
+        assert not any(b"park-" in p for _t, p, _k
+                       in mgrs["A"].links["C"].parked)
+        await pub.close()
+        await sess_b.close()
+
+test_kill_during_park_rehomes_forwards_to_takeover_winner\
+    ._async_timeout = 60
+
+
+# ----------------------------------------------------------------------
+# GeoDay smoke (compressed RTTs; also runs in the asyncio-debug lane)
+# ----------------------------------------------------------------------
+
+
+async def test_geoday_smoke_slo_sheet_passes():
+    day = GeoDay(rtt_scale=0.1, fanin_msgs=6, share_msgs=6,
+                 outage_msgs=8, roam_msgs=6, keepalive=0.5,
+                 will_grace=0.5, sync_timeout_ms=600, settle_s=12.0)
+    sheet = await day.run()
+    assert sheet["pass"], f"SLO violations: {sheet['violations']}"
+    assert sheet["pubacked_loss"] == 0
+    assert sheet["pubacked_total"] > 0
+    assert sheet["wills_fired"] == 1
+    assert sheet["wills_delivered"] == 1
+    assert sheet["false_link_flaps"] == 0
+    assert sheet["share_duplicates"] == 0
+    assert sheet["outage_session_present"]
+    assert sheet["takeover_session_present"]
+    assert sheet["fwd_parked_rehomed"] > 0
+    assert sheet["shape_deferrals"] > 0
+    assert sheet["rtt_adaptive_extended"] > 0
+    assert 0 <= sheet["heal_convergence_ms"] <= sheet["heal_budget_ms"]
+    assert 0 <= sheet["outage_takeover_recovery_ms"] \
+        <= sheet["takeover_budget_ms"]
+    names = [p["name"] for p in sheet["phases"]]
+    assert names == ["shape_links", "regional_fanin",
+                     "cross_region_share", "region_outage_heal",
+                     "roam_takeover"]
+    # shapes armed, recorded for replay, and cleared afterwards
+    assert sheet["phases"][0]["armed_sites"]
+    assert not faults.REGISTRY.any_shaped()
+    assert not faults.REGISTRY.any_armed()
+
+test_geoday_smoke_slo_sheet_passes._async_timeout = 120

@@ -124,3 +124,44 @@ def test_bench_compare_gates_slo_fields():
     assert [(c, m) for c, m, *_ in regressions] == \
         [("x", "takeover_recovery_ms")]
     assert [r for r in table if r[1] == "trace.p99_ms"][0][-1] == "worse"
+
+
+def test_bench_compare_rtt_scaled_floor():
+    """ADR 022: a row that declares ``rtt_ms`` (the geoday sheet) gets
+    its *_ms noise floor scaled by the configured RTT — at 150ms RTT a
+    recovery time wobbling by under one round trip is run-to-run
+    noise, not a regression; past the scaled floor it still gates."""
+    path = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "scripts", "bench_compare.py")
+    spec = importlib.util.spec_from_file_location("bench_compare_mod2",
+                                                  path)
+    bc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bc)
+
+    # +40% but only +120ms absolute: under the 150ms scaled floor ->
+    # "worse", not gated (an unshaped row with the same move gates)
+    old = {"geoday": {"rtt_ms": 150.0,
+                      "outage_takeover_recovery_ms": 300.0},
+           "macroday": {"takeover_recovery_ms": 300.0}}
+    new = {"geoday": {"rtt_ms": 150.0,
+                      "outage_takeover_recovery_ms": 420.0},
+           "macroday": {"takeover_recovery_ms": 420.0}}
+    table, regressions = bc.compare(old, new, threshold=0.15)
+    assert [(c, m) for c, m, *_ in regressions] == \
+        [("macroday", "takeover_recovery_ms")]
+    geo = [r for r in table
+           if r[0] == "geoday" and r[1] == "outage_takeover_recovery_ms"]
+    assert geo[0][-1] == "worse"
+    # past the scaled floor (and the threshold) the geoday row gates
+    new = {"geoday": {"rtt_ms": 150.0,
+                      "outage_takeover_recovery_ms": 600.0}}
+    _t, regressions = bc.compare({"geoday": old["geoday"]}, new,
+                                 threshold=0.15)
+    assert [(c, m) for c, m, *_ in regressions] == \
+        [("geoday", "outage_takeover_recovery_ms")]
+    # a missing rtt_ms leaves the plain 1ms floor untouched
+    old2 = {"y": {"takeover_recovery_ms": 10.0}}
+    new2 = {"y": {"takeover_recovery_ms": 20.0}}
+    _t, regressions = bc.compare(old2, new2, threshold=0.15)
+    assert [(c, m) for c, m, *_ in regressions] == \
+        [("y", "takeover_recovery_ms")]
