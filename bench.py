@@ -2534,6 +2534,313 @@ def cpu_sanity_rows() -> dict:
         return {"error": f"cpu sanity run failed: {exc!r}"[:300]}
 
 
+def bench_mqttplus(preds: int = 64, msgs: int = 4096,
+                   reps: int = 5, e2e_msgs: int = 200) -> dict:
+    """ADR-023 content plane (MAXMQ_BENCH_CONFIGS=mqttplus): three
+    phases. (1) Microbench: the vectorized columnar evaluator vs the
+    per-message Python reference loop over the same ``preds``
+    compiled predicates x ``msgs`` decoded JSON payloads — the
+    speedup the subsystem exists for, with a mask-equality check so
+    the fast path can never drift from the oracle unnoticed. (2) A
+    live broker with TCP predicate subscribers, one plain subscriber
+    and one windowed-aggregate subscriber: masked-delivery fractions
+    against the oracle's expectation and the emitted aggregate value
+    bit-compared (fp tolerance) to the naive recomputation. (3) The
+    filtering-DISABLED broker, proving the ADR-019 template fast
+    path still carries plain traffic untouched."""
+    import asyncio
+
+    import numpy as np
+
+    from maxmq_tpu.broker import (Broker, BrokerOptions, Capabilities,
+                                  TCPListener)
+    from maxmq_tpu.filtering.columnar import (ColumnarEvaluator,
+                                              build_columns,
+                                              eval_reference_batch)
+    from maxmq_tpu.filtering.expr import compile_expr
+    from maxmq_tpu.hooks import AllowHook
+    from maxmq_tpu.mqtt_client import MQTTClient
+
+    rng = random.Random(7)
+    fields = ("payload.temp", "payload.hum", "payload.rpm")
+    exprs = []
+    for i in range(preds):
+        f = fields[i % len(fields)]
+        op = rng.choice((">", "<", ">=", "<="))
+        e = f"{f}{op}{round(rng.uniform(0, 100), 1)}"
+        if i % 5 == 0:      # a quarter compound, like real fleets
+            g = fields[(i + 1) % len(fields)]
+            e = f"({e})&&{g}!={round(rng.uniform(0, 100), 1)}"
+        elif i % 7 == 0:
+            e = f"!({e})||payload.hum>90"
+        exprs.append(e)
+    predset = [compile_expr(e) for e in exprs]
+    objs = []
+    for i in range(msgs):
+        o = {"temp": round(rng.uniform(-10, 110), 2),
+             "hum": round(rng.uniform(0, 100), 2)}
+        if i % 7:           # a field that is sometimes missing
+            o["rpm"] = rng.randint(0, 10_000)
+        objs.append(o)
+
+    d: dict = {"config": "mqttplus", "predicates": preds,
+               "batch_msgs": msgs}
+
+    # -- phase 1: vectorized vs per-message reference ------------------
+    union: list[str] = []
+    for p in predset:
+        for f in p.fields:
+            if f not in union:
+                union.append(f)
+    programs = [p.program for p in predset]
+    ev = ColumnarEvaluator(backend="numpy")
+    mat = ev.eval_batch(programs, build_columns(objs, tuple(union)),
+                        msgs)                                   # warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        cols = build_columns(objs, tuple(union))    # decode-once cost
+        mat = ev.eval_batch(programs, cols, msgs)   # counts: in-loop
+    vec_s = max((time.perf_counter() - t0) / reps, 1e-9)
+    t0 = time.perf_counter()
+    ref = eval_reference_batch(predset, objs)
+    ref_s = max(time.perf_counter() - t0, 1e-9)
+    pairs = preds * msgs
+    d["vector_evals_per_sec"] = round(pairs / vec_s, 1)
+    d["reference_evals_per_sec"] = round(pairs / ref_s, 1)
+    d["vector_speedup"] = round(ref_s / vec_s, 2)
+    d["mask_mismatches"] = int((mat != ref).sum())
+
+    # device A/B (capture script: MAXMQ_FILTER_BACKEND=jnp): same
+    # programs through the requested backend, NumPy row kept alongside
+    want_backend = os.environ.get("MAXMQ_FILTER_BACKEND", "numpy")
+    if want_backend != "numpy":
+        dev = ColumnarEvaluator(backend=want_backend)
+        dmat = dev.eval_batch(programs,
+                              build_columns(objs, tuple(union)), msgs)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            cols = build_columns(objs, tuple(union))
+            dmat = dev.eval_batch(programs, cols, msgs)
+        dev_s = max((time.perf_counter() - t0) / reps, 1e-9)
+        d[f"vector_evals_per_sec_{want_backend}"] = round(
+            pairs / dev_s, 1)
+        d[f"mask_mismatches_{want_backend}"] = int((dmat != ref).sum())
+        d["device_fallbacks"] = dev.device_fallbacks
+
+    # -- phase 2: live broker, predicate + aggregate subscribers -------
+    temps = [float(i % 100) for i in range(e2e_msgs)]
+    thresholds = [10.0 * (1 + (i % 9)) for i in range(16)]
+
+    async def run_e2e() -> dict:
+        b = Broker(BrokerOptions(capabilities=Capabilities(
+            sys_topic_interval=0, maximum_keepalive=0)))
+        b.add_hook(AllowHook())
+        lst = b.add_listener(TCPListener("t", "127.0.0.1:0"))
+        await b.serve()
+        port = lst._server.sockets[0].getsockname()[1]
+
+        pub = MQTTClient(client_id="mp-pub", keepalive=0)
+        await pub.connect("127.0.0.1", port)
+        pclients = []
+        for i, thr in enumerate(thresholds):
+            c = MQTTClient(client_id=f"mp-p{i}", keepalive=0)
+            await c.connect("127.0.0.1", port)
+            await c.subscribe((f"sense/data?$expr=payload.temp>{thr}",
+                               0))
+            pclients.append(c)
+        plain = MQTTClient(client_id="mp-plain", keepalive=0)
+        await plain.connect("127.0.0.1", port)
+        await plain.subscribe(("sense/data", 0))
+        agg = MQTTClient(client_id="mp-agg", keepalive=0)
+        await agg.connect("127.0.0.1", port)
+        await agg.subscribe(
+            ("sense/data?$agg=avg&$win=1s&$field=payload.temp", 0))
+
+        t0 = time.perf_counter()
+        for t in temps:
+            await pub.publish("sense/data",
+                              json.dumps({"temp": t}).encode(), qos=0)
+        got = {"plain": 0}
+        pred_got = [0] * len(pclients)
+
+        async def drain(c, slot=None):
+            while True:
+                try:
+                    await c.next_message(timeout=1.0)
+                except asyncio.TimeoutError:
+                    return
+                if slot is None:
+                    got["plain"] += 1
+                else:
+                    pred_got[slot] += 1
+        await asyncio.gather(
+            drain(plain),
+            *(drain(c, i) for i, c in enumerate(pclients)))
+        span = max(time.perf_counter() - t0, 1e-9)
+
+        # windows close on the 1s housekeeping tick
+        emissions = []
+        deadline = time.perf_counter() + 4.0
+        while time.perf_counter() < deadline:
+            try:
+                m = await agg.next_message(timeout=0.5)
+            except asyncio.TimeoutError:
+                continue
+            row = json.loads(m.payload)
+            if row.get("op") == "avg":
+                emissions.append(row)
+                if sum(r["count"] for r in emissions) >= e2e_msgs:
+                    break
+
+        out = {"e2e_publishes": e2e_msgs,
+               "e2e_plain_delivered": got["plain"],
+               "e2e_msgs_per_sec": round(
+                   (got["plain"] + sum(pred_got)) / span, 1)}
+        mism = 0
+        for i, thr in enumerate(thresholds):
+            if pred_got[i] != sum(1 for t in temps if t > thr):
+                mism += 1
+        out["e2e_pred_count_mismatches"] = mism
+        out["e2e_masked_frac"] = round(
+            1 - sum(pred_got) / (e2e_msgs * len(pclients)), 3)
+        agg_n = sum(r["count"] for r in emissions)
+        out["agg_emissions"] = len(emissions)
+        out["agg_samples"] = agg_n
+        if agg_n:
+            folded = sum(r["value"] * r["count"] for r in emissions)
+            expect = sum(temps[:agg_n]) / agg_n
+            out["agg_value_abs_err"] = round(
+                abs(folded / agg_n - expect), 12)
+        cp = b.content
+        out["filter_evals"] = cp.evals
+        out["filter_masked"] = cp.masked
+        out["filter_eval_errors"] = cp.eval_errors
+
+        for c in pclients + [pub, plain, agg]:
+            try:
+                await c.disconnect()
+            except Exception:
+                pass
+        await b.close()
+        return out
+
+    for k, v in asyncio.run(run_e2e()).items():
+        d[k] = v
+
+    # -- phase 3: filtering disabled — plain path untouched ------------
+    async def run_disabled() -> dict:
+        b = Broker(BrokerOptions(capabilities=Capabilities(
+            sys_topic_interval=0, maximum_keepalive=0,
+            content_filtering=False)))
+        b.add_hook(AllowHook())
+        lst = b.add_listener(TCPListener("t", "127.0.0.1:0"))
+        await b.serve()
+        port = lst._server.sockets[0].getsockname()[1]
+        pub = MQTTClient(client_id="md-pub", keepalive=0)
+        await pub.connect("127.0.0.1", port)
+        sub = MQTTClient(client_id="md-sub", keepalive=0)
+        await sub.connect("127.0.0.1", port)
+        await sub.subscribe(("sense/data", 0))
+        sends0 = b.overload.template_sends
+        t0 = time.perf_counter()
+        for t in temps:
+            await pub.publish("sense/data",
+                              json.dumps({"temp": t}).encode(), qos=0)
+        n = 0
+        while n < e2e_msgs:
+            try:
+                await sub.next_message(timeout=1.0)
+            except asyncio.TimeoutError:
+                break
+            n += 1
+        span = max(time.perf_counter() - t0, 1e-9)
+        out = {"disabled_plane_absent": b.content is None,
+               "disabled_delivered": n,
+               "disabled_msgs_per_sec": round(n / span, 1),
+               "disabled_template_sends":
+                   b.overload.template_sends - sends0}
+        for c in (pub, sub):
+            try:
+                await c.disconnect()
+            except Exception:
+                pass
+        await b.close()
+        return out
+
+    for k, v in asyncio.run(run_disabled()).items():
+        d[k] = v
+
+    log(f"[mqttplus] vectorized {d['vector_evals_per_sec']:,.0f} "
+        f"pair-evals/s = {d['vector_speedup']}x reference "
+        f"(mismatches {d['mask_mismatches']}); e2e masked "
+        f"{d.get('e2e_masked_frac')} agg_err "
+        f"{d.get('agg_value_abs_err', 'n/a')}")
+    return d
+
+
+def bench_churn(n_subs: int = 20_000, batch: int = 8_192,
+                rounds: int = 12) -> dict:
+    """ADR-023 satellite (MAXMQ_BENCH_CONFIGS=churn): subscription
+    churn under matcher load. One sig-matcher corpus at ``n_subs``
+    subscriptions takes a steady QoS0-shaped topic-batch stream;
+    between batches a churn loop subscribes/unsubscribes fresh
+    filters and forces ``refresh()`` recompiles. Reported: healthy
+    vs churning match throughput (the dip ratio) and the refresh
+    recompile latency distribution — the costs a fleet pays when
+    devices come and go mid-traffic."""
+    import numpy as np
+
+    from maxmq_tpu.matching.sig import SigEngine
+    from maxmq_tpu.protocol.packets import Subscription
+
+    log(f"[churn] corpus {n_subs} subs ...")
+    filters, topic_gen = build_corpus(n_subs)
+    index = build_index(filters)
+    engine = SigEngine(index, auto_refresh=False)
+    batches = [topic_gen(batch, seed2=500 + i) for i in range(rounds)]
+    run_sig(engine, batches[:1], 2)                 # warm compile
+
+    def measure(tag: int, churn: bool) -> tuple[float, list[float]]:
+        refresh_ms: list[float] = []
+        t0 = time.perf_counter()
+        for i, topics in enumerate(batches):
+            if churn:
+                for j in range(32):
+                    cid = f"churn-{tag}-{i}-{j}"
+                    index.subscribe(cid, Subscription(
+                        filter=f"churn/{tag}/{i}/{j}/+"))
+                for j in range(16):
+                    index.unsubscribe(f"churn-{tag}-{i}-{j}",
+                                      f"churn/{tag}/{i}/{j}/+")
+                r0 = time.perf_counter()
+                engine.refresh()
+                refresh_ms.append(
+                    (time.perf_counter() - r0) * 1000.0)
+            run_sig(engine, [topics], 2)
+        return time.perf_counter() - t0, refresh_ms
+
+    healthy_s, _ = measure(0, churn=False)
+    churn_s, refresh_ms = measure(1, churn=True)
+    total = batch * rounds
+    arr = np.asarray(refresh_ms)
+    d = {"config": "churn", "corpus_subs": n_subs,
+         "batch": batch, "rounds": rounds,
+         "healthy_matches_per_sec": round(total / healthy_s, 1),
+         "churning_matches_per_sec": round(total / churn_s, 1),
+         "churn_dip_ratio": round(healthy_s / churn_s, 3),
+         "churn_refresh_count": len(refresh_ms),
+         "churn_refresh_p50_ms": round(
+             float(np.percentile(arr, 50)), 2) if len(arr) else None,
+         "churn_refresh_p99_ms": round(
+             float(np.percentile(arr, 99)), 2) if len(arr) else None}
+    log(f"[churn] healthy {d['healthy_matches_per_sec']:,.0f}/s "
+        f"churning {d['churning_matches_per_sec']:,.0f}/s "
+        f"(ratio {d['churn_dip_ratio']}) refresh p50 "
+        f"{d['churn_refresh_p50_ms']}ms p99 "
+        f"{d['churn_refresh_p99_ms']}ms")
+    return d
+
+
 def main() -> None:
     which = os.environ.get("MAXMQ_BENCH_CONFIGS",
                            "1,2,3,4,4h,5,lat,lath,latd,latdo,e2e")
@@ -2752,6 +3059,21 @@ def main() -> None:
                      lambda: bench_cshard(
                          storm=max(60, int(200 * scale)),
                          msgs=max(60, int(300 * scale)))))
+    if "mqttplus" in which:
+        # ADR-023 content plane: vectorized predicate eval vs the
+        # per-message reference (>=5x at 64 predicates), live-broker
+        # masked delivery + aggregate bit-compare, disabled fast path
+        runs.append(("mqttplus",
+                     lambda: bench_mqttplus(
+                         msgs=max(512, int(4096 * scale)),
+                         e2e_msgs=max(60, int(200 * scale)))))
+    if "churn" in which:
+        # ADR-023 satellite: sub/unsub churn under matcher load —
+        # refresh() recompile latency + the throughput dip ratio
+        runs.append(("churn",
+                     lambda: bench_churn(
+                         n_subs=s(20_000),
+                         rounds=max(4, int(12 * scale)))))
     if "5" in which:
         runs.append(("cluster", lambda: bench_cluster(subs=s(100_000))))
     if "e2e" in which:
@@ -2838,7 +3160,7 @@ CONFIG_DEADLINES = {"1": 900, "2": 900, "3": 1200, "4": 2400,
                     "widthab": 1200, "degraded": 1200, "overload": 900,
                     "cluster": 900, "durable": 900, "failover": 900,
                     "fanout": 900, "macroday": 900, "cshard": 900,
-                    "geoday": 900}
+                    "geoday": 900, "mqttplus": 900, "churn": 1200}
 
 
 def run_supervised(which: list[str]) -> None:

@@ -70,7 +70,41 @@ def capabilities_from_config(conf: Config) -> Capabilities:
         # zero-copy fan-out (ADR 019)
         native_encode=conf.broker_native_encode,
         flush_coalesce=conf.broker_flush_coalesce,
+        # MQTT+ content plane (ADR 023)
+        content_filtering=conf.filter_enabled,
+        filter_backend=conf.filter_backend,
+        filter_max_subscriptions=conf.filter_max_subscriptions,
+        filter_max_expr_len=conf.filter_max_expr_len,
+        filter_max_fields=conf.filter_max_fields,
+        filter_batch_max=conf.filter_batch_max,
+        filter_window_min_s=float(conf.filter_window_min_s),
+        filter_window_max_s=float(conf.filter_window_max_s),
     )
+
+
+def install_event_loop(policy: str, logger: Logger | None = None) -> str:
+    """Install the configured asyncio event-loop policy BEFORE
+    asyncio.run (ADR 023 satellite). ``auto`` takes uvloop when the
+    package is installed; ``uvloop`` warns and falls back cleanly when
+    it is not — a config written for a uvloop box must still boot a
+    bare one. Returns the name of what was installed."""
+    policy = (policy or "auto").strip().lower()
+    if policy not in ("auto", "asyncio", "uvloop"):
+        raise ValueError(f"unknown broker_event_loop {policy!r} "
+                         "(want auto|asyncio|uvloop)")
+    if policy in ("auto", "uvloop"):
+        try:
+            import uvloop
+        except ImportError:
+            if policy == "uvloop" and logger is not None:
+                logger.with_prefix("bootstrap").warn(
+                    "uvloop requested but not installed; "
+                    "falling back to asyncio")
+        else:
+            asyncio.set_event_loop_policy(uvloop.EventLoopPolicy())
+            return "uvloop"
+    asyncio.set_event_loop_policy(asyncio.DefaultEventLoopPolicy())
+    return "asyncio"
 
 
 def build_matcher(conf: Config, broker: Broker):
@@ -180,6 +214,7 @@ def build_cluster(conf: Config, broker: Broker, logger: Logger | None = None):
         telemetry_interval_s=float(conf.cluster_telemetry_interval_s),
         telemetry_full_every=conf.cluster_telemetry_full_every,
         rtt_deadline_k=float(conf.cluster_rtt_deadline_k),
+        content_routes=conf.cluster_content_routes,
         logger=logger.with_prefix("cluster") if logger else None)
     broker.attach_cluster(manager)
     return manager

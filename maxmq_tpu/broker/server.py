@@ -18,6 +18,9 @@ from dataclasses import dataclass, field
 from zlib import crc32
 
 from .. import faults
+from ..filtering.expr import ExprError, decode_payload
+from ..filtering.plane import (ContentPlane, ContentQuota,
+                               USER_PROP_KEY as FILTER_PROP_KEY)
 from ..hooks.base import Hook, Hooks, RejectPacket
 from ..trace import MAX_DRAIN_SPANS, PipelineTracer
 from ..matching.topics import valid_filter, valid_topic_name
@@ -110,6 +113,18 @@ class Capabilities:
     flush_coalesce: bool = True       # coalesce writer wakes to one
                                       # flush per loop iteration
 
+    # -- MQTT+ content plane (ADR 023) ---------------------------------
+    content_filtering: bool = True    # parse ?$expr/?$agg SUBSCRIBE
+                                      # options; False leaves '?' a
+                                      # plain topic character
+    filter_backend: str = "numpy"     # numpy | jnp | auto
+    filter_max_subscriptions: int = 10000  # content subs per broker
+    filter_max_expr_len: int = 512    # $expr source-length bound
+    filter_max_fields: int = 64       # distinct decoded fields bound
+    filter_batch_max: int = 256       # pipeline publishes per eval flush
+    filter_window_min_s: float = 0.5  # accepted $win range
+    filter_window_max_s: float = 3600.0
+
 
 @dataclass
 class BrokerOptions:
@@ -148,6 +163,12 @@ class Broker:
         # publish topics repeat heavily, and a trie walk costs ~20us;
         # entries self-invalidate on any subscription change
         self._match_cache = VersionedTopicCache()
+        # MQTT+ content plane (ADR 023): payload-predicate masks +
+        # windowed aggregates. Constructed whenever the capability is
+        # on; with no content subscriptions registered .active is
+        # False and every publish-path hook reduces to one check
+        self.content = (ContentPlane(self)
+                        if self.capabilities.content_filtering else None)
         # matcher-mode publish pipeline: (match future, origin, packet)
         # consumed in arrival order, so per-publisher delivery order holds
         # [MQTT-4.6.0] while many publishes ride the device concurrently
@@ -565,6 +586,8 @@ class Broker:
                 if self.cluster is not None:
                     self.cluster.note_unsubscribe(filt)
         client.subscriptions.clear()
+        if self.content is not None:
+            self.content.drop_client(client.id)
         self.clients.delete(client.id)
         sessions = self._cluster_sessions()
         if sessions is not None:
@@ -1174,38 +1197,85 @@ class Broker:
     async def _pub_pipeline_loop(self) -> None:
         """Drain the publish pipeline in arrival order: await each match
         result, fan out, fire on_published. A matcher failure degrades
-        that one publish to the CPU trie — delivery never silently drops."""
+        that one publish to the CPU trie — delivery never silently drops.
+
+        With content subscriptions registered (ADR 023) the loop drains
+        every already-queued publish into one flush — bounded by
+        filter_batch_max — so the content plane decodes payloads and
+        evaluates every (publish x predicate) pair in one vectorized
+        pass; arrival order is preserved end to end. With the plane
+        inactive the pre-023 single-item path runs unchanged."""
         while True:
-            fut, client, packet, durable_ack = await self._pub_queue.get()
+            item = await self._pub_queue.get()
+            cp = self.content
+            if cp is not None and cp.active:
+                batch = [item]
+                while len(batch) < cp.batch_max:
+                    try:
+                        batch.append(self._pub_queue.get_nowait())
+                    except asyncio.QueueEmpty:
+                        break
+                await self._pub_deliver_batch(batch)
+                continue
+            fut, client, packet, durable_ack = item
             try:
-                try:
-                    subscribers = await fut
-                except asyncio.CancelledError:
-                    # CancelledError is a BaseException: catch it
-                    # explicitly or a batcher-close cancelling a MATCH
-                    # future kills the consumer. cancelling() (3.11+)
-                    # distinguishes "we are being cancelled" from "only
-                    # the future was"; without it, stay conservative.
-                    me = asyncio.current_task()
-                    cancelling = getattr(me, "cancelling", None)
-                    if cancelling is None or cancelling():
-                        raise
-                    subscribers = self.topics.subscribers(packet.topic)
-                except Exception as exc:
-                    self.matcher_degrades += 1
-                    self.tracer.note_error("match_device", "matcher_failed")
-                    tr = self._packet_trace(packet)
-                    if tr is not None:
-                        tr.degraded = "pipeline_trie"
-                    if self.log is not None:
-                        self.log.with_prefix("broker").error(
-                            "matcher failed; trie fallback",
-                            topic=packet.topic, error=repr(exc))
-                    subscribers = self.topics.subscribers(packet.topic)
+                subscribers = await self._await_match(fut, packet)
                 if self.tracer.sample_n or self.tracer.adopted_open:
                     self._trace_match_spans(fut, packet)
                 self._pub_deliver(subscribers, client, packet, durable_ack)
             finally:
+                self._pub_queue.task_done()
+
+    async def _await_match(self, fut, packet: Packet):
+        """Await one match future with the pipeline's degrade ladder:
+        a cancelled future (not a cancelled consumer) or a matcher
+        failure serves that one publish from the CPU trie."""
+        try:
+            return await fut
+        except asyncio.CancelledError:
+            # CancelledError is a BaseException: catch it
+            # explicitly or a batcher-close cancelling a MATCH
+            # future kills the consumer. cancelling() (3.11+)
+            # distinguishes "we are being cancelled" from "only
+            # the future was"; without it, stay conservative.
+            me = asyncio.current_task()
+            cancelling = getattr(me, "cancelling", None)
+            if cancelling is None or cancelling():
+                raise
+            return self.topics.subscribers(packet.topic)
+        except Exception as exc:
+            self.matcher_degrades += 1
+            self.tracer.note_error("match_device", "matcher_failed")
+            tr = self._packet_trace(packet)
+            if tr is not None:
+                tr.degraded = "pipeline_trie"
+            if self.log is not None:
+                self.log.with_prefix("broker").error(
+                    "matcher failed; trie fallback",
+                    topic=packet.topic, error=repr(exc))
+            return self.topics.subscribers(packet.topic)
+
+    async def _pub_deliver_batch(self, batch: list) -> None:
+        """One content-plane flush (ADR 023): resolve every match in
+        arrival order, evaluate the batch's predicate matrix once,
+        then deliver in the same order. task_done fires once per item
+        even when a resolve raises mid-batch (consumer cancellation)."""
+        try:
+            resolved = []
+            for fut, client, packet, durable_ack in batch:
+                subscribers = await self._await_match(fut, packet)
+                resolved.append(
+                    (fut, subscribers, client, packet, durable_ack))
+            cp = self.content
+            if cp is not None and cp.active:
+                cp.apply([(packet, subscribers)
+                          for _f, subscribers, _c, packet, _d in resolved])
+            for fut, subscribers, client, packet, durable_ack in resolved:
+                if self.tracer.sample_n or self.tracer.adopted_open:
+                    self._trace_match_spans(fut, packet)
+                self._pub_deliver(subscribers, client, packet, durable_ack)
+        finally:
+            for _ in batch:
                 self._pub_queue.task_done()
 
     def _trace_match_spans(self, fut, packet: Packet) -> None:
@@ -1285,6 +1355,14 @@ class Broker:
         path funnels through here exactly once, so the route-table
         consult happens once per publish regardless of matcher mode —
         and the ADR-015 fanout/bridge spans are stamped once too."""
+        cp = self.content
+        if (cp is not None and cp.active
+                and "_content_skip" not in packet.__dict__):
+            # trie-path / will / $SYS / inline publishes reach here
+            # without riding the pipeline flush: evaluate them as a
+            # single-packet batch (the pipeline path already stamped
+            # its packets, which is what the sentinel key records)
+            cp.apply(((packet, subscribers),))
         tr = self._packet_trace(packet)
         if tr is None:
             self._fan_out_local(subscribers, packet)
@@ -1594,6 +1672,11 @@ class Broker:
             return
         if sub.no_local and packet.origin == client_id:
             return  # v5 NoLocal [MQTT-3.8.3-3]
+        skip = packet.__dict__.get("_content_skip")
+        if skip is not None and not shared and client_id in skip:
+            return  # ADR 023: every claim this client has on the topic
+            #         is content-gated and none passed (shared picks
+            #         are exempt: $share filters carry no options)
         if self._shed_qos0(client, sub, packet):
             return  # above the high-water mark: QoS0 fan-out shed
         if self._fast_qos0_eligible(client, sub, packet):
@@ -1838,8 +1921,33 @@ class Broker:
         reason_codes: list[int] = []
         counts: list[int] = []
         accepted: list[Subscription] = []
+        specs = self._content_specs(client, packet)
         for sub in packet.filters:
             filt = sub.filter
+            spec = None
+            if specs is not None:
+                # ADR 023: split/parse content options (?$expr / ?$agg
+                # suffix, or the v5 user-property carriage); malformed
+                # options reject THIS filter cleanly
+                options = None
+                if "?" in filt:
+                    filt, _, options = filt.partition("?")
+                elif filt in specs:
+                    options = specs[filt]
+                if options is not None:
+                    try:
+                        if filt.startswith("$share/"):
+                            raise ExprError(
+                                "content options on a $share filter")
+                        spec = self.content.parse_spec(options)
+                    except ExprError:
+                        self.content.rejected_subscribes += 1
+                        reason_codes.append(
+                            codes.ErrTopicFilterInvalid.value)
+                        counts.append(0)
+                        continue
+                    sub.filter = filt   # index/cluster/session all see
+                    #                     the base filter from here on
             if not valid_filter(filt,
                                 shared_allowed=caps.shared_sub_available,
                                 wildcards_allowed=caps.wildcard_sub_available):
@@ -1865,6 +1973,20 @@ class Broker:
             sub.qos = granted
             if not caps.sub_id_available:
                 sub.identifier = 0
+            if spec is not None:
+                try:
+                    self.content.register(client.id, filt, spec)
+                except ContentQuota:
+                    # refused BEFORE the topic index sees it: nothing
+                    # to roll back, the quota answer is the SUBACK code
+                    self.content.rejected_subscribes += 1
+                    reason_codes.append(codes.ErrQuotaExceeded.value)
+                    counts.append(0)
+                    continue
+            elif self.content is not None:
+                # a plain re-SUBSCRIBE on the same filter replaces any
+                # earlier content options (resubscribe semantics)
+                self.content.unregister(client.id, filt)
             is_new = self.topics.subscribe(client.id, sub)
             if is_new:
                 self.info.subscriptions += 1
@@ -1881,6 +2003,24 @@ class Broker:
         for sub, is_new in accepted:
             self._publish_retained_to(client, sub, existing=not is_new)
 
+    def _content_specs(self, client: Client,
+                       packet: Packet) -> dict[str, str] | None:
+        """ADR 023: the v5 user-property carriage of content options —
+        each ``maxmq-filter`` property holds ``<filter>?<options>``
+        and applies to the matching filter in this SUBSCRIBE. Returns
+        None when the content plane is off (then ``?`` stays a plain
+        topic character, the documented opt-in)."""
+        if self.content is None:
+            return None
+        out: dict[str, str] = {}
+        if client.properties.protocol_version >= 5:
+            for key, val in packet.properties.user_properties:
+                if key == FILTER_PROP_KEY:
+                    base, sep, options = val.partition("?")
+                    if sep:
+                        out[base] = options
+        return out
+
     def _cluster_note_subs(self, accepted) -> None:
         """Feed brand-new subscriptions into the federation route
         table (ADR 013) so peers learn them as aggregated deltas."""
@@ -1896,6 +2036,11 @@ class Broker:
         none [MQTT-3.3.1-13]."""
         if sub.filter.startswith("$share/"):
             return
+        csub = (self.content.get(client.id, sub.filter)
+                if self.content is not None else None)
+        if csub is not None and csub.window is not None:
+            return  # ADR 023: aggregate subs receive synthesized
+            #         window publishes, never the raw retained state
         if sub.retain_handling == 2:
             return
         if sub.retain_handling == 1 and existing:
@@ -1915,6 +2060,12 @@ class Broker:
         now = time.time()
         maxexp = self.capabilities.maximum_message_expiry_interval
         for msg in self.topics.retained_for(sub.filter):
+            if (csub is not None and csub.pred is not None
+                    and not csub.pred.eval_reference(
+                        decode_payload(msg.payload))):
+                continue    # ADR 023: retained state is predicate-
+                #             gated via the scalar reference evaluator
+                #             (a cold path; no batch to vectorize)
             if not self._message_expired(msg, now, maxexp):
                 self._send_retained(client, sub, msg, now)
 
@@ -1961,12 +2112,18 @@ class Broker:
         packet = self.hooks.modify("on_unsubscribe", packet, client)
         reason_codes = []
         for sub in packet.filters:
-            existed = self.topics.unsubscribe(client.id, sub.filter)
+            filt = sub.filter
+            if self.content is not None:
+                if "?" in filt:     # ADR 023: clients unsubscribe with
+                    filt = filt.partition("?")[0]  # the suffixed form;
+                    #                 the index holds the base filter
+                self.content.unregister(client.id, filt)
+            existed = self.topics.unsubscribe(client.id, filt)
             if existed:
                 self.info.subscriptions -= 1
                 if self.cluster is not None:
-                    self.cluster.note_unsubscribe(sub.filter)
-            client.subscriptions.pop(sub.filter, None)
+                    self.cluster.note_unsubscribe(filt)
+            client.subscriptions.pop(filt, None)
             reason_codes.append(codes.Success.value if existed
                                 else codes.NoSubscriptionExisted.value)
         client.send(Packet(fixed=FixedHeader(type=PT.UNSUBACK),
@@ -2066,6 +2223,8 @@ class Broker:
                 self._check_expired_inflight(now)
                 self._check_stalled_writers(mono)
                 self._check_overload_recovery()
+                if self.content is not None:
+                    self.content.tick(now)
         except asyncio.CancelledError:
             pass
 

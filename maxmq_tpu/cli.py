@@ -11,7 +11,8 @@ import argparse
 import asyncio
 import sys
 
-from .bootstrap import BANNER, new_logger_from_config, run_server
+from .bootstrap import (BANNER, install_event_loop,
+                        new_logger_from_config, run_server)
 from .utils.build import get_info
 from .utils.config import load_config
 
@@ -53,6 +54,8 @@ def cmd_start(args: argparse.Namespace) -> int:
     logger = new_logger_from_config(conf)
     if not args.no_banner:
         print(BANNER, file=sys.stderr)
+    # ADR 023 satellite: the loop policy must land before asyncio.run
+    install_event_loop(conf.broker_event_loop, logger)
     try:
         asyncio.run(run_server(conf, logger))
     except KeyboardInterrupt:

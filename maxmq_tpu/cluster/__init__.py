@@ -11,8 +11,8 @@ from .membership import (Membership, PeerSpec, PeerSpecError,
                          parse_peers, valid_node_id)
 from .routes import (IncrementalCover, RouteTable, RouteWireError,
                      ShareLedger, decode_delta, decode_snapshot,
-                     encode_delta, encode_snapshot, filter_subsumes,
-                     minimal_cover)
+                     decode_snapshot_preds, encode_delta,
+                     encode_snapshot, filter_subsumes, minimal_cover)
 from .sessions import SessionEntry, SessionFederation
 from .telemetry import WIRE_CAPS, ClusterTelemetry
 
@@ -20,8 +20,9 @@ __all__ = [
     "BRIDGE_ID_PREFIX", "BridgeLink", "ClusterManager", "DedupWindow",
     "Membership", "PeerSpec", "PeerSpecError", "parse_peers",
     "valid_node_id", "IncrementalCover", "RouteTable", "RouteWireError",
-    "ShareLedger", "decode_delta", "decode_snapshot", "encode_delta",
-    "encode_snapshot", "filter_subsumes", "minimal_cover",
+    "ShareLedger", "decode_delta", "decode_snapshot",
+    "decode_snapshot_preds", "encode_delta", "encode_snapshot",
+    "filter_subsumes", "minimal_cover",
     "SessionEntry", "SessionFederation", "ClusterTelemetry",
     "WIRE_CAPS",
 ]

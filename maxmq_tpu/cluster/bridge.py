@@ -120,6 +120,10 @@ class BridgeLink:
         # marks a link whose last snapshot failed to enqueue and must
         # be retried before any delta may flow
         self.advertised: set[str] = set()
+        # ADR 023 stretch: the predicate annotations last sent on this
+        # link (None while content routes are off / before the first
+        # annotated snapshot) — annotation drift forces a snapshot
+        self.advertised_preds: dict[str, list[str]] | None = None
         self.route_seq = 0
         self.needs_snapshot = False
 

@@ -48,8 +48,10 @@ from ..protocol.codec import FixedHeader, PacketType as PT
 from ..protocol.packets import Packet
 from .bridge import BRIDGE_ID_PREFIX, BridgeLink
 from .membership import Membership, PeerSpec, valid_node_id
+from ..filtering.expr import ExprError, compile_expr, decode_payload
 from .routes import (RouteTable, RouteWireError, decode_delta,
-                     decode_snapshot, encode_delta, encode_snapshot)
+                     decode_snapshot_preds, encode_delta,
+                     encode_snapshot)
 
 DEDUP_WINDOW = 8192     # per-origin forwarded-message-id memory
 REHOME_INTENT_TTL_S = 60.0   # how long a deferred takeover-rehome waits
@@ -100,7 +102,8 @@ class ClusterManager:
                  trace_return: bool = True,
                  telemetry_interval_s: float = 5.0,
                  telemetry_full_every: int = 10,
-                 rtt_deadline_k: float = 4.0) -> None:
+                 rtt_deadline_k: float = 4.0,
+                 content_routes: bool = False) -> None:
         if not valid_node_id(node_id):
             raise ValueError(f"bad cluster node id {node_id!r}")
         if any(p.node_id == node_id for p in peers):
@@ -129,6 +132,13 @@ class ClusterManager:
         # 150ms link never flaps as dead while a truly dead link is
         # still detected at the floor
         self.rtt_deadline_k = max(float(rtt_deadline_k), 0.0)
+        # ADR 023 stretch: predicate-annotated routes — snapshots carry
+        # the local content plane's fully-gated filter->exprs map, and
+        # the forwarder reference-evaluates a peer's annotations to
+        # skip forwards its content plane would fully mask. Off by
+        # default; purely an optimization (fail open on any doubt).
+        self.content_routes = content_routes
+        self._pred_cache: dict[str, object] = {}
         self.routes = RouteTable(
             node_id, epoch if epoch is not None
             else int(time.time() * 1000))
@@ -181,6 +191,7 @@ class ClusterManager:
         self.route_apply_failures = 0
         self.syncs_sent = 0
         self.inbound_rejected = 0       # malformed/spoofed $cluster wire
+        self.content_route_skips = 0    # ADR 023: pred-gated forwards
         # ADR 018: fwd-durability barrier + partition-harness health
         self.fwd_barrier_waits = 0      # publisher acks that waited on
                                         # a cross-node forward PUBACK
@@ -344,7 +355,17 @@ class ClusterManager:
             changed = self.routes.note_local_subscribe(filt)
         else:
             changed = self.routes.note_local_unsubscribe(filt)
-        if changed and refresh:
+        # under content_routes every subscription change may flip a
+        # filter's gating (a plain holder joining a gated filter must
+        # un-gate it at the peers) even when the aggregated set is
+        # unchanged — the refresh pass diffs annotations per link
+        if refresh and (changed or self.content_routes):
+            self._schedule_refresh()
+
+    def note_content_change(self) -> None:
+        """ADR 023 stretch: the content plane's registry changed —
+        re-advertise so peers see the fresh gating annotations."""
+        if self.content_routes:
             self._schedule_refresh()
 
     # ------------------------------------------------------------------
@@ -365,6 +386,7 @@ class ClusterManager:
 
     def _refresh_advertisements(self) -> None:
         self._refresh_pending = False
+        preds_map = self._content_preds()
         for link in self.links.values():
             if not link.connected:
                 continue    # the reconnect snapshot will catch it up
@@ -372,6 +394,14 @@ class ClusterManager:
                 self._send_snapshot(link)   # unsent snapshot first: a
                 continue                    # delta atop it would gap
             desired = self.routes.advertisement_for(link.peer)
+            if preds_map is not None:
+                pdes = {f: preds_map[f]
+                        for f in desired if f in preds_map}
+                if pdes != link.advertised_preds:
+                    # deltas never carry annotations (ADR 023): any
+                    # gating change rides a full snapshot
+                    self._send_snapshot(link)
+                    continue
             if desired == link.advertised:
                 continue
             add = desired - link.advertised
@@ -388,6 +418,30 @@ class ClusterManager:
                 # peer: fall back to a full snapshot on this link
                 self._send_snapshot(link)
 
+    def _content_preds(self) -> dict[str, list[str]] | None:
+        """The local content plane's fully-gated filter->exprs map, or
+        None when predicate-annotated routes are off (ADR 023). Only
+        LOCAL filters ever carry annotations: transitive routes from
+        other peers stay un-annotated, so a relay never gates traffic
+        on behalf of a node it cannot see into."""
+        if not self.content_routes:
+            return None
+        cp = getattr(self.broker, "content", None)
+        if cp is None:
+            return None
+        try:
+            gated = cp.gated_filters()
+        except Exception:
+            return None     # fail open: plain, annotation-free routes
+        if gated:
+            # a remote holder of the same filter string rides our
+            # transitive advertisement — its subscribers are not
+            # gated by OUR predicates, so the filter must stay plain
+            for nr in self.routes.nodes.values():
+                for f in nr.filters & gated.keys():
+                    gated.pop(f, None)
+        return gated
+
     def _send_snapshot(self, link: BridgeLink) -> bool:
         """Send the full advertisement on one link. ``advertised``/
         ``route_seq`` advance ONLY on a successful enqueue — marking a
@@ -395,14 +449,18 @@ class ClusterManager:
         routeless while we believe it is caught up; failures mark the
         link and retry shortly."""
         desired = self.routes.advertisement_for(link.peer)
+        preds_map = self._content_preds()
+        pdes = ({f: preds_map[f] for f in desired if f in preds_map}
+                if preds_map is not None else None)
         ok = link.send_control(
             f"$cluster/routes/{self.node_id}",
             encode_snapshot(self.node_id, self.routes.epoch,
-                            link.route_seq + 1, desired),
+                            link.route_seq + 1, desired, preds=pdes),
             retain=True)
         if ok:
             link.route_seq += 1
             link.advertised = desired
+            link.advertised_preds = pdes
             link.needs_snapshot = False
         else:
             link.needs_snapshot = True
@@ -552,6 +610,9 @@ class ClusterManager:
             targets = set(self.routes.nodes_for(topic))
         targets.discard(origin)
         targets.discard(via)
+        if (self.content_routes and targets
+                and not packet.fixed.retain):
+            targets = self._content_gate(targets, topic, packet)
         if not targets:
             return
         if hops >= self.max_hops:
@@ -575,6 +636,48 @@ class ClusterManager:
                              collect, park)
         if collect:
             packet._fwd_waits = collect
+
+    def _content_gate(self, targets: set[str], topic: str,
+                      packet: Packet) -> set[str]:
+        """ADR 023 stretch: drop forward targets whose EVERY matching
+        advertised filter carries predicate annotations none of which
+        pass this payload — the peer's content plane would mask every
+        delivery anyway. Fail open on any doubt (un-annotated filter,
+        compile error, eval error): correctness over savings."""
+        obj = None
+        decoded = False
+        keep = set()
+        for node in targets:
+            exprs = self.routes.pred_gate(node, topic)
+            if exprs is None:
+                keep.add(node)
+                continue
+            if not decoded:
+                obj = decode_payload(packet.payload)
+                decoded = True
+            if self._any_pred_passes(exprs, obj):
+                keep.add(node)
+            else:
+                self.content_route_skips += 1
+        return keep
+
+    def _any_pred_passes(self, exprs, obj) -> bool:
+        for e in exprs:
+            pred = self._pred_cache.get(e)
+            if pred is None:
+                try:
+                    pred = compile_expr(e)
+                except ExprError:
+                    return True     # un-compilable annotation: fail open
+                if len(self._pred_cache) > 512:
+                    self._pred_cache.clear()
+                self._pred_cache[e] = pred
+            try:
+                if pred.eval_reference(obj):
+                    return True
+            except Exception:
+                return True
+        return False
 
     def _fwd_identity(self, packet: Packet) -> tuple:
         """(origin, epoch, msgid, via, hops) for one forward — local
@@ -1083,11 +1186,15 @@ class ClusterManager:
             self._desync(node)
 
     def _apply_snapshot(self, node: str, payload: bytes) -> None:
-        wnode, epoch, seq, filters = decode_snapshot(payload)
+        wnode, epoch, seq, filters, preds = \
+            decode_snapshot_preds(payload)
         if wnode != node:
             self.inbound_rejected += 1
             return
-        if self.routes.apply_snapshot(node, epoch, seq, filters):
+        if not self.content_routes:
+            preds = {}      # ADR 023 off: never gate on annotations
+        if self.routes.apply_snapshot(node, epoch, seq, filters,
+                                      preds=preds):
             self.snapshots_applied += 1
             self._note_route_sync(node)
             self.membership.note_alive(node)

@@ -38,7 +38,7 @@ from __future__ import annotations
 import json
 import zlib
 
-from ..matching.topics import split_levels
+from ..matching.topics import filter_matches_topic, split_levels
 from ..matching.trie import TopicIndex, VersionedTopicCache
 from ..protocol.packets import Subscription
 
@@ -278,13 +278,29 @@ class ShareLedger:
 # ----------------------------------------------------------------------
 
 
-def encode_snapshot(node: str, epoch: int, seq: int, filters) -> bytes:
-    return zlib.compress(json.dumps(
-        {"v": WIRE_VERSION, "node": node, "epoch": epoch, "seq": seq,
-         "filters": sorted(filters)}).encode())
+def encode_snapshot(node: str, epoch: int, seq: int, filters,
+                    preds=None) -> bytes:
+    d = {"v": WIRE_VERSION, "node": node, "epoch": epoch, "seq": seq,
+         "filters": sorted(filters)}
+    if preds:
+        # ADR 023 stretch: per-filter predicate annotations, present
+        # only for filters whose EVERY local holder is content-gated.
+        # Decoders that predate the key ignore it (same wire version:
+        # the snapshot stays fully readable without it).
+        d["preds"] = {f: sorted(preds[f]) for f in sorted(preds)}
+    return zlib.compress(json.dumps(d).encode())
 
 
 def decode_snapshot(payload: bytes) -> tuple[str, int, int, list[str]]:
+    node, epoch, seq, filters, _preds = decode_snapshot_preds(payload)
+    return node, epoch, seq, filters
+
+
+def decode_snapshot_preds(
+        payload: bytes
+) -> tuple[str, int, int, list[str], dict[str, tuple[str, ...]]]:
+    """Snapshot decode that also surfaces the optional ADR-023
+    predicate annotations ({} when the sender carried none)."""
     try:
         raw = zlib.decompress(payload, bufsize=65536)
         if len(raw) > MAX_SNAPSHOT_BYTES:
@@ -292,8 +308,10 @@ def decode_snapshot(payload: bytes) -> tuple[str, int, int, list[str]]:
         d = json.loads(raw)
         if d.get("v") != WIRE_VERSION:
             raise RouteWireError(f"unknown wire version {d.get('v')!r}")
+        preds = {str(f): tuple(str(e) for e in exprs)
+                 for f, exprs in (d.get("preds") or {}).items()}
         return (str(d["node"]), int(d["epoch"]), int(d["seq"]),
-                [str(f) for f in d["filters"]])
+                [str(f) for f in d["filters"]], preds)
     except RouteWireError:
         raise
     except Exception as exc:
@@ -327,14 +345,18 @@ def decode_delta(payload: bytes
 
 
 class NodeRoutes:
-    """What one direct peer currently advertises."""
+    """What one direct peer currently advertises. ``preds`` holds the
+    ADR-023 content-gating annotations: filter -> predicate exprs for
+    filters whose every holder at the peer requires a predicate."""
 
-    __slots__ = ("epoch", "seq", "filters")
+    __slots__ = ("epoch", "seq", "filters", "preds")
 
-    def __init__(self, epoch: int, seq: int, filters: set[str]) -> None:
+    def __init__(self, epoch: int, seq: int, filters: set[str],
+                 preds: dict[str, tuple[str, ...]] | None = None) -> None:
         self.epoch = epoch
         self.seq = seq
         self.filters = filters
+        self.preds = preds or {}
 
 
 class RouteTable:
@@ -415,7 +437,7 @@ class RouteTable:
     # -- remote side ---------------------------------------------------
 
     def apply_snapshot(self, node: str, epoch: int, seq: int,
-                       filters) -> bool:
+                       filters, preds=None) -> bool:
         """Replace everything known about ``node``. False = stale
         (older epoch, or an older seq within the same epoch — e.g. a
         retained snapshot from before the peer restarted)."""
@@ -434,7 +456,9 @@ class RouteTable:
             add = fresh
         for f in add:
             self._index.subscribe(node, Subscription(filter=f))
-        self.nodes[node] = NodeRoutes(epoch, seq, fresh)
+        kept = ({f: tuple(exprs) for f, exprs in preds.items()
+                 if f in fresh} if preds else None)
+        self.nodes[node] = NodeRoutes(epoch, seq, fresh, kept)
         self._cover_update(node, add, removed)
         return True
 
@@ -450,11 +474,16 @@ class RouteTable:
         for f in remove:
             if f in nr.filters:
                 nr.filters.discard(f)
+                nr.preds.pop(f, None)
                 self._index.unsubscribe(node, f)
                 removed.append(f)
         for f in add:
             if f not in nr.filters:
                 nr.filters.add(f)
+                # deltas never carry annotations (ADR 023): a delta-added
+                # filter is conservatively un-gated until the next
+                # snapshot re-establishes it
+                nr.preds.pop(f, None)
                 self._index.subscribe(node, Subscription(filter=f))
                 added.append(f)
         nr.seq = seq
@@ -483,6 +512,35 @@ class RouteTable:
         result = frozenset(matched.subscriptions)
         self._cache.put(topic, version, result)
         return result
+
+    def pred_gate(self, node: str, topic: str
+                  ) -> tuple[str, ...] | None:
+        """ADR 023 stretch: when EVERY advertised filter of ``node``
+        matching ``topic`` carries a predicate annotation, return the
+        union of those predicate expressions — the forwarder may skip
+        the peer when none passes, because the peer's own content
+        plane would mask every delivery anyway. None = not fully gated
+        (a matching filter with a plain holder, a transitive route, or
+        an annotation-free advertisement): the forward must go."""
+        nr = self.nodes.get(node)
+        if nr is None or not nr.preds:
+            return None
+        tlevels = split_levels(topic)
+        dollar = topic.startswith("$")
+        exprs: list[str] = []
+        matched = False
+        for f in nr.filters:
+            if not filter_matches_topic(split_levels(f), tlevels,
+                                        dollar):
+                continue
+            matched = True
+            fexprs = nr.preds.get(f)
+            if fexprs is None:
+                return None
+            exprs.extend(fexprs)
+        if not matched:
+            return None
+        return tuple(dict.fromkeys(exprs))
 
     @property
     def remote_route_count(self) -> int:

@@ -85,6 +85,12 @@ STORAGE_RESTORE = "storage.restore"    # per-record boot restore parse
 NATIVE_ENCODE = "native.encode"        # C publish-frame head assembly
                                        # (ADR 019; trips fall back to the
                                        # pure-Python encoder)
+FILTER_EVAL = "filter.eval"            # content-plane batch evaluation
+                                       # (ADR 023; trips fail OPEN: the
+                                       # flush delivers unfiltered)
+FILTER_WINDOW = "filter.window"        # aggregate window emission (ADR
+                                       # 023; trips shed that emission,
+                                       # counted in agg_shed)
 
 
 class _Spec:

@@ -1,0 +1,16 @@
+"""MQTT+ content plane (ADR 023).
+
+Payload-predicate subscriptions and windowed aggregation riding the
+batch publish path: ``expr`` compiles ``payload.temp>30`` predicates
+to columnar stack programs, ``columnar`` evaluates all
+(publish x predicate) pairs per pipeline flush (NumPy baseline, jnp
+behind a breaker), ``window`` accumulates tumbling-window aggregates,
+and ``plane`` owns the registry + fan-out mask + emission."""
+
+from .expr import (CompiledPredicate, ExprError, compile_expr,
+                   decode_payload, extract_field)
+from .plane import ContentPlane, ContentQuota, FilterSpec, parse_spec
+
+__all__ = ["CompiledPredicate", "ExprError", "compile_expr",
+           "decode_payload", "extract_field", "ContentPlane",
+           "ContentQuota", "FilterSpec", "parse_spec"]

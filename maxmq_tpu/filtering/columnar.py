@@ -1,0 +1,184 @@
+"""Vectorized predicate evaluation over a publish batch (ADR 023).
+
+One pipeline flush hands the plane N publishes; payloads are decoded
+**once** into a columnar scratch — per loaded field, a float64 value
+column plus a bool validity column over the batch — and every distinct
+compiled predicate then runs its stack program against those columns,
+producing a (predicates x publishes) boolean matrix in a handful of
+array ops. That turns the per-(message, subscriber) Python loop a
+naive broker would run into array arithmetic, the same shape the
+device matcher exploits.
+
+Backends: NumPy is the always-on baseline; ``jnp`` lowers the same
+stack machine onto jax.numpy (XLA; the device path when a TPU owns
+the process, CPU otherwise). The jnp path sits behind a miniature
+ADR-011 breaker — consecutive failures pin NumPy with a timed reprobe
+— because a wedged accelerator must degrade the content plane to the
+host path, never wedge delivery. Comparisons/boolean ops are bandwidth
+-bound elementwise work, so the jnp lowering uses stock jax.numpy
+ops; no bespoke Pallas kernel is warranted at these shapes (see
+docs/adr/023-content-plane.md).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from .expr import CompiledPredicate, extract_field
+
+# (values, valid) column pair per field; a None valid means "scalar
+# constant, always valid" inside the stack machine
+Columns = dict
+
+
+def build_columns(payload_objs: list, fields: tuple[str, ...]) -> Columns:
+    """Decode-once scratch: one (float64 values, bool valid) pair per
+    field over the whole batch."""
+    n = len(payload_objs)
+    cols: Columns = {f: (np.zeros(n, dtype=np.float64),
+                         np.zeros(n, dtype=bool)) for f in fields}
+    for i, obj in enumerate(payload_objs):
+        if obj is None:
+            continue
+        for f in fields:
+            v = extract_field(obj, f)
+            if v is not None:
+                vals, valid = cols[f]
+                vals[i] = v
+                valid[i] = True
+    return cols
+
+
+def _cmp(op: str, a, b, xp):
+    if op == ">":
+        return a > b
+    if op == ">=":
+        return a >= b
+    if op == "<":
+        return a < b
+    if op == "<=":
+        return a <= b
+    if op == "==":
+        return a == b
+    return a != b
+
+
+def _run_program(program, cols: Columns, n: int, xp) -> object:
+    """Stack-machine pass over one program; ``xp`` is numpy or
+    jax.numpy. Stack entries are (values, valid) numeric pairs or bare
+    boolean arrays; the compiler's grammar guarantees well-typedness."""
+    stack: list = []
+    for op in program:
+        kind = op[0]
+        if kind == "load":
+            stack.append(cols[op[1]])
+        elif kind == "const":
+            stack.append((op[1], None))
+        elif kind == "cmp":
+            bvals, bvalid = stack.pop()
+            avals, avalid = stack.pop()
+            mask = _cmp(op[1], avals, bvals, xp)
+            if avalid is not None:
+                mask = mask & avalid
+            if bvalid is not None:
+                mask = mask & bvalid
+            if not hasattr(mask, "shape") or getattr(mask, "shape", ()) == ():
+                # const-vs-const comparison: broadcast to the batch
+                mask = xp.full(n, bool(mask), dtype=bool)
+            stack.append(mask)
+        elif kind == "and":
+            b, a = stack.pop(), stack.pop()
+            stack.append(a & b)
+        elif kind == "or":
+            b, a = stack.pop(), stack.pop()
+            stack.append(a | b)
+        else:               # not
+            stack.append(~stack.pop())
+    return stack[0]
+
+
+def eval_batch_numpy(programs: list, cols: Columns, n: int) -> np.ndarray:
+    """(len(programs), n) boolean matrix, NumPy baseline."""
+    out = np.zeros((len(programs), n), dtype=bool)
+    for row, program in enumerate(programs):
+        out[row] = _run_program(program, cols, n, np)
+    return out
+
+
+def eval_batch_jnp(programs: list, cols: Columns, n: int) -> np.ndarray:
+    """Same matrix via jax.numpy: columns cross to the device once and
+    are shared by every program's pass."""
+    import jax.numpy as jnp
+    jcols = {f: (jnp.asarray(vals), jnp.asarray(valid))
+             for f, (vals, valid) in cols.items()}
+    rows = [_run_program(p, jcols, n, jnp) for p in programs]
+    if not rows:
+        return np.zeros((0, n), dtype=bool)
+    return np.asarray(jnp.stack(rows))
+
+
+def eval_reference_batch(predicates: list[CompiledPredicate],
+                         payload_objs: list) -> np.ndarray:
+    """The naive per-(message, predicate) Python loop — the bench
+    baseline and the differential-test oracle."""
+    out = np.zeros((len(predicates), len(payload_objs)), dtype=bool)
+    for row, pred in enumerate(predicates):
+        for i, obj in enumerate(payload_objs):
+            out[row, i] = pred.eval_reference(obj)
+    return out
+
+
+class ColumnarEvaluator:
+    """Backend selector + breaker for the vectorized evaluator.
+
+    ``backend``: ``numpy`` pins the baseline; ``jnp`` requests the
+    jax.numpy path; ``auto`` takes jnp when jax imports. A jnp batch
+    that raises falls back to NumPy for that batch (counted in
+    ``device_fallbacks``); after ``fail_limit`` consecutive failures
+    NumPy is pinned for ``pin_s`` seconds before one reprobe — the
+    content-plane rung of the ADR-011 ladder.
+    """
+
+    def __init__(self, backend: str = "numpy", fail_limit: int = 3,
+                 pin_s: float = 30.0) -> None:
+        self.backend = backend
+        self.fail_limit = max(int(fail_limit), 1)
+        self.pin_s = float(pin_s)
+        self.device_fallbacks = 0
+        self._fails = 0
+        self._pinned_until = 0.0
+        self._jnp_ok: bool | None = None   # lazy import probe
+
+    def _want_jnp(self) -> bool:
+        if self.backend == "numpy":
+            return False
+        if self._jnp_ok is None:
+            try:
+                import jax.numpy  # noqa: F401
+                self._jnp_ok = True
+            except Exception:
+                self._jnp_ok = False
+                if self.backend == "jnp":
+                    # requested explicitly but unavailable: count the
+                    # degrade once so operators can see it
+                    self.device_fallbacks += 1
+        if not self._jnp_ok:
+            return False
+        return time.monotonic() >= self._pinned_until
+
+    def eval_batch(self, programs: list, cols: Columns,
+                   n: int) -> np.ndarray:
+        if self._want_jnp():
+            try:
+                out = eval_batch_jnp(programs, cols, n)
+                self._fails = 0
+                return out
+            except Exception:
+                self.device_fallbacks += 1
+                self._fails += 1
+                if self._fails >= self.fail_limit:
+                    self._pinned_until = time.monotonic() + self.pin_s
+                    self._fails = 0
+        return eval_batch_numpy(programs, cols, n)

@@ -412,6 +412,8 @@ def register_broker_metrics(registry: Registry, broker) -> None:
     _register_trace_metrics(registry, broker)
     # zero-copy fan-out (ADR 019)
     _register_fanout_metrics(registry, broker)
+    # MQTT+ content plane (ADR 023)
+    _register_filter_metrics(registry, broker)
 
 
 # stage-error label cardinality bound: stages are a fixed set and
@@ -596,7 +598,11 @@ def _register_cluster_metrics(registry: Registry, broker) -> None:
             ("fwd_parked_rehomed",
              "Parked forwards re-routed off a dead owner's link after "
              "a takeover moved the subscription (ADR 022, closes the "
-             "ADR-021 dead-owner blackhole)")):
+             "ADR-021 dead-owner blackhole)"),
+            ("content_route_skips",
+             "Forwards skipped because the peer's every matching "
+             "route carried ADR-023 predicate annotations none of "
+             "which passed the payload")):
         registry.counter_func(f"maxmq_cluster_{name}_total", help_,
                               lambda n=name: getattr(mgr, n))
     registry.gauge_func(
@@ -916,6 +922,50 @@ def _register_fanout_metrics(registry: Registry, broker) -> None:
             registry.counter_func(
                 f"maxmq_broker_fanout_flush_{name}_total", help_,
                 lambda n=name: getattr(sched, n))
+
+
+def _register_filter_metrics(registry: Registry, broker) -> None:
+    """ADR-023 content plane: predicate-subscription registry size,
+    batch-evaluation throughput, the delivery mask's effect, windowed
+    aggregation output/shedding, and the device-path fallback ladder
+    — the terms the mqttplus bench config divides by."""
+    cp = getattr(broker, "content", None)
+    if cp is None:
+        return
+    registry.gauge_func(
+        "maxmq_filter_subscriptions",
+        "Content subscriptions currently registered (predicate "
+        "and/or aggregate)", lambda: len(cp.subs))
+    registry.gauge_func(
+        "maxmq_filter_predicates",
+        "Distinct compiled predicate programs in the registry",
+        lambda: cp.n_predicates)
+    registry.gauge_func(
+        "maxmq_filter_windows",
+        "Tumbling aggregation windows currently holding state",
+        lambda: cp.n_windows)
+    for name, help_ in (
+            ("batches", "Pipeline flushes the content plane "
+             "evaluated (one vectorized pass each)"),
+            ("evals", "Predicate x message pairs evaluated "
+             "vectorized (the per-message reference loop would "
+             "run this many scalar programs)"),
+            ("masked", "Deliveries suppressed because the "
+             "subscriber's every matching content predicate "
+             "evaluated false"),
+            ("eval_errors", "Batch evaluations that failed and "
+             "failed OPEN (unfiltered delivery preserved)"),
+            ("agg_emitted", "Synthesized aggregate publishes "
+             "emitted at window close"),
+            ("agg_shed", "Window-close emissions shed under "
+             "overload or the filter.window fault"),
+            ("rejected_subscribes", "SUBSCRIBE filters rejected for "
+             "malformed/over-quota content options"),
+            ("device_fallbacks", "Vectorized batches that fell back "
+             "from the device backend to NumPy (ADR-011-style "
+             "breaker ladder)")):
+        registry.counter_func(f"maxmq_filter_{name}_total", help_,
+                              lambda n=name: getattr(cp, n))
 
 
 def _register_matcher_metrics(registry: Registry, broker) -> None:

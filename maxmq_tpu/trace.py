@@ -35,6 +35,13 @@ Stage model (see docs/adr/015-publish-tracing.md for the contract):
 ``release``        QoS2 release leg, PUBREC sent -> PUBREL received
                    (ADR 017; histogram-only like takeover — it waits
                    on the publisher's network round trip)
+``filter``         content-plane batch evaluation: payload decode +
+                   columnar predicate matrix + mask stamping (ADR
+                   023; histogram-only, fed per pipeline flush — one
+                   observation covers every publish in the batch)
+``aggregate``      windowed-aggregate close + synthesized emission
+                   (ADR 023; histogram-only like journal_commit — a
+                   housekeeping-tick span, not a publish-path one)
 
 Cross-node model (ADR 017): a node receiving a forwarded publish whose
 envelope carries trace context **adopts** the origin's trace — same
@@ -70,12 +77,13 @@ from .metrics import Histogram
 # are not tied to one publish's critical path; bridge_in is critical
 # only on ADOPTED traces, where it IS the path's first local segment)
 STAGES = ("decode", "admission", "match_queue", "match_device",
-          "pipeline_wait", "fanout", "bridge", "bridge_in",
+          "pipeline_wait", "filter", "fanout", "bridge", "bridge_in",
           "journal_commit", "barrier", "ack", "drain", "takeover",
-          "release")
+          "release", "aggregate")
 CRITICAL_STAGES = frozenset(
     s for s in STAGES
-    if s not in ("drain", "journal_commit", "takeover", "release"))
+    if s not in ("drain", "journal_commit", "takeover", "release",
+                 "aggregate"))
 
 MAX_DRAIN_SPANS = 8     # per-trace cap on recorded subscriber drains
 SLOWEST_KEEP = 8        # slowest-ever publishes kept beside the ring

@@ -153,6 +153,30 @@ class Config:
     # backlog queued; off restores the per-enqueue direct wake
     broker_flush_coalesce: bool = True
 
+    # -- MQTT+ content plane (ADR 023) ----------------------------------------
+    # parse ?$expr=/?$agg= subscription options and run the vectorized
+    # payload-predicate / windowed-aggregation plane on the publish
+    # batch path; off leaves '?' a plain topic character end to end
+    filter_enabled: bool = True
+    filter_backend: str = "numpy"       # numpy | jnp | auto (jnp rides
+                                        # the device with a breaker
+                                        # fallback to numpy, ADR 011)
+    filter_max_subscriptions: int = 10000  # content subs per broker
+    filter_max_expr_len: int = 512      # $expr source-length bound
+    filter_max_fields: int = 64         # distinct decoded payload fields
+    filter_batch_max: int = 256         # pipeline publishes per eval flush
+    filter_window_min_s: float = 0.5    # accepted $win range, seconds
+    filter_window_max_s: float = 3600.0
+    # stretch (off by default): annotate route advertisements with the
+    # predicates of fully-gated filters so a bridge peer skips forwards
+    # no remote predicate can pass — counted, correctness-preserving
+    cluster_content_routes: bool = False
+
+    # -- event loop (ADR 023 satellite) ---------------------------------------
+    # auto = uvloop when installed, else asyncio; uvloop warns + falls
+    # back cleanly when the package is missing
+    broker_event_loop: str = "auto"     # auto | asyncio | uvloop
+
     # -- persistence --------------------------------------------------------
     storage_backend: str = ""           # "" | memory | sqlite
     storage_path: str = "maxmq.db"

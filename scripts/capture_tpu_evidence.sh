@@ -79,4 +79,11 @@ run_step "cshard workers=1/2/4 scaling" 900 \
     env MAXMQ_BENCH_CONFIGS=cshard JAX_PLATFORMS=cpu python bench.py \
     2>/tmp/cap_cshard.err
 
+# ADR-023 content plane: the vectorized predicate evaluator on the
+# device backend (jnp path + its NumPy fallback ladder) vs the
+# per-message reference — the filtering speedup row
+run_step "filtering predicate-eval device A/B" 900 \
+    env MAXMQ_BENCH_CONFIGS=mqttplus MAXMQ_FILTER_BACKEND=jnp \
+    python bench.py 2>/tmp/cap_mqttplus.err
+
 tail -c 2000 "$OUT"

@@ -229,6 +229,19 @@ def self_test() -> str:
     tracer.attach_remote({"i": tr.id, "n": "nodeB", "h": 2,
                           "e2e_us": 1200,
                           "spans": [["bridge_in", 1, 3]]})
+    # ADR 023: a content subscription + one vectorized flush so the
+    # maxmq_filter_* families have non-trivial series
+    cp = broker.content
+    cp.register("filter-client", "sensors/+",
+                cp.parse_spec("$expr=payload.temp>30"))
+    cp.register("filter-client", "agg/t",
+                cp.parse_spec("$agg=avg&$win=5s&$field=payload.temp"))
+
+    class _FilterPkt:
+        topic = "sensors/a"
+        payload = b'{"temp": 42}'
+
+    cp.apply(((_FilterPkt(), None), (_FilterPkt(), None)))
     # a hostile client id must survive the offender-label escaping
     hostile = broker.new_inline_client('bad"id\\with\nnewline')
     hostile.dropped_msgs = 3
