@@ -2031,6 +2031,54 @@ def bench_geoday(scale: float = 1.0) -> dict:
     return d
 
 
+def bench_crashday(scale: float = 1.0) -> dict:
+    """ADR-024 kill-point crash day (MAXMQ_BENCH_CONFIGS=crashday):
+    harness/crashday.py SIGKILLs a real subprocess broker at named
+    instants in the commit pipeline (pre-fsync, post-fsync-pre-ack,
+    mid-WAL-write, mid-restore-parse), reboots it onto the same store,
+    and machine-checks the durability contract — storage_sync=always
+    means ZERO PUBACKed loss across every sampled kill, QoS2 never
+    duplicates, torn WAL tails + hand-torn records quarantine exactly
+    and still boot to serving, ENOSPC/fsync failures degrade (breaker,
+    shed rung, poisoned-connection reopen) instead of wedging. The
+    batched policy rides along at reduced kill count so its measured
+    loss-vs-window numbers land in the same row. bench_compare gates
+    pubacked_loss / qos2_duplicates / recovery p99 / violation_count."""
+    import asyncio
+
+    from harness.crashday import CrashDay
+
+    kills = max(8, int(20 * scale))
+    d = asyncio.run(CrashDay(policy="always", kills=kills).run())
+    log(f"[crashday] always pass={d['pass']} "
+        f"loss={d['pubacked_loss']}/{d['acked_total']} "
+        f"dups={d['qos2_duplicates']} "
+        f"kills={d['kill_points']} "
+        f"recovery-p99={d.get('recovery_p99_ms')}ms "
+        f"violations={d['violations']}")
+    b = asyncio.run(CrashDay(policy="batched",
+                             kills=max(6, kills // 2),
+                             seed=20241).run())
+    log(f"[crashday] batched pass={b['pass']} "
+        f"lost={b['pubacked_loss']} "
+        f"bounds={b.get('batched_loss_bounds')} "
+        f"violations={b['violations']}")
+    # nest the batched day as numeric leaves of the SAME row; the raw
+    # lost-message count is informational (losing 0..window acked
+    # messages is the CONTRACT, not a regression), so it rides under a
+    # name the *loss* gate pattern does not match — violation_count
+    # (window exceeded ⇒ violation) is the gated twin
+    d["batched"] = {
+        "lost_msgs": b["pubacked_loss"],
+        "window_bound_max": max(
+            list(b.get("batched_loss_bounds", {}).values()) or [0.0]),
+        "qos2_duplicates": b["qos2_duplicates"],
+        "violation_count": b["violation_count"],
+        "recovery_p99_ms": b.get("recovery_p99_ms", 0.0),
+    }
+    return d
+
+
 def bench_cshard(storm: int = 200, msgs: int = 300,
                  pairs: int = 4) -> dict:
     """ADR-021 in-box cluster scaling (MAXMQ_BENCH_CONFIGS=cshard):
@@ -3051,6 +3099,12 @@ def main() -> None:
         # RTT with asymmetric bandwidth + loss, scored for zero loss,
         # zero false flaps, RTT-relative heal/takeover bounds
         runs.append(("geoday", lambda: bench_geoday(scale=scale)))
+    if "crashday" in which:
+        # ADR-024 kill-point crash day: subprocess brokers SIGKILLed
+        # at named commit-pipeline instants, durability windows
+        # machine-checked (always=0 loss, batched bounded, QoS2 no
+        # dups, torn-tail quarantine exact, ENOSPC/fsync degrade)
+        runs.append(("crashday", lambda: bench_crashday(scale=scale)))
     if "cshard" in which:
         # ADR-021 in-box cluster: subprocess worker pool on one
         # SO_REUSEPORT port — accept rate + aggregate QoS0/QoS1
@@ -3160,7 +3214,8 @@ CONFIG_DEADLINES = {"1": 900, "2": 900, "3": 1200, "4": 2400,
                     "widthab": 1200, "degraded": 1200, "overload": 900,
                     "cluster": 900, "durable": 900, "failover": 900,
                     "fanout": 900, "macroday": 900, "cshard": 900,
-                    "geoday": 900, "mqttplus": 900, "churn": 1200}
+                    "geoday": 900, "mqttplus": 900, "churn": 1200,
+                    "crashday": 900}
 
 
 def run_supervised(which: list[str]) -> None:

@@ -227,6 +227,7 @@ def build_storage(conf: Config) -> "StorageHook | None":
     and QoS acks can ride the durability barrier under ``always``."""
     if not conf.storage_backend:
         return None
+    from .hooks.faultstore import FaultInjectingStore
     from .hooks.journal import SQLITE_SYNC_BY_POLICY, WriteBehindStore
     policy = conf.storage_sync
     if policy not in SQLITE_SYNC_BY_POLICY:
@@ -237,6 +238,10 @@ def build_storage(conf: Config) -> "StorageHook | None":
     else:
         inner = SQLiteStore(conf.storage_path,
                             synchronous=SQLITE_SYNC_BY_POLICY[policy])
+    # the disk.* fault shim (ADR 024) wraps unconditionally: every site
+    # is consulted off the event loop and the unarmed fast path is one
+    # empty-dict membership test per commit
+    inner = FaultInjectingStore(inner)
     store = WriteBehindStore(
         inner, policy=policy,
         batch_ms=conf.storage_batch_ms,

@@ -71,6 +71,8 @@ class OverloadState:
         self.connects_refused = 0   # token-bucket socket refusals
         self.half_open_refused = 0  # half-open-handshake cap refusals
         self.stalled_disconnects = 0
+        self.disk_full_sheds = 0    # QoS0-irrelevant storage rewrites
+                                    # shed by the ENOSPC rung (ADR 024)
         # -- zero-copy fan-out ledger (ADR 019) ------------------------
         # one publish should cost one encode: template_sends counts
         # deliveries assembled from a shared template (wire0 cache hits

@@ -1948,6 +1948,10 @@ class Broker:
                         continue
                     sub.filter = filt   # index/cluster/session all see
                     #                     the base filter from here on
+                    # ADR 023/024: the storage hook persists the raw
+                    # option string with the subscription record so
+                    # the spec survives restart + session restore
+                    sub.content_options = options
             if not valid_filter(filt,
                                 shared_allowed=caps.shared_sub_available,
                                 wildcards_allowed=caps.wildcard_sub_available):
@@ -2665,6 +2669,23 @@ class Broker:
             client = self.clients.get(rec.client_id)
             if client is not None:
                 client.subscriptions[rec.filter] = sub
+            options = getattr(rec, "options", "")
+            if options and self.content is not None:
+                # ADR 023/024: re-register the persisted content spec;
+                # a spec this build can't parse (downgrade, tightened
+                # caps) degrades THIS subscription to unfiltered,
+                # loudly, instead of failing the restore
+                try:
+                    self.content.register(rec.client_id, rec.filter,
+                                          self.content.parse_spec(options))
+                except Exception as exc:
+                    self.content.rejected_subscribes += 1
+                    if self.log is not None:
+                        self.log.with_prefix("broker").error(
+                            "restored subscription content spec "
+                            "rejected; subscription is unfiltered",
+                            client=rec.client_id, filter=rec.filter,
+                            error=repr(exc)[:200])
 
     def _bump_boot_epoch(self) -> None:
         """Persisted monotonic boot epoch (ADR 014): strictly increases

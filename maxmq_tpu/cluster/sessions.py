@@ -957,6 +957,10 @@ class SessionFederation(Hook):
         """Drain pending session updates + inflight ops onto the wire
         (one debounced pass per loop turn; the ack-coupled barrier
         flushes eagerly so its target seq is known)."""
+        # ADR 024 crash point: replication accepted and debounced but
+        # not yet on the wire — a node dying here is the widest
+        # replica-lag window a single loop turn can leave
+        faults.crash_point("replica_flush")
         self._flush_scheduled = False
         if self._dirty_cids:
             for cid in list(self._dirty_cids):

@@ -32,17 +32,22 @@ workers arm faults they can't reach by reference::
 
     MAXMQ_FAULTS="device.match:raise:3,device.match:hang:1:0.5"
 
-parses as ``site:mode[:count[:delay_s]]``, comma-separated, applied in
-order (later entries queue behind earlier ones for the same site).
-Because each subprocess re-parses the env at import, the pool parent
-delivers ``pool.worker`` entries to exactly ONE initial worker spawn
-and strips them everywhere else (broker/workers.py) — a worker-kill
-drill means one death, not a pool-wide crash loop.
+parses as ``site:mode[:count[:delay_s[:skip]]]``, comma-separated,
+applied in order (later entries queue behind earlier ones for the same
+site). ``skip`` lets an env-armed fault pass its first N hits before
+firing — the crash-day harness (ADR 024) needs "SIGKILL at the 7th
+group commit", and the first commits happen at boot (boot_epoch
+flush), long before the traffic under test. Because each subprocess
+re-parses the env at import, the pool parent delivers ``pool.worker``
+entries to exactly ONE initial worker spawn and strips them everywhere
+else (broker/workers.py) — a worker-kill drill means one death, not a
+pool-wide crash loop.
 """
 
 from __future__ import annotations
 
 import os
+import signal
 import threading
 import time
 import zlib
@@ -91,15 +96,52 @@ FILTER_EVAL = "filter.eval"            # content-plane batch evaluation
 FILTER_WINDOW = "filter.window"        # aggregate window emission (ADR
                                        # 023; trips shed that emission,
                                        # counted in agg_shed)
+DISK_WRITE = "disk.write"              # backend write/commit path: an
+                                       # armed trip surfaces as EIO from
+                                       # the store (ADR 024)
+DISK_ENOSPC = "disk.enospc"            # backend commit: disk full
+                                       # (ENOSPC) from the store
+DISK_FSYNC = "disk.fsync"              # backend commit: write landed,
+                                       # fsync FAILED — dirty-page state
+                                       # unknown (fsyncgate; the journal
+                                       # must poison + reopen + replay)
+DISK_LATENCY = "disk.latency"          # backend commit latency (hang
+                                       # mode sleeps the WRITER thread)
+CRASH_AT = "crash.at"                  # named kill points (ADR 024);
+                                       # keyed per point: crash.at#<p>
+                                       # mode "kill" SIGKILLs the
+                                       # PROCESS — subprocess drills only
+
+# The crash-point registry (ADR 024): every named point a subprocess
+# broker can be told to SIGKILL itself at, placed at the exact commit-
+# pipeline instants whose before/after durability semantics differ.
+# Armed via MAXMQ_FAULTS, e.g. "crash.at#pre_fsync:kill:1:0:6" = die at
+# the 7th commit attempt (skip 6).
+CRASH_POINTS = (
+    "pre_fsync",            # journal writer: batch taken, backend NOT
+                            # yet committed (acked-under-`batched` data
+                            # in this window is the documented loss)
+    "post_fsync_pre_ack",   # journal writer: backend committed, ack
+                            # barriers NOT yet released (`always` must
+                            # redeliver, never lose)
+    "mid_wal_write",        # SQLite apply_batch: half the batch's ops
+                            # executed, transaction open (the WAL tears)
+    "restore_parse",        # boot restore: mid-bucket parse (a crash
+                            # DURING recovery must not corrupt anew)
+    "replica_flush",        # cluster/sessions.py: replication drain
+                            # scheduled but not yet on the wire
+)
 
 
 class _Spec:
-    __slots__ = ("mode", "remaining", "delay_s")
+    __slots__ = ("mode", "remaining", "delay_s", "skip")
 
-    def __init__(self, mode: str, remaining: int, delay_s: float) -> None:
+    def __init__(self, mode: str, remaining: int, delay_s: float,
+                 skip: int = 0) -> None:
         self.mode = mode
         self.remaining = remaining
         self.delay_s = delay_s
+        self.skip = skip
 
 
 class ShapeSpec:
@@ -210,6 +252,10 @@ class ShapeSpec:
         return (self.delay_ns + self.jitter_ns / 2) / 1e9
 
 
+def _sigkill_self() -> None:
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
 class FaultRegistry:
     """Thread-safe armed-fault table. One global instance (``REGISTRY``)
     serves the whole process; tests that want isolation construct their
@@ -229,6 +275,10 @@ class FaultRegistry:
         # test can install a scripted clock and get deterministic
         # spans; restore with reset_clock()
         self.clock_ns = time.monotonic_ns
+        # swappable kill action (ADR 024): crash_point() delivers the
+        # SIGKILL through this indirection so an in-process test can
+        # observe the trip without dying with the subprocess drills
+        self.kill_fn = _sigkill_self
 
     def reset_clock(self) -> None:
         self.clock_ns = time.monotonic_ns
@@ -236,12 +286,12 @@ class FaultRegistry:
     # -- arming --------------------------------------------------------
 
     def arm(self, site: str, mode: str = "raise", count: int = 1,
-            delay_s: float = 0.05) -> None:
+            delay_s: float = 0.05, skip: int = 0) -> None:
         if count == 0:
             return
         with self._lock:
             self._specs.setdefault(site, []).append(
-                _Spec(mode, count, delay_s))
+                _Spec(mode, count, delay_s, max(int(skip), 0)))
 
     def disarm(self, site: str) -> None:
         with self._lock:
@@ -295,12 +345,13 @@ class FaultRegistry:
                 continue
             parts = entry.split(":")
             if len(parts) < 2:
-                raise ValueError(f"bad fault spec {entry!r} "
-                                 "(want site:mode[:count[:delay_s]])")
+                raise ValueError(f"bad fault spec {entry!r} (want "
+                                 "site:mode[:count[:delay_s[:skip]]])")
             site, mode = parts[0], parts[1]
             count = int(parts[2]) if len(parts) > 2 else 1
             delay = float(parts[3]) if len(parts) > 3 else 0.05
-            self.arm(site, mode, count, delay)
+            skip = int(parts[4]) if len(parts) > 4 else 0
+            self.arm(site, mode, count, delay, skip)
 
     # -- firing (the production-code side) -----------------------------
 
@@ -313,6 +364,12 @@ class FaultRegistry:
             if not queue:
                 return None
             spec = queue[0]
+            if spec.skip > 0:
+                # a pass-through hit: the site proceeds untouched and
+                # the spec moves one step closer to firing (uncounted —
+                # `fired` records trips, not near-misses)
+                spec.skip -= 1
+                return None
             if spec.remaining > 0:
                 spec.remaining -= 1
                 if spec.remaining == 0:
@@ -461,6 +518,34 @@ def unshape(a: str, b: str) -> None:
         REGISTRY.del_shape(partition_key(src, dst))
 
 
+# ----------------------------------------------------------------------
+# Crash points (ADR 024): the ``crash.at`` site family
+# ----------------------------------------------------------------------
+#
+# A crash point is a named instant in the commit pipeline (CRASH_POINTS
+# above) where a broker told to die, dies NOW — SIGKILL to self, no
+# atexit, no flush, exactly what a power cut at that instant leaves
+# behind. The production code calls ``crash_point("<name>")`` at each
+# site; the cost when nothing is armed is the usual one-dict-membership
+# fast path. Arming rides MAXMQ_FAULTS with the keyed-site convention
+# (``crash.at#pre_fsync:kill:1:0:<skip>``) so the crash-day harness's
+# subprocess brokers inherit their death sentence through env.
+#
+# Mode ``kill`` (or ``raise``/anything — a crash point only crashes)
+# fires the registry's ``kill_fn``; tests that must observe the trip
+# in-process swap ``REGISTRY.kill_fn`` first.
+
+
+def crash_point(point: str) -> None:
+    """Die here if this named crash point is armed (ADR 024)."""
+    site = f"{CRASH_AT}#{point}"
+    if site not in REGISTRY._specs:     # racy-but-safe fast path
+        return
+    spec = REGISTRY._take(site)
+    if spec is not None:
+        REGISTRY.kill_fn()
+
+
 # module-level conveniences bound to the process registry
 arm = REGISTRY.arm
 disarm = REGISTRY.disarm
@@ -472,6 +557,7 @@ fire_detail = REGISTRY.fire_detail
 arm_from_spec = REGISTRY.arm_from_spec
 get_shape = REGISTRY.get_shape
 any_shaped = REGISTRY.any_shaped
+fired = REGISTRY.fired
 
 # env arming: subprocess pool workers and bench's degraded-mode runs
 # inherit MAXMQ_FAULTS through their environment

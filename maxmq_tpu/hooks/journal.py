@@ -41,14 +41,36 @@ QoS1-relevant ops are never discarded here — above the watermark the
 StorageHook sheds QoS0-irrelevant rewrites (hooks/storage.py) and
 ``overflows`` counts what still lands past it.
 
+Disk-failure classes (ADR 024) get their own ladder rungs on top of
+the generic breaker:
+
+* **fsync failure poisons the connection** (fsyncgate): after a failed
+  flush the backend's dirty-page state is unknown — retrying the
+  commit on the same handle could "succeed" against pages the kernel
+  already dropped. The journal marks the backend poisoned, trips the
+  breaker immediately, and the half-open reprobe REOPENS the backend
+  before replaying the parked journal (replay is idempotent same-key
+  upserts, so anything that did reach the platter commits again,
+  harmlessly).
+* **ENOSPC is not transient**: a full volume won't heal by politely
+  retrying the same batch, so the breaker trips on the FIRST ENOSPC
+  (no threshold wait), ``disk_full`` raises the QoS0-irrelevant
+  rewrite shed rung in hooks/storage.py regardless of broker load,
+  and barriers release degraded (ADR-011 availability over
+  durability) until a commit succeeds again.
+
 Fault sites (faults.py): ``storage.put`` at the enqueue boundary,
 ``storage.commit`` in the writer thread (hang mode sleeps the WRITER,
 never the loop — which is the point), ``storage.restore`` in the
-hook's per-record restore parse.
+hook's per-record restore parse, plus the backend-level ``disk.*``
+family via hooks/faultstore.py. Crash points (ADR 024):
+``crash.at#pre_fsync`` / ``crash.at#post_fsync_pre_ack`` bracket the
+group commit — the two instants whose durability semantics differ.
 """
 
 from __future__ import annotations
 
+import errno
 import heapq
 import itertools
 import logging
@@ -57,6 +79,7 @@ import time
 from collections import deque
 
 from .. import faults
+from .faultstore import FsyncFailed
 from .storage import Store
 
 _OP_PUT = "put"
@@ -75,6 +98,25 @@ BREAKER_HALF_OPEN = 2
 SQLITE_SYNC_BY_POLICY = {"always": "FULL", "batched": "FULL", "off": "OFF"}
 
 POLICIES = ("always", "batched", "off")
+
+
+def classify_commit_failure(exc: Exception) -> str:
+    """Sort a commit failure into its ladder rung (ADR 024):
+    ``"fsync"`` (poison + reopen), ``"enospc"`` (immediate breaker +
+    disk-full shed), or ``"other"`` (the generic consecutive-failure
+    breaker). Recognizes both the injected ``disk.*`` shapes and what
+    the real backends raise — sqlite3 reports a full volume as
+    OperationalError("database or disk is full")."""
+    if isinstance(exc, FsyncFailed):
+        return "fsync"
+    if isinstance(exc, OSError) and exc.errno == errno.ENOSPC:
+        return "enospc"
+    msg = str(exc).lower()
+    if "disk is full" in msg or "no space left" in msg:
+        return "enospc"
+    if "fsync" in msg:
+        return "fsync"
+    return "other"
 
 
 class _Op:
@@ -153,6 +195,17 @@ class WriteBehindStore(Store):
         self.commit_seconds_total = 0.0
         self.dirty = False              # a write was lost or parked past
                                         # its durability promise
+
+        # -- disk-failure ladder rungs (ADR 024) -----------------------
+        self.fsync_failures = 0         # commits whose flush failed
+        self.enospc_failures = 0        # commits refused by a full disk
+        self.backend_reopens = 0        # poisoned connections reopened
+        self.disk_full = False          # last failure was ENOSPC and no
+                                        # commit has succeeded since —
+                                        # raises the storage hook's
+                                        # rewrite-shed rung unconditionally
+        self._poisoned = False          # fsync failed: the backend must
+                                        # be reopened before any retry
 
         # -- breaker ---------------------------------------------------
         self.breaker_state = BREAKER_CLOSED
@@ -423,8 +476,15 @@ class WriteBehindStore(Store):
         t0 = time.perf_counter()
         try:
             faults.fire(faults.STORAGE_COMMIT)
+            if self._poisoned:
+                # fsyncgate discipline (ADR 024): never retry on the
+                # handle whose flush failed — reopen first, then the
+                # parked journal replays through the fresh connection
+                self._reopen_poisoned()
+            faults.crash_point("pre_fsync")
             self.inner.apply_batch(
                 [(op.kind, op.bucket, op.key, op.value) for op in batch])
+            faults.crash_point("post_fsync_pre_ack")
         except Exception as exc:
             self._commit_failed(batch, exc)
             return
@@ -456,10 +516,29 @@ class WriteBehindStore(Store):
                 self._degraded_seconds += time.monotonic() - self._degraded_since
                 self._cur_backoff = self.backoff_s
             self._consecutive_failures = 0
+            if self.disk_full:
+                self.disk_full = False      # space came back; rung down
+                self.log.warning("storage disk-full condition cleared "
+                                 "(commit succeeded)")
+
+    def _reopen_poisoned(self) -> None:
+        """Swap the poisoned backend connection for a fresh one (ADR
+        024). Raises on failure — the caller's commit then fails and
+        the breaker/backoff machinery owns the retry cadence. A backend
+        without ``reopen`` (bare MemoryStore in tests) just clears the
+        poison: it has no kernel page cache to distrust."""
+        reopen = getattr(self.inner, "reopen", None)
+        if reopen is not None:
+            reopen()
+            self.backend_reopens += 1
+        self._poisoned = False
+        self.log.warning("storage backend reopened after fsync failure; "
+                         "replaying %d parked ops", self.queue_depth)
 
     def _commit_failed(self, batch: list[_Op], exc: Exception) -> None:
         if self.tracer is not None:
             self.tracer.note_error("journal_commit", "commit_failed")
+        failure_class = classify_commit_failure(exc)
         with self._lock:
             # park the batch back at the FRONT, preserving op order; a
             # same-key write enqueued while the commit ran owns
@@ -472,7 +551,18 @@ class WriteBehindStore(Store):
             self.commit_failures += 1
             self._consecutive_failures += 1
             self.dirty = True
+            if failure_class == "fsync":
+                # fsyncgate: the handle is now untrustworthy — poison
+                # it and trip immediately; the reprobe reopens first
+                self.fsync_failures += 1
+                self._poisoned = True
+            elif failure_class == "enospc":
+                # a full disk is a state, not a blip: no point burning
+                # threshold-many retries against it
+                self.enospc_failures += 1
+                self.disk_full = True
             tripped = (self.breaker_state == BREAKER_HALF_OPEN
+                       or failure_class in ("fsync", "enospc")
                        or self._consecutive_failures >= self.breaker_threshold)
             if tripped:
                 if self.breaker_state == BREAKER_CLOSED:
@@ -485,5 +575,5 @@ class WriteBehindStore(Store):
                 # a barrier must never outlive the durability it was
                 # promised: release them all, loudly, and stay dirty
                 self._resolve_barriers_locked(None, degraded=True)
-        self.log.error("storage commit failed (%d consecutive): %r",
-                       self._consecutive_failures, exc)
+        self.log.error("storage commit failed (%s, %d consecutive): %r",
+                       failure_class, self._consecutive_failures, exc)

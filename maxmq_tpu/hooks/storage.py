@@ -77,6 +77,11 @@ class SubscriptionRecord:
     retain_as_published: bool = False
     retain_handling: int = 0
     identifier: int = 0
+    # ADR 023/024: the raw content-filter option string ("$expr=...&
+    # $agg=..."), empty for plain subscriptions — persisted so restore
+    # can re-register the spec with the content plane instead of
+    # silently downgrading a survivor to an unfiltered subscription
+    options: str = ""
 
     def to_json(self) -> str:
         return json.dumps(asdict(self))
@@ -196,6 +201,10 @@ class StorageHook(Hook):
     def _restore_bucket(self, bucket: str, parse) -> list:
         out = []
         for key, raw in self.store.all(bucket).items():
+            # ADR 024: a crash DURING recovery must leave a store the
+            # NEXT boot restores from — the kill-point drill dies here
+            # mid-bucket and reboots onto the same file
+            faults.crash_point("restore_parse")
             try:
                 faults.fire(faults.STORAGE_RESTORE)
                 out.append(parse(raw))
@@ -258,9 +267,20 @@ class StorageHook(Hook):
     def _shed_rewrite(self, client) -> bool:
         """True when a QoS0-irrelevant rewrite should be dropped: the
         broker is load-shedding (ADR 012) AND the journal sits past its
-        byte watermark — storms must not grow the journal unbounded."""
+        byte watermark — storms must not grow the journal unbounded.
+        A full disk (ADR 024 ENOSPC rung) sheds unconditionally: every
+        parked byte already has nowhere to go, so QoS0-irrelevant
+        rewrites are the first thing off the ladder."""
         j = self.journal
-        if j is None or not j.over_watermark:
+        if j is None:
+            return False
+        if getattr(j, "disk_full", False):
+            over = getattr(getattr(client, "server", None),
+                           "overload", None)
+            if over is not None:
+                over.disk_full_sheds += 1
+            return True
+        if not j.over_watermark:
             return False
         over = getattr(getattr(client, "server", None), "overload", None)
         return bool(over is not None and over.shedding)
@@ -302,7 +322,12 @@ class StorageHook(Hook):
                 client_id=client.id, filter=sub.filter, qos=sub.qos,
                 no_local=sub.no_local,
                 retain_as_published=sub.retain_as_published,
-                retain_handling=sub.retain_handling, identifier=sub.identifier)
+                retain_handling=sub.retain_handling, identifier=sub.identifier,
+                # ADR 023/024: the subscribe path stashes the parsed-OK
+                # content options on the Subscription; a plain
+                # (re-)subscribe stores "" and so clears any earlier
+                # persisted spec (resubscribe-replaces semantics)
+                options=getattr(sub, "content_options", "") or "")
             self.store.put("subscriptions", f"{client.id}|{sub.filter}",
                            rec.to_json())
 
@@ -435,6 +460,7 @@ class SQLiteStore(Store):
                  busy_timeout_ms: int = 5000, logger=None) -> None:
         self.path = path
         self.corruptions = 0
+        self.aside_failures = 0         # forensic move-asides that failed
         self._synchronous = synchronous
         self._busy_timeout_ms = busy_timeout_ms
         self.log = logger or _log
@@ -486,16 +512,48 @@ class SQLiteStore(Store):
             n += 1
         aside = f"{path}.corrupt-{n}"
         for suffix in ("", "-wal", "-shm"):
+            src = path + suffix
             try:
-                if os.path.exists(path + suffix):
-                    os.replace(path + suffix, aside + suffix)
-            except OSError:
-                pass
+                if os.path.exists(src):
+                    os.replace(src, aside + suffix)
+            except OSError as move_exc:
+                # a failed move-aside loses the forensic copy, never
+                # the boot: count + log it, then REMOVE the damaged
+                # file in place so the recreate below starts fresh
+                # instead of re-opening the same corruption
+                self.aside_failures += 1
+                self.log.error(
+                    "storage move-aside of %s to %s failed (%r); "
+                    "removing the damaged file in place — forensic "
+                    "copy lost", src, aside + suffix, move_exc)
+                try:
+                    os.remove(src)
+                except OSError as rm_exc:
+                    self.log.error(
+                        "storage could not remove damaged file %s "
+                        "either: %r", src, rm_exc)
         self.log.error(
             "storage file %s failed integrity check (%r); moved aside "
             "to %s and recreated EMPTY — persisted sessions/retained/"
             "inflight from it are gone", path, exc, aside)
         return self._open_verified(path)
+
+    def reopen(self) -> None:
+        """Drop the current connection and open a verified fresh one
+        (ADR 024): the journal calls this when a failed fsync poisoned
+        the handle — dirty-page state is unknown, so the only honest
+        move is a new connection plus a full replay of the parked
+        journal. A file the reopen finds corrupt takes the move-aside
+        path like any boot would."""
+        with self._lock:
+            try:
+                self._conn.close()
+            except sqlite3.Error:
+                pass            # a poisoned handle may refuse to close
+            try:
+                self._conn = self._open_verified(self.path)
+            except CorruptStoreError as exc:
+                self._conn = self._recreate_aside(self.path, exc)
 
     def put(self, bucket, key, value):
         with self._lock:
@@ -535,9 +593,15 @@ class SQLiteStore(Store):
         """Group commit (ADR 014): the whole batch is ONE transaction —
         one fsync per batch under synchronous=FULL, and a crash leaves
         either all of it or none of it."""
+        mid = len(ops) // 2
         with self._lock:
             try:
-                for kind, bucket, key, value in ops:
+                for i, (kind, bucket, key, value) in enumerate(ops):
+                    if i == mid:
+                        # ADR 024: die INSIDE the open transaction —
+                        # statements executed, nothing committed; the
+                        # restart must see all-or-nothing
+                        faults.crash_point("mid_wal_write")
                     if kind == "put":
                         self._conn.execute(
                             "INSERT INTO kv (bucket, key, value) "

@@ -783,6 +783,12 @@ def _register_storage_metrics(registry: Registry, broker) -> None:
             "Storage files that failed the open-time integrity check "
             "and were moved aside + recreated",
             lambda: backing.corruptions)
+    if getattr(backing, "aside_failures", None) is not None:
+        registry.counter_func(
+            "maxmq_storage_aside_failures_total",
+            "Corrupt-file move-asides that failed (forensic copy lost; "
+            "the damaged file was removed in place so the recreate "
+            "still booted)", lambda: backing.aside_failures)
     if jr is None:
         return
     for name, help_, fn in (
@@ -802,7 +808,11 @@ def _register_storage_metrics(registry: Registry, broker) -> None:
             ("dirty",
              "1 when a write was lost or parked past its durability "
              "promise (degraded-mode writes, shed rewrites)",
-             lambda: int(jr.dirty))):
+             lambda: int(jr.dirty)),
+            ("disk_full",
+             "1 while the last commit failure was ENOSPC and no commit "
+             "has succeeded since (the ADR-024 disk-full rung is up)",
+             lambda: int(getattr(jr, "disk_full", False)))):
         registry.gauge_func(f"maxmq_storage_{name}", help_, fn)
     for name, help_, fn in (
             ("commits", "Group commits applied to the backend",
@@ -828,7 +838,16 @@ def _register_storage_metrics(registry: Registry, broker) -> None:
             ("commit_seconds", "Cumulative time in backend commits",
              lambda: jr.commit_seconds_total),
             ("degraded_seconds", "Cumulative wall time with the "
-             "storage breaker not closed", lambda: jr.degraded_seconds)):
+             "storage breaker not closed", lambda: jr.degraded_seconds),
+            ("fsync_failures", "Group commits whose flush failed — "
+             "each one poisons the backend connection (ADR 024)",
+             lambda: getattr(jr, "fsync_failures", 0)),
+            ("enospc_failures", "Group commits refused by a full disk "
+             "(immediate breaker trip, ADR 024)",
+             lambda: getattr(jr, "enospc_failures", 0)),
+            ("backend_reopens", "Poisoned backend connections reopened "
+             "before replaying the parked journal (ADR 024)",
+             lambda: getattr(jr, "backend_reopens", 0))):
         registry.counter_func(f"maxmq_storage_{name}_total", help_, fn)
 
 
@@ -863,7 +882,10 @@ def _register_overload_metrics(registry: Registry, broker) -> None:
             ("deferred_retained",
              "Retained deliveries deferred to recovery by shedding"),
             ("stalled_disconnects",
-             "Clients disconnected by the writer stall deadline")):
+             "Clients disconnected by the writer stall deadline"),
+            ("disk_full_sheds",
+             "QoS0-irrelevant storage rewrites shed by the ENOSPC "
+             "ladder rung while the backing disk was full (ADR 024)")):
         registry.counter_func(f"maxmq_broker_overload_{name}_total",
                               help_, lambda n=name: getattr(over, n))
     for reason, attr in (("rate", "connects_refused"),

@@ -17,8 +17,9 @@ trajectory handed to each round was empty. This tool:
 3. prints a per-config/per-metric delta table between the two rounds;
 4. exits non-zero when a **headline throughput** metric (``*per_sec*``,
    higher-better), a **p99 latency** metric (``*p99*``, lower-better),
-   or (ADR 020) a macroday **SLO-sheet** field — ``*loss*``,
-   ``*recover*``/``*convergence*`` times, ``*violation*`` counts, all
+   or (ADR 020/024) an **SLO-sheet** field — ``*loss*``,
+   ``*recover*``/``*convergence*`` times, ``*violation*`` counts, and
+   the crashday row's ``*duplicate*`` (QoS2) counts, all
    lower-better — regressed by more than ``--threshold``
    (default 15%).
 
@@ -149,9 +150,12 @@ def _direction(metric: str) -> int:
     if m.endswith("_ms") or m.endswith("_s") or "latency" in m:
         return -1
     # ADR 020: SLO-sheet counters — loss windows, recovery /
-    # convergence times, violation counts — are all lower-better
+    # convergence times, violation counts — are all lower-better;
+    # ADR 024 adds duplicate counts (QoS2 exactly-once across
+    # crashes). "duplicate", not "dup": "speedup" contains "dup" and
+    # the cshard speedup ratios must stay informational
     if "loss" in m or "recover" in m or "convergence" in m \
-            or "violation" in m:
+            or "violation" in m or "duplicate" in m:
         return -1
     return 0
 
@@ -162,7 +166,7 @@ def _gated(metric: str) -> bool:
     m = metric.lower()
     return ("per_sec" in m or "p99" in m or "loss" in m
             or "recover" in m or "convergence" in m
-            or "violation" in m)
+            or "violation" in m or "duplicate" in m)
 
 
 def compare(old: dict, new: dict, threshold: float,

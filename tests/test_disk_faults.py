@@ -1,0 +1,455 @@
+"""ADR 024 units: the ``disk.*`` fault family, the fault-wrapping
+backend shim, crash-point plumbing, and the hardened journal ladder.
+
+The crash-day subprocess drills live in test_crashday.py; this file
+exercises the machinery in-process — skip-field arming, the
+FaultInjectingStore's injection points and delegation, commit-failure
+classification, fsync poisoning (reopen-before-reprobe), the ENOSPC
+rung (immediate trip + unconditional QoS0-rewrite shed + rung-down on
+success), torn-tail truncation, the quarantine contract under random
+garbage in every bucket, the move-aside-failure fix, the persisted
+content-filter spec round trip, and the replica-flush crash point on
+a live 2-node mesh (swapped kill_fn, no process dies)."""
+
+import asyncio
+import errno
+import json
+import os
+import pathlib
+import re
+import sqlite3
+import time
+from contextlib import asynccontextmanager
+
+import pytest
+
+from maxmq_tpu import faults
+from maxmq_tpu.broker import Broker, BrokerOptions, Capabilities, TCPListener
+from maxmq_tpu.cluster import ClusterManager, PeerSpec
+from maxmq_tpu.hooks import AllowHook
+from maxmq_tpu.hooks.faultstore import (DiskFull, FaultInjectingStore,
+                                        FsyncFailed, torn_tail)
+from maxmq_tpu.hooks.journal import WriteBehindStore, classify_commit_failure
+from maxmq_tpu.hooks.storage import (QUARANTINE_BUCKET, CorruptStoreError,
+                                     SQLiteStore, StorageHook,
+                                     SubscriptionRecord)
+from maxmq_tpu.mqtt_client import MQTTClient
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.clear()
+    kill_fn = faults.REGISTRY.kill_fn
+    yield
+    faults.clear()
+    faults.REGISTRY.kill_fn = kill_fn
+
+
+def wait_until(pred, timeout: float = 5.0, what: str = ""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"condition not reached in {timeout}s: {what}")
+
+
+# ----------------------------------------------------------------------
+# Fault registry: skip field + crash points
+# ----------------------------------------------------------------------
+
+
+def test_spec_skip_field_delays_fire_uncounted():
+    kills = []
+    faults.REGISTRY.kill_fn = lambda: kills.append(1)
+    faults.arm_from_spec("crash.at#pre_fsync:kill:1:0:3")
+    for _ in range(3):                   # three pass-through hits
+        faults.crash_point("pre_fsync")
+        assert not kills
+        # near-misses are not trips
+        assert faults.REGISTRY.fired.get("crash.at#pre_fsync", 0) == 0
+    faults.crash_point("pre_fsync")      # the 4th hit fires
+    assert kills == [1]
+    assert faults.REGISTRY.fired["crash.at#pre_fsync"] == 1
+    faults.crash_point("pre_fsync")      # count=1: spent
+    assert kills == [1]
+
+
+def test_every_crash_point_fires_and_other_points_pass():
+    for point in faults.CRASH_POINTS:
+        kills = []
+        faults.REGISTRY.kill_fn = lambda k=kills: k.append(1)
+        faults.arm(f"crash.at#{point}", "kill")
+        for other in faults.CRASH_POINTS:
+            if other != point:
+                faults.crash_point(other)
+        assert not kills, f"{point}: wrong point tripped"
+        faults.crash_point(point)
+        assert kills == [1], f"{point}: armed point did not fire"
+        faults.clear()
+
+
+def test_crash_point_registry_matches_call_sites():
+    """Every CRASH_POINTS name must have a production call site and
+    every call site must be registered — the two lists drift apart
+    silently otherwise (an unregistered point can never be armed; a
+    registered-but-never-called one gives false coverage)."""
+    pkg = pathlib.Path(faults.__file__).parent
+    called = set()
+    for py in pkg.rglob("*.py"):
+        if py.name == "faults.py":
+            continue
+        called |= set(re.findall(r'crash_point\(\s*"([a-z_]+)"',
+                                 py.read_text()))
+    assert called == set(faults.CRASH_POINTS)
+
+
+# ----------------------------------------------------------------------
+# Commit-failure classification
+# ----------------------------------------------------------------------
+
+
+def test_classify_commit_failure():
+    assert classify_commit_failure(FsyncFailed()) == "fsync"
+    assert classify_commit_failure(
+        OSError(errno.ENOSPC, "no space")) == "enospc"
+    assert classify_commit_failure(DiskFull()) == "enospc"
+    assert classify_commit_failure(
+        sqlite3.OperationalError("database or disk is full")) == "enospc"
+    assert classify_commit_failure(
+        OSError("fsync failed on journal")) == "fsync"
+    assert classify_commit_failure(OSError(errno.EIO, "eio")) == "other"
+    assert classify_commit_failure(ValueError("boom")) == "other"
+
+
+# ----------------------------------------------------------------------
+# FaultInjectingStore shim
+# ----------------------------------------------------------------------
+
+
+def test_faultstore_injects_and_delegates(tmp_path):
+    inner = SQLiteStore(str(tmp_path / "s.db"))
+    store = FaultInjectingStore(inner)
+
+    faults.arm(faults.DISK_WRITE, "err", 1)
+    with pytest.raises(OSError) as exc:
+        store.put("b", "k", "v")
+    assert exc.value.errno == errno.EIO
+    assert store.get("b", "k") is None       # EIO fires BEFORE the write
+
+    faults.arm(faults.DISK_ENOSPC, "err", 1)
+    with pytest.raises(DiskFull):
+        store.put("b", "k", "v")
+
+    # fsync failure fires AFTER the inner op: the write may have landed
+    # (flush-result-unknown is exactly the fsyncgate ambiguity)
+    faults.arm(faults.DISK_FSYNC, "err", 1)
+    with pytest.raises(FsyncFailed):
+        store.put("b", "k", "v1")
+    assert inner.get("b", "k") == "v1"
+
+    faults.arm(faults.DISK_LATENCY, "hang", 1, delay_s=0.08)
+    t0 = time.perf_counter()
+    store.put("b", "k2", "v2")
+    assert time.perf_counter() - t0 >= 0.08
+
+    # delegation: reads, bulk reads, counters, reopen
+    assert store.get("b", "k2") == "v2"
+    assert store.all("b") == {"k": "v1", "k2": "v2"}
+    assert store.corruptions == 0            # __getattr__ passthrough
+    store.reopen()
+    assert store.get("b", "k2") == "v2"
+
+    faults.arm(faults.DISK_ENOSPC, "err", 1)
+    with pytest.raises(DiskFull):
+        store.apply_batch([("put", "b", "k3", "v3")])
+    store.apply_batch([("put", "b", "k3", "v3")])
+    assert store.get("b", "k3") == "v3"
+    store.close()
+
+
+def test_torn_tail_truncates(tmp_path):
+    db = str(tmp_path / "t.db")
+    inner = SQLiteStore(db)
+    for i in range(50):
+        inner.put("b", f"k{i}", "x" * 64)
+    wal = db + "-wal"
+    assert os.path.exists(wal)
+    before = os.path.getsize(wal)
+    cut = torn_tail(db, 128, target="wal")
+    assert cut == 128
+    assert os.path.getsize(wal) == before - 128
+    inner.close()
+    before_db = os.path.getsize(db)
+    cut = torn_tail(db, 64, target="db")
+    assert cut == 64 and os.path.getsize(db) == before_db - 64
+    # a cut larger than the file empties it instead of raising
+    assert torn_tail(db, 10**9, target="db") == before_db - 64
+    assert os.path.getsize(db) == 0
+
+
+# ----------------------------------------------------------------------
+# Journal hardening: fsync poisoning + ENOSPC rung
+# ----------------------------------------------------------------------
+
+
+def _journal(tmp_path, name, **kw):
+    kw.setdefault("policy", "always")
+    kw.setdefault("backoff_s", 0.05)
+    kw.setdefault("backoff_max_s", 0.1)
+    return WriteBehindStore(
+        FaultInjectingStore(SQLiteStore(str(tmp_path / name))), **kw)
+
+
+def test_fsync_failure_poisons_then_reopens_and_replays(tmp_path):
+    j = _journal(tmp_path, "fs.db")
+    try:
+        faults.arm(faults.DISK_FSYNC, "err", 1)
+        j.put("b", "k", "v1")
+        wait_until(lambda: j.fsync_failures == 1, what="fsync counted")
+        # fsync class trips the breaker IMMEDIATELY — no 5-strike grace
+        assert j.breaker_trips >= 1
+        wait_until(lambda: j.breaker_recoveries >= 1 and j.commits >= 1,
+                   what="reprobe recovered")
+        # the reprobe reopened the poisoned connection BEFORE retrying
+        assert j.backend_reopens == 1
+        assert not j._poisoned
+        assert j.flush(timeout=5.0)
+        assert j.inner.get("b", "k") == "v1"     # parked op replayed
+    finally:
+        j.close()
+
+
+def test_enospc_trips_immediately_and_clears_on_success(tmp_path):
+    j = _journal(tmp_path, "eno.db")
+    try:
+        faults.arm(faults.DISK_ENOSPC, "err", 1)
+        j.put("b", "k", "v1")
+        wait_until(lambda: j.enospc_failures == 1, what="enospc counted")
+        assert j.breaker_trips >= 1              # immediate trip
+        wait_until(lambda: j.disk_full or j.commits >= 1,
+                   what="disk_full observed or already recovered")
+        wait_until(lambda: not j.disk_full and j.commits >= 1,
+                   what="rung down on first successful commit")
+        assert j.flush(timeout=5.0)
+        assert j.inner.get("b", "k") == "v1"
+    finally:
+        j.close()
+
+
+def test_enospc_shed_rung_sheds_qos0_rewrites_unconditionally(tmp_path):
+    j = _journal(tmp_path, "shed.db")
+    hook = StorageHook(j)
+
+    class _Over:
+        disk_full_sheds = 0
+
+    class _Server:
+        overload = _Over()
+
+    class _Client:
+        server = _Server()
+
+    try:
+        client = _Client()
+        assert hook._shed_rewrite(client) is False   # healthy: no shed
+        j.disk_full = True
+        # full disk: shed regardless of watermark/overload state
+        assert hook._shed_rewrite(client) is True
+        assert client.server.overload.disk_full_sheds == 1
+        j.disk_full = False
+        assert hook._shed_rewrite(client) is False
+    finally:
+        j.close()
+
+
+# ----------------------------------------------------------------------
+# Quarantine property: random garbage in every bucket
+# ----------------------------------------------------------------------
+
+
+def test_restore_quarantines_random_garbage_exactly(tmp_path):
+    import random
+    rng = random.Random(240)
+    store = SQLiteStore(str(tmp_path / "q.db"))
+    junk_gens = (
+        lambda: '{"torn": tru',                      # torn JSON
+        lambda: "",                                  # empty record
+        lambda: "[]",                                # wrong JSON shape
+        lambda: '"just a string"',                   # wrong JSON shape
+        lambda: "\x00" + "".join(chr(rng.randrange(32, 300))
+                                 for _ in range(rng.randrange(1, 40))),
+    )
+    planted = []
+    for bucket in ("clients", "subscriptions", "retained", "inflight"):
+        for i in range(rng.randrange(3, 8)):
+            key = f"junk-{bucket}-{i}"
+            store.put(bucket, key, rng.choice(junk_gens)())
+            planted.append((bucket, key))
+    good = SubscriptionRecord(client_id="c1", filter="a/b", qos=1)
+    store.put("subscriptions", "c1|a/b", good.to_json())
+
+    hook = StorageHook(store)
+    # restore must NEVER raise, whatever the garbage
+    assert hook.stored_clients() == []
+    subs = hook.stored_subscriptions()
+    assert hook.stored_retained_messages() == []
+    assert hook.stored_inflight_messages() == []
+
+    assert [s.filter for s in subs] == ["a/b"]       # the good one lives
+    assert hook.quarantined == len(planted)
+    rows = store.all(QUARANTINE_BUCKET)
+    assert len(rows) == len(planted)                 # one row per record
+    for bucket, key in planted:
+        assert f"{bucket}|{key}" in rows
+        assert store.get(bucket, key) is None        # moved, not copied
+    store.close()
+
+
+# ----------------------------------------------------------------------
+# Move-aside failure fix (satellite: the silenced except OSError)
+# ----------------------------------------------------------------------
+
+
+def test_recreate_aside_failure_counted_and_still_boots(tmp_path,
+                                                        monkeypatch):
+    db = str(tmp_path / "c.db")
+    with open(db, "w") as f:
+        f.write("not a sqlite file at all")
+
+    def refuse_replace(src, dst):
+        raise OSError(errno.EACCES, "injected: aside volume readonly")
+
+    monkeypatch.setattr(os, "replace", refuse_replace)
+    store = SQLiteStore(db)          # boot MUST still succeed
+    assert store.corruptions == 1
+    assert store.aside_failures == 1     # counted, not swallowed
+    store.put("b", "k", "v")
+    assert store.get("b", "k") == "v"
+    store.close()
+    # the damaged file was removed in place (forensic copy lost), so
+    # no .corrupt-N sibling exists
+    assert not [p for p in os.listdir(tmp_path) if ".corrupt-" in p]
+
+
+# ----------------------------------------------------------------------
+# Persisted content-filter specs (satellite: ?$expr= / ?$agg= restore)
+# ----------------------------------------------------------------------
+
+
+@asynccontextmanager
+async def running_broker(db=None, **caps):
+    """Broker with hooks attached BEFORE serve() — restore from the
+    storage hook happens inside serve (server.py)."""
+    caps.setdefault("sys_topic_interval", 0)
+    b = Broker(BrokerOptions(capabilities=Capabilities(**caps)))
+    b.add_hook(AllowHook())
+    if db is not None:
+        b.add_hook(StorageHook(SQLiteStore(db)))
+    listener = b.add_listener(TCPListener("t1", "127.0.0.1:0"))
+    await b.serve()
+    b.test_port = listener._server.sockets[0].getsockname()[1]
+    try:
+        yield b
+    finally:
+        await b.close()
+
+
+async def test_content_spec_survives_restart(tmp_path):
+    db = str(tmp_path / "content.db")
+    async with running_broker(db=db, content_filtering=True) as b1:
+        c = MQTTClient(client_id="cf", clean_start=False)
+        await c.connect("127.0.0.1", b1.test_port)
+        assert await c.subscribe(
+            ("s/t?$expr=payload.temp>30", 1),
+            ("s/a?$agg=avg&$win=5s&$field=payload.v", 0),
+            ("s/plain", 0)) == [1, 0, 0]
+        sub = b1.content.get("cf", "s/t")
+        assert sub.spec.source == "$expr=payload.temp>30"
+        await c.disconnect()
+
+    async with running_broker(db=db, content_filtering=True) as b2:
+        sub = b2.content.get("cf", "s/t")
+        assert sub is not None
+        assert sub.spec.source == "$expr=payload.temp>30"
+        agg = b2.content.get("cf", "s/a")
+        assert agg is not None and agg.spec.agg == "avg"
+        assert b2.content.get("cf", "s/plain") is None
+        assert b2.content.active
+
+        # and it FILTERS: resume the session, mismatching payload is
+        # masked, matching one delivers
+        c = MQTTClient(client_id="cf", clean_start=False)
+        await c.connect("127.0.0.1", b2.test_port)
+        assert c.connack.session_present
+        pub = MQTTClient(client_id="p")
+        await pub.connect("127.0.0.1", b2.test_port)
+        await pub.publish("s/t", b'{"temp": 10}', qos=1, timeout=5.0)
+        await pub.publish("s/t", b'{"temp": 99}', qos=1, timeout=5.0)
+        m = await c.next_message(timeout=5.0)
+        assert m.payload == b'{"temp": 99}'
+        with pytest.raises(asyncio.TimeoutError):
+            await c.next_message(timeout=0.3)
+        await pub.close()
+        await c.close()
+
+
+async def test_unparseable_restored_spec_degrades_not_fails_boot(tmp_path):
+    db = str(tmp_path / "badspec.db")
+    store = SQLiteStore(db)
+    rec = SubscriptionRecord(client_id="cf", filter="s/t", qos=1,
+                             options="$expr=payload..broken>")
+    store.put("subscriptions", "cf|s/t", rec.to_json())
+    store.close()
+    async with running_broker(db=db, content_filtering=True) as b:
+        # boot (with restore inside serve) must not raise; the spec is
+        # rejected loudly but the subscription itself still restored,
+        # just unfiltered
+        assert b.content.get("cf", "s/t") is None
+        assert b.content.rejected_subscribes == 1
+        assert b.info.subscriptions == 1
+
+
+# ----------------------------------------------------------------------
+# Replica-flush crash point on a live mesh (swapped kill_fn)
+# ----------------------------------------------------------------------
+
+
+async def _make_node() -> Broker:
+    b = Broker(BrokerOptions(capabilities=Capabilities(
+        sys_topic_interval=0)))
+    b.add_hook(AllowHook())
+    listener = b.add_listener(TCPListener("t", "127.0.0.1:0"))
+    await b.serve()
+    b.test_port = listener._server.sockets[0].getsockname()[1]
+    return b
+
+
+async def test_replica_flush_crash_point_trips_on_mesh():
+    kills = []
+    faults.REGISTRY.kill_fn = lambda: kills.append(1)
+    a, b = await _make_node(), await _make_node()
+    mgr_a = ClusterManager(a, "a", [PeerSpec("b", "127.0.0.1",
+                                             b.test_port)],
+                           keepalive=0.5, backoff_initial_s=0.05)
+    mgr_b = ClusterManager(b, "b", [PeerSpec("a", "127.0.0.1",
+                                             a.test_port)],
+                           keepalive=0.5, backoff_initial_s=0.05)
+    a.attach_cluster(mgr_a)
+    b.attach_cluster(mgr_b)
+    await mgr_a.start()
+    await mgr_b.start()
+    try:
+        faults.arm("crash.at#replica_flush", "kill")
+        c = MQTTClient(client_id="rc", clean_start=False)
+        await c.connect("127.0.0.1", a.test_port)
+        await c.subscribe(("r/t", 1))        # dirties the session entry
+        deadline = time.monotonic() + 5.0
+        while not kills and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+        assert kills, "replica_flush crash point never reached"
+        await c.close()
+    finally:
+        faults.clear()
+        await a.close()
+        await b.close()
